@@ -1,0 +1,122 @@
+"""Device mesh construction — the TPU replacement for process groups.
+
+The reference manages many torch.distributed process groups
+(ref: deepspeed/utils/groups.py:305 _clone_world_group, :321
+_get_data_parallel_group, expert groups at :107/:160/:206). On TPU all of
+that collapses into ONE ``jax.sharding.Mesh`` with named axes; "groups"
+become axis names and collectives become XLA ops over those axes.
+
+Axis layout (major to minor): ``('pipe', 'data', 'fsdp', 'sequence', 'model')``.
+- ``data``   replicated-param data parallelism (ZeRO-0/1/2)
+- ``fsdp``   parameter-sharding data parallelism (ZeRO-3); merged with
+             ``data`` for the optimizer-state partitioning so dp degree =
+             data*fsdp
+- ``model``  tensor parallelism — innermost so TP collectives ride the
+             fastest ICI links
+- ``sequence`` ring/all-to-all sequence parallelism (DeepSpeed has no SP at
+             v0.6.4; first-class here)
+- ``expert`` expert parallelism reuses the (data x fsdp) axes via
+             ``expert_sharding`` helpers rather than occupying mesh slots
+             (GShard-style: experts sharded over dp ranks).
+"""
+
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.runtime import constants as C
+from deepspeed_tpu.utils.logging import logger
+
+# canonical axis order, major -> minor
+MESH_AXES = ("pipe", "data", "fsdp", "sequence", "model")
+
+# axes over which a batch is split
+BATCH_AXES = ("data", "fsdp")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Logical parallelism degrees; -1 data means 'use remaining devices'."""
+    pipe: int = 1
+    data: int = -1
+    fsdp: int = 1
+    sequence: int = 1
+    model: int = 1
+
+    def resolve(self, n_devices: int) -> Tuple[int, ...]:
+        fixed = self.pipe * self.fsdp * self.sequence * self.model
+        data = self.data
+        if data == -1:
+            assert n_devices % fixed == 0, (
+                f"devices {n_devices} not divisible by pipe*fsdp*seq*model={fixed}")
+            data = n_devices // fixed
+        total = fixed * data
+        assert total == n_devices, (
+            f"mesh {self} requires {total} devices, have {n_devices}")
+        return (self.pipe, data, self.fsdp, self.sequence, self.model)
+
+
+def make_mesh(spec: Optional[MeshSpec] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build the framework mesh over the given (default: all) devices."""
+    spec = spec or MeshSpec()
+    devices = list(devices if devices is not None else jax.devices())
+    dims = spec.resolve(len(devices))
+    try:
+        from jax.experimental import mesh_utils
+        dev_array = mesh_utils.create_device_mesh(dims, devices=devices)
+    except Exception:
+        dev_array = np.asarray(devices).reshape(dims)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def mesh_from_config(cfg, devices: Optional[Sequence] = None) -> Mesh:
+    """Build a mesh from a DeepSpeedConfig's MeshConfig.
+
+    ZeRO stage 3 moves the data-parallel degree onto the ``fsdp`` axis so
+    parameter sharding happens over it; stages 0-2 keep it on ``data``.
+    """
+    m = cfg.mesh
+    n = len(devices if devices is not None else jax.devices())
+    fixed = (m.pipeline_parallel_size * m.tensor_parallel_size *
+             m.sequence_parallel_size)
+    assert n % fixed == 0, f"{n} devices not divisible by pp*tp*sp={fixed}"
+    dp_total = n // fixed
+    if cfg.zero.stage == 3:
+        spec = MeshSpec(pipe=m.pipeline_parallel_size, data=1, fsdp=dp_total,
+                        sequence=m.sequence_parallel_size,
+                        model=m.tensor_parallel_size)
+    else:
+        spec = MeshSpec(pipe=m.pipeline_parallel_size, data=dp_total, fsdp=1,
+                        sequence=m.sequence_parallel_size,
+                        model=m.tensor_parallel_size)
+    mesh = make_mesh(spec, devices)
+    logger.info(f"mesh axes {dict(zip(mesh.axis_names, mesh.devices.shape))}")
+    return mesh
+
+
+def dp_world_size(mesh: Mesh) -> int:
+    """Total data-parallel degree (data x fsdp axes)."""
+    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    return shape.get("data", 1) * shape.get("fsdp", 1)
+
+
+def axis_size(mesh: Mesh, axis: str) -> int:
+    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    return shape.get(axis, 1)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for a [batch, ...] input: split over both dp axes."""
+    return NamedSharding(mesh, P(BATCH_AXES))
+
+
+def batch_pspec() -> P:
+    return P(BATCH_AXES)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,600 @@
+"""JSON config file/dict -> typed configuration object.
+
+TPU-native analog of the reference config system
+(ref: deepspeed/runtime/config.py:791 DeepSpeedConfig; per-feature getters at
+:79-662; zero config at deepspeed/runtime/zero/config.py; offload config at
+deepspeed/runtime/zero/offload_config.py). Same JSON schema where it makes
+sense on TPU (so a DeepSpeed user's ds_config.json mostly "just works"), plus
+a ``mesh`` section describing the named-axis device mesh that replaces
+process groups.
+"""
+
+import json
+import os
+from dataclasses import dataclass, field, asdict
+from typing import Any, Dict, List, Optional, Union
+
+from deepspeed_tpu.runtime import constants as C
+from deepspeed_tpu.runtime.config_utils import (
+    dict_raise_error_on_duplicate_keys,
+    get_scalar_param,
+)
+from deepspeed_tpu.utils.logging import logger
+
+
+class DeepSpeedConfigError(Exception):
+    pass
+
+
+@dataclass
+class FP16Config:
+    enabled: bool = False
+    loss_scale: float = 0.0          # 0 => dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    min_loss_scale: float = 1.0
+    fp16_master_weights_and_grads: bool = False
+
+    @property
+    def dynamic_loss_scale(self) -> bool:
+        return self.loss_scale == 0
+
+    @staticmethod
+    def from_dict(d: Dict) -> "FP16Config":
+        return FP16Config(
+            enabled=get_scalar_param(d, C.FP16_ENABLED, C.FP16_ENABLED_DEFAULT),
+            loss_scale=get_scalar_param(d, C.FP16_LOSS_SCALE, C.FP16_LOSS_SCALE_DEFAULT),
+            initial_scale_power=get_scalar_param(d, C.FP16_INITIAL_SCALE_POWER,
+                                                 C.FP16_INITIAL_SCALE_POWER_DEFAULT),
+            loss_scale_window=get_scalar_param(d, C.FP16_LOSS_SCALE_WINDOW,
+                                               C.FP16_LOSS_SCALE_WINDOW_DEFAULT),
+            hysteresis=get_scalar_param(d, C.FP16_HYSTERESIS, C.FP16_HYSTERESIS_DEFAULT),
+            min_loss_scale=get_scalar_param(d, C.FP16_MIN_LOSS_SCALE,
+                                            C.FP16_MIN_LOSS_SCALE_DEFAULT),
+            fp16_master_weights_and_grads=get_scalar_param(
+                d, C.FP16_MASTER_WEIGHTS_AND_GRADS,
+                C.FP16_MASTER_WEIGHTS_AND_GRADS_DEFAULT),
+        )
+
+
+@dataclass
+class BF16Config:
+    enabled: bool = False
+
+    @staticmethod
+    def from_dict(d: Dict) -> "BF16Config":
+        return BF16Config(enabled=get_scalar_param(d, C.BFLOAT16_ENABLED,
+                                                   C.BFLOAT16_ENABLED_DEFAULT))
+
+
+@dataclass
+class OffloadConfig:
+    """Offload target for params or optimizer state
+    (ref: deepspeed/runtime/zero/offload_config.py)."""
+    device: str = C.OFFLOAD_DEVICE_NONE   # none | cpu | nvme
+    nvme_path: Optional[str] = None
+    buffer_count: int = 5
+    buffer_size: int = 100_000_000
+    pin_memory: bool = False
+    pipeline_read: bool = False
+    pipeline_write: bool = False
+    max_in_cpu: int = 1_000_000_000
+
+    @property
+    def enabled(self) -> bool:
+        return self.device != C.OFFLOAD_DEVICE_NONE
+
+    @staticmethod
+    def from_dict(d: Optional[Dict]) -> "OffloadConfig":
+        if not d:
+            return OffloadConfig()
+        return OffloadConfig(
+            device=get_scalar_param(d, C.OFFLOAD_DEVICE, C.OFFLOAD_DEVICE_NONE),
+            nvme_path=get_scalar_param(d, C.OFFLOAD_NVME_PATH, None),
+            buffer_count=get_scalar_param(d, C.OFFLOAD_BUFFER_COUNT, 5),
+            buffer_size=int(get_scalar_param(d, C.OFFLOAD_BUFFER_SIZE, 100_000_000)),
+            pin_memory=get_scalar_param(d, C.OFFLOAD_PIN_MEMORY, False),
+            pipeline_read=get_scalar_param(d, C.OFFLOAD_PIPELINE_READ, False),
+            pipeline_write=get_scalar_param(d, C.OFFLOAD_PIPELINE_WRITE, False),
+            max_in_cpu=int(get_scalar_param(d, C.OFFLOAD_MAX_IN_CPU, 1_000_000_000)),
+        )
+
+
+@dataclass
+class ZeroConfig:
+    """ZeRO sharding config (ref: deepspeed/runtime/zero/config.py).
+
+    On TPU, stages are realized as sharding specs over the mesh:
+      stage 0: everything replicated over 'data'
+      stage 1: optimizer state sharded over 'data'
+      stage 2: stage 1 + gradients reduce-scattered (XLA emits these when the
+               grad accumulator is sharded)
+      stage 3: stage 2 + parameters sharded over 'data' (FSDP)
+    """
+    stage: int = 0
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: int = 500_000_000
+    allgather_partitions: bool = True
+    allgather_bucket_size: int = 500_000_000
+    overlap_comm: bool = False
+    offload_param: OffloadConfig = field(default_factory=OffloadConfig)
+    offload_optimizer: OffloadConfig = field(default_factory=OffloadConfig)
+    stage3_max_live_parameters: int = 1_000_000_000
+    stage3_max_reuse_distance: int = 1_000_000_000
+    stage3_prefetch_bucket_size: int = 50_000_000
+    stage3_param_persistence_threshold: int = 100_000
+    stage3_gather_16bit_weights_on_model_save: bool = False
+    round_robin_gradients: bool = False
+    elastic_checkpoint: bool = True
+    # minimum trailing-dim size below which a param stays replicated in stage 3
+    stage3_min_shard_size: int = 1024
+
+    @property
+    def enabled(self) -> bool:
+        return self.stage > 0
+
+    @staticmethod
+    def from_dict(d: Optional[Dict]) -> "ZeroConfig":
+        if not d:
+            return ZeroConfig()
+        cfg = ZeroConfig(
+            stage=get_scalar_param(d, C.ZERO_STAGE, C.ZERO_STAGE_DEFAULT),
+            contiguous_gradients=get_scalar_param(d, C.ZERO_CONTIGUOUS_GRADIENTS, True),
+            reduce_scatter=get_scalar_param(d, C.ZERO_REDUCE_SCATTER, True),
+            reduce_bucket_size=int(get_scalar_param(d, C.ZERO_REDUCE_BUCKET_SIZE, 500_000_000)),
+            allgather_partitions=get_scalar_param(d, C.ZERO_ALLGATHER_PARTITIONS, True),
+            allgather_bucket_size=int(get_scalar_param(d, C.ZERO_ALLGATHER_BUCKET_SIZE, 500_000_000)),
+            overlap_comm=get_scalar_param(d, C.ZERO_OVERLAP_COMM, False),
+            offload_param=OffloadConfig.from_dict(d.get(C.ZERO_OFFLOAD_PARAM)),
+            offload_optimizer=OffloadConfig.from_dict(d.get(C.ZERO_OFFLOAD_OPTIMIZER)),
+            stage3_max_live_parameters=int(get_scalar_param(
+                d, C.ZERO_STAGE3_MAX_LIVE_PARAMETERS, 1_000_000_000)),
+            stage3_max_reuse_distance=int(get_scalar_param(
+                d, C.ZERO_STAGE3_MAX_REUSE_DISTANCE, 1_000_000_000)),
+            stage3_prefetch_bucket_size=int(get_scalar_param(
+                d, C.ZERO_STAGE3_PREFETCH_BUCKET_SIZE, 50_000_000)),
+            stage3_param_persistence_threshold=int(get_scalar_param(
+                d, C.ZERO_STAGE3_PARAM_PERSISTENCE_THRESHOLD, 100_000)),
+            stage3_gather_16bit_weights_on_model_save=get_scalar_param(
+                d, C.ZERO_STAGE3_GATHER_16BIT_WEIGHTS_ON_MODEL_SAVE, False),
+            round_robin_gradients=get_scalar_param(d, C.ZERO_ROUND_ROBIN_GRADIENTS, False),
+            elastic_checkpoint=get_scalar_param(d, C.ZERO_ELASTIC_CHECKPOINT, True),
+            stage3_min_shard_size=int(get_scalar_param(d, "stage3_min_shard_size", 1024)),
+        )
+        if cfg.stage not in (0, 1, 2, 3):
+            raise DeepSpeedConfigError(f"invalid zero stage {cfg.stage}")
+        return cfg
+
+
+@dataclass
+class MeshConfig:
+    """Named-axis device mesh replacing the reference's process groups
+    (ref: deepspeed/utils/groups.py, deepspeed/runtime/pipe/topology.py).
+
+    The data-parallel degree is derived: dp = world // (tp * pp * sp).
+    """
+    tensor_parallel_size: int = 1
+    pipeline_parallel_size: int = 1
+    sequence_parallel_size: int = 1
+    expert_parallel_size: int = 1
+
+    @staticmethod
+    def from_dict(d: Optional[Dict]) -> "MeshConfig":
+        if not d:
+            return MeshConfig()
+        return MeshConfig(
+            tensor_parallel_size=get_scalar_param(
+                d, C.TENSOR_PARALLEL_SIZE, C.TENSOR_PARALLEL_SIZE_DEFAULT),
+            pipeline_parallel_size=get_scalar_param(
+                d, C.PIPELINE_PARALLEL_SIZE, C.PIPELINE_PARALLEL_SIZE_DEFAULT),
+            sequence_parallel_size=get_scalar_param(
+                d, C.SEQUENCE_PARALLEL_SIZE, C.SEQUENCE_PARALLEL_SIZE_DEFAULT),
+            expert_parallel_size=get_scalar_param(
+                d, C.EXPERT_PARALLEL_SIZE, C.EXPERT_PARALLEL_SIZE_DEFAULT),
+        )
+
+
+@dataclass
+class ActivationCheckpointingConfig:
+    """ref: deepspeed/runtime/activation_checkpointing/checkpointing.py config."""
+    partition_activations: bool = False
+    number_checkpoints: Optional[int] = None
+    contiguous_memory_optimization: bool = False
+    synchronize_checkpoint_boundary: bool = False
+    cpu_checkpointing: bool = False
+    profile: bool = False
+
+    @staticmethod
+    def from_dict(d: Optional[Dict]) -> "ActivationCheckpointingConfig":
+        if not d:
+            return ActivationCheckpointingConfig()
+        return ActivationCheckpointingConfig(
+            partition_activations=get_scalar_param(d, C.ACT_CKPT_PARTITION_ACTIVATIONS, False),
+            number_checkpoints=get_scalar_param(d, C.ACT_CKPT_NUMBER_CHECKPOINTS, None),
+            contiguous_memory_optimization=get_scalar_param(
+                d, C.ACT_CKPT_CONTIGUOUS_MEMORY_OPTIMIZATION, False),
+            synchronize_checkpoint_boundary=get_scalar_param(
+                d, C.ACT_CKPT_SYNCHRONIZE_CHECKPOINT_BOUNDARY, False),
+            cpu_checkpointing=get_scalar_param(d, C.ACT_CKPT_CPU_CHECKPOINTING, False),
+            profile=get_scalar_param(d, C.ACT_CKPT_PROFILE, False),
+        )
+
+
+@dataclass
+class SparseAttentionConfig:
+    """Block-sparse attention pattern config
+    (ref: deepspeed/ops/sparse_attention/sparsity_config.py:9,63,94,243,421,544)."""
+    mode: str = C.SPARSE_FIXED_MODE
+    block: int = 16
+    different_layout_per_head: bool = False
+    num_local_blocks: int = 4
+    num_global_blocks: int = 1
+    attention: str = "bidirectional"
+    horizontal_global_attention: bool = False
+    num_different_global_patterns: int = 1
+    num_random_blocks: int = 0
+    local_window_blocks: List[int] = field(default_factory=lambda: [4])
+    global_block_indices: List[int] = field(default_factory=lambda: [0])
+    global_block_end_indices: Optional[List[int]] = None
+    num_sliding_window_blocks: int = 3
+
+    @staticmethod
+    def from_dict(d: Optional[Dict]) -> Optional["SparseAttentionConfig"]:
+        if d is None:
+            return None
+        cfg = SparseAttentionConfig()
+        for k, v in d.items():
+            if hasattr(cfg, k):
+                setattr(cfg, k, v)
+        return cfg
+
+
+@dataclass
+class FlopsProfilerConfig:
+    enabled: bool = False
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+    @staticmethod
+    def from_dict(d: Optional[Dict]) -> "FlopsProfilerConfig":
+        if not d:
+            return FlopsProfilerConfig()
+        return FlopsProfilerConfig(
+            enabled=get_scalar_param(d, C.FLOPS_PROFILER_ENABLED, False),
+            profile_step=get_scalar_param(d, C.FLOPS_PROFILER_PROFILE_STEP, 1),
+            module_depth=get_scalar_param(d, C.FLOPS_PROFILER_MODULE_DEPTH, -1),
+            top_modules=get_scalar_param(d, C.FLOPS_PROFILER_TOP_MODULES, 1),
+            detailed=get_scalar_param(d, C.FLOPS_PROFILER_DETAILED, True),
+            output_file=get_scalar_param(d, C.FLOPS_PROFILER_OUTPUT_FILE, None),
+        )
+
+
+@dataclass
+class TensorboardConfig:
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = C.TENSORBOARD_JOB_NAME_DEFAULT
+
+    @staticmethod
+    def from_dict(d: Optional[Dict]) -> "TensorboardConfig":
+        if not d:
+            return TensorboardConfig()
+        return TensorboardConfig(
+            enabled=get_scalar_param(d, C.TENSORBOARD_ENABLED, False),
+            output_path=get_scalar_param(d, C.TENSORBOARD_OUTPUT_PATH, ""),
+            job_name=get_scalar_param(d, C.TENSORBOARD_JOB_NAME,
+                                      C.TENSORBOARD_JOB_NAME_DEFAULT),
+        )
+
+
+@dataclass
+class PLDConfig:
+    enabled: bool = False
+    theta: float = 1.0
+    gamma: float = 0.001
+
+    @staticmethod
+    def from_dict(d: Optional[Dict]) -> "PLDConfig":
+        if not d:
+            return PLDConfig()
+        return PLDConfig(
+            enabled=get_scalar_param(d, C.PLD_ENABLED, C.PLD_ENABLED_DEFAULT),
+            theta=get_scalar_param(d, C.PLD_THETA, C.PLD_THETA_DEFAULT),
+            gamma=get_scalar_param(d, C.PLD_GAMMA, C.PLD_GAMMA_DEFAULT),
+        )
+
+
+@dataclass
+class CurriculumConfig:
+    enabled: bool = False
+    curriculum_type: str = "seqlen"
+    min_difficulty: int = 8
+    max_difficulty: int = 1024
+    schedule_type: str = "fixed_linear"
+    schedule_config: Dict[str, Any] = field(default_factory=dict)
+
+    @staticmethod
+    def from_dict(d: Optional[Dict]) -> "CurriculumConfig":
+        if not d:
+            return CurriculumConfig()
+        return CurriculumConfig(
+            enabled=get_scalar_param(d, C.CURRICULUM_ENABLED, False),
+            curriculum_type=get_scalar_param(d, "curriculum_type", "seqlen"),
+            min_difficulty=get_scalar_param(d, "min_difficulty", 8),
+            max_difficulty=get_scalar_param(d, "max_difficulty", 1024),
+            schedule_type=get_scalar_param(d, "schedule_type", "fixed_linear"),
+            schedule_config=d.get("schedule_config", {}),
+        )
+
+
+@dataclass
+class EigenvalueConfig:
+    """MoQ eigenvalue config (ref: deepspeed/runtime/eigenvalue.py:7)."""
+    enabled: bool = False
+    verbose: bool = False
+    max_iter: int = 100
+    tol: float = 1e-2
+    stability: float = 1e-6
+    gas_boundary_resolution: int = 1
+    layer_name: str = "bert.encoder.layer"
+    layer_num: int = 0
+
+    @staticmethod
+    def from_dict(d: Optional[Dict]) -> "EigenvalueConfig":
+        if not d:
+            return EigenvalueConfig()
+        cfg = EigenvalueConfig()
+        for k, v in d.items():
+            if hasattr(cfg, k):
+                setattr(cfg, k, v)
+        return cfg
+
+
+@dataclass
+class QuantizeTrainingConfig:
+    """MoQ quantize-aware-training config (ref: deepspeed/runtime/quantize.py:12
+    and config parsing in deepspeed/runtime/config.py get_quantize_training)."""
+    enabled: bool = False
+    quantize_bits_start: int = 16
+    quantize_bits_target: int = 8
+    quantize_schedule_offset: int = 100
+    quantize_groups: int = 1
+    quantize_period: int = 100
+    schedule_type: str = "linear"   # linear | exponential
+    quantize_type: str = "symmetric"  # symmetric | asymmetric
+    rounding: str = "nearest"       # nearest | stochastic
+    fp16_mixed_quantize: bool = False
+    quantize_change_ratio: float = 0.001
+    quantize_verbose: bool = False
+    use_quantizer_kernel: bool = True
+    eigenvalue: EigenvalueConfig = field(default_factory=EigenvalueConfig)
+
+    @staticmethod
+    def from_dict(d: Optional[Dict]) -> "QuantizeTrainingConfig":
+        if not d:
+            return QuantizeTrainingConfig()
+        cfg = QuantizeTrainingConfig()
+        for k, v in d.items():
+            if k == "eigenvalue":
+                cfg.eigenvalue = EigenvalueConfig.from_dict(v)
+            elif hasattr(cfg, k):
+                setattr(cfg, k, v)
+        cfg.enabled = d.get("enabled", False)
+        return cfg
+
+
+@dataclass
+class OptimizerConfig:
+    type: Optional[str] = None
+    params: Dict[str, Any] = field(default_factory=dict)
+    legacy_fusion: bool = False
+
+    @staticmethod
+    def from_dict(d: Optional[Dict]) -> "OptimizerConfig":
+        if not d:
+            return OptimizerConfig()
+        return OptimizerConfig(
+            type=d.get(C.TYPE),
+            params=d.get(C.OPTIMIZER_PARAMS, {}) or {},
+            legacy_fusion=d.get(C.LEGACY_FUSION, False),
+        )
+
+
+@dataclass
+class SchedulerConfig:
+    type: Optional[str] = None
+    params: Dict[str, Any] = field(default_factory=dict)
+
+    @staticmethod
+    def from_dict(d: Optional[Dict]) -> "SchedulerConfig":
+        if not d:
+            return SchedulerConfig()
+        return SchedulerConfig(type=d.get(C.TYPE), params=d.get(C.SCHEDULER_PARAMS, {}) or {})
+
+
+class DeepSpeedConfig:
+    """Typed view over the JSON config (ref: deepspeed/runtime/config.py:791).
+
+    Parameters
+    ----------
+    config : str | dict
+        Path to a JSON file or an already-parsed dict.
+    world_size : int
+        Number of chips participating in data parallelism (used for
+        batch-size reconciliation). On TPU this is
+        ``mesh data-axis size x fsdp-axis size``.
+    """
+
+    def __init__(self, config: Union[str, Dict], world_size: int = 1):
+        if isinstance(config, str):
+            if not os.path.exists(config):
+                raise DeepSpeedConfigError(
+                    f"Expected a string path to an existing deepspeed config, "
+                    f"but received: {config}")
+            with open(config) as f:
+                self._param_dict = json.load(
+                    f, object_pairs_hook=dict_raise_error_on_duplicate_keys)
+        elif isinstance(config, dict):
+            self._param_dict = config
+        else:
+            raise DeepSpeedConfigError(
+                f"Expected a string path or dict, got {type(config)}")
+
+        self.world_size = world_size
+        self._initialize(self._param_dict)
+        self._configure_train_batch_size()
+        self._do_sanity_check()
+
+    # ------------------------------------------------------------------
+    def _initialize(self, pd: Dict):
+        self.train_batch_size = get_scalar_param(pd, C.TRAIN_BATCH_SIZE,
+                                                 C.TRAIN_BATCH_SIZE_DEFAULT)
+        self.train_micro_batch_size_per_gpu = get_scalar_param(
+            pd, C.TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+            C.TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT)
+        self.gradient_accumulation_steps = get_scalar_param(
+            pd, C.GRADIENT_ACCUMULATION_STEPS, C.GRADIENT_ACCUMULATION_STEPS_DEFAULT)
+
+        self.steps_per_print = get_scalar_param(pd, C.STEPS_PER_PRINT,
+                                                C.STEPS_PER_PRINT_DEFAULT)
+        self.dump_state = get_scalar_param(pd, C.DUMP_STATE, C.DUMP_STATE_DEFAULT)
+        self.gradient_clipping = get_scalar_param(pd, C.GRADIENT_CLIPPING,
+                                                  C.GRADIENT_CLIPPING_DEFAULT)
+        self.prescale_gradients = get_scalar_param(pd, C.PRESCALE_GRADIENTS,
+                                                   C.PRESCALE_GRADIENTS_DEFAULT)
+        self.gradient_predivide_factor = get_scalar_param(
+            pd, C.GRADIENT_PREDIVIDE_FACTOR, C.GRADIENT_PREDIVIDE_FACTOR_DEFAULT)
+        self.sparse_gradients_enabled = get_scalar_param(pd, C.SPARSE_GRADIENTS,
+                                                         C.SPARSE_GRADIENTS_DEFAULT)
+        self.wall_clock_breakdown = get_scalar_param(pd, C.WALL_CLOCK_BREAKDOWN,
+                                                     C.WALL_CLOCK_BREAKDOWN_DEFAULT)
+        self.memory_breakdown = get_scalar_param(pd, C.MEMORY_BREAKDOWN,
+                                                 C.MEMORY_BREAKDOWN_DEFAULT)
+        self.seed = get_scalar_param(pd, C.SEED, C.SEED_DEFAULT)
+
+        self.fp16 = FP16Config.from_dict(pd.get(C.FP16, {}))
+        bf16_dict = pd.get(C.BFLOAT16, pd.get(C.BFLOAT16_OLD, {}))
+        self.bf16 = BF16Config.from_dict(bf16_dict)
+        self.zero = ZeroConfig.from_dict(pd.get(C.ZERO_OPTIMIZATION))
+        self.mesh = MeshConfig.from_dict(pd.get(C.MESH))
+        self.activation_checkpointing = ActivationCheckpointingConfig.from_dict(
+            pd.get(C.ACTIVATION_CHECKPOINTING))
+        self.sparse_attention = SparseAttentionConfig.from_dict(pd.get(C.SPARSE_ATTENTION))
+        self.flops_profiler = FlopsProfilerConfig.from_dict(pd.get(C.FLOPS_PROFILER))
+        self.tensorboard = TensorboardConfig.from_dict(pd.get(C.TENSORBOARD))
+        self.pld = PLDConfig.from_dict(pd.get(C.PROGRESSIVE_LAYER_DROP))
+        self.curriculum = CurriculumConfig.from_dict(pd.get(C.CURRICULUM_LEARNING))
+        self.quantize_training = QuantizeTrainingConfig.from_dict(pd.get(C.QUANTIZE_TRAINING))
+        self.optimizer = OptimizerConfig.from_dict(pd.get(C.OPTIMIZER))
+        self.scheduler = SchedulerConfig.from_dict(pd.get(C.SCHEDULER))
+
+        self.checkpoint_tag_validation_mode = get_scalar_param(
+            pd.get(C.CHECKPOINT, {}) or {}, C.CHECKPOINT_TAG_VALIDATION,
+            C.CHECKPOINT_TAG_VALIDATION_DEFAULT).lower().capitalize()
+
+        self.elasticity_enabled = bool(
+            (pd.get(C.ELASTICITY) or {}).get(C.ELASTICITY_ENABLED,
+                                             C.ELASTICITY_ENABLED_DEFAULT))
+        self.elasticity_dict = pd.get(C.ELASTICITY) or {}
+        self.autotuning_enabled = bool(
+            (pd.get(C.AUTOTUNING) or {}).get(C.AUTOTUNING_ENABLED, False))
+        self.autotuning_dict = pd.get(C.AUTOTUNING) or {}
+
+        self.comm_backend_name = get_scalar_param(pd, C.COMM_BACKEND_NAME,
+                                                  C.COMM_BACKEND_NAME_DEFAULT)
+
+        dtypes = pd.get(C.DATA_TYPES, {}) or {}
+        self.grad_accum_dtype = dtypes.get(C.GRAD_ACCUM_DTYPE, C.GRAD_ACCUM_DTYPE_DEFAULT)
+
+    # ------------------------------------------------------------------
+    @property
+    def compute_dtype(self):
+        import jax.numpy as jnp
+        if self.fp16.enabled:
+            return jnp.float16
+        if self.bf16.enabled:
+            return jnp.bfloat16
+        return jnp.float32
+
+    @property
+    def precision_name(self) -> str:
+        if self.fp16.enabled:
+            return "fp16"
+        if self.bf16.enabled:
+            return "bf16"
+        return "fp32"
+
+    # ------------------------------------------------------------------
+    def _configure_train_batch_size(self):
+        """Reconcile train_batch = micro_batch * grad_acc * dp_world
+        (ref: deepspeed/runtime/config.py _configure_train_batch_size)."""
+        self._set_batch_related_parameters()
+        self._batch_assertion()
+
+    def _set_batch_related_parameters(self):
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+        ws = self.world_size
+
+        if all(x is not None for x in (train_batch, micro_batch, grad_acc)):
+            pass
+        elif train_batch is not None and micro_batch is not None:
+            grad_acc = train_batch // micro_batch
+            grad_acc //= ws
+            self.gradient_accumulation_steps = grad_acc
+        elif train_batch is not None and grad_acc is not None:
+            micro_batch = train_batch // ws
+            micro_batch //= grad_acc
+            self.train_micro_batch_size_per_gpu = micro_batch
+        elif train_batch is not None:
+            self.gradient_accumulation_steps = 1
+            self.train_micro_batch_size_per_gpu = train_batch // ws
+        elif micro_batch is not None:
+            if grad_acc is None:
+                self.gradient_accumulation_steps = 1
+            self.train_batch_size = (self.train_micro_batch_size_per_gpu *
+                                     self.gradient_accumulation_steps * ws)
+        else:
+            raise DeepSpeedConfigError(
+                "Either train_batch_size or train_micro_batch_size_per_gpu "
+                "needs to be provided")
+
+    def _batch_assertion(self):
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+        assert train_batch > 0, f"Train batch size: {train_batch} has to be greater than 0"
+        assert micro_batch > 0, f"Micro batch size per gpu: {micro_batch} has to be greater than 0"
+        assert grad_acc > 0, f"Gradient accumulation steps: {grad_acc} has to be greater than 0"
+        assert train_batch == micro_batch * grad_acc * self.world_size, (
+            f"Check batch related parameters. train_batch_size is not equal to "
+            f"micro_batch_per_gpu * gradient_acc_step * world_size "
+            f"{train_batch} != {micro_batch} * {grad_acc} * {self.world_size}")
+
+    # ------------------------------------------------------------------
+    def _do_sanity_check(self):
+        if self.fp16.enabled and self.bf16.enabled:
+            raise DeepSpeedConfigError("fp16 and bf16 modes cannot both be enabled")
+        if self.zero.stage >= 2 and self.fp16.enabled is False and self.bf16.enabled is False:
+            logger.warning("ZeRO with fp32 enabled — allowed, but mixed "
+                           "precision is recommended on TPU (bf16)")
+        if self.checkpoint_tag_validation_mode not in C.CHECKPOINT_TAG_VALIDATION_MODES:
+            raise DeepSpeedConfigError(
+                f"checkpoint_tag_validation mode "
+                f"{self.checkpoint_tag_validation_mode} invalid, must be one of "
+                f"{C.CHECKPOINT_TAG_VALIDATION_MODES}")
+
+    # ------------------------------------------------------------------
+    def print_config(self, name: str = "DeepSpeedTPUConfig"):
+        logger.info(f"{name}:")
+        logger.info(json.dumps(self._param_dict, indent=2, sort_keys=True, default=str))
+
+    @property
+    def param_dict(self) -> Dict:
+        return self._param_dict

@@ -1,0 +1,37 @@
+"""Config helpers (ref: deepspeed/runtime/config_utils.py)."""
+
+import json
+from typing import Any, Dict
+
+
+def get_scalar_param(param_dict: Dict, param_name: str, param_default_value: Any) -> Any:
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_list_param(param_dict: Dict, param_name: str, param_default_value: Any) -> Any:
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_dict_param(param_dict: Dict, param_name: str, param_default_value: Any) -> Any:
+    return param_dict.get(param_name, param_default_value)
+
+
+def dict_raise_error_on_duplicate_keys(ordered_pairs):
+    """Reject duplicate keys during JSON parsing."""
+    d = dict((k, v) for k, v in ordered_pairs)
+    if len(d) != len(ordered_pairs):
+        counter = {}
+        for k, _ in ordered_pairs:
+            counter[k] = counter.get(k, 0) + 1
+        keys = [k for k, v in counter.items() if v > 1]
+        raise ValueError("Duplicate keys in DeepSpeed config: {}".format(keys))
+    return d
+
+
+class ScientificNotationEncoder(json.JSONEncoder):
+    """Print big numbers in scientific notation (ref config_utils.py)."""
+
+    def iterencode(self, o, _one_shot=False):
+        if isinstance(o, float) and o >= 1e3:
+            return iter([f"{o:.1e}"])
+        return super().iterencode(o, _one_shot=_one_shot)

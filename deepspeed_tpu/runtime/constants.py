@@ -1,0 +1,304 @@
+"""Config keys and defaults.
+
+TPU-native analog of the reference's centralized key/default registry
+(ref: deepspeed/runtime/constants.py, deepspeed/runtime/zero/constants.py).
+Every JSON config key recognized by ``DeepSpeedConfig`` lives here.
+"""
+
+#############################################
+# Routes
+#############################################
+ROUTE_TRAIN = "train"
+ROUTE_EVAL = "eval"
+ROUTE_PREDICT = "predict"
+ROUTE_ENCODE = "encode"
+
+#############################################
+# Batch size
+#############################################
+TRAIN_BATCH_SIZE = "train_batch_size"
+TRAIN_BATCH_SIZE_DEFAULT = None
+
+TRAIN_MICRO_BATCH_SIZE_PER_GPU = "train_micro_batch_size_per_gpu"
+TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT = None
+GRADIENT_ACCUMULATION_STEPS = "gradient_accumulation_steps"
+GRADIENT_ACCUMULATION_STEPS_DEFAULT = None
+
+#############################################
+# Optimizer and lr scheduler
+#############################################
+OPTIMIZER = "optimizer"
+OPTIMIZER_TYPE_DEFAULT = None
+OPTIMIZER_PARAMS = "params"
+TYPE = "type"
+LEGACY_FUSION = "legacy_fusion"
+LEGACY_FUSION_DEFAULT = False
+SCHEDULER = "scheduler"
+SCHEDULER_TYPE_DEFAULT = None
+SCHEDULER_PARAMS = "params"
+MAX_GRAD_NORM = "max_grad_norm"
+
+ADAM_OPTIMIZER = "adam"
+ADAMW_OPTIMIZER = "adamw"
+FUSED_ADAM_OPTIMIZER = "fusedadam"
+CPU_ADAM_OPTIMIZER = "cpuadam"
+LAMB_OPTIMIZER = "lamb"
+FUSED_LAMB_OPTIMIZER = "fusedlamb"
+SGD_OPTIMIZER = "sgd"
+ADAGRAD_OPTIMIZER = "adagrad"
+ONEBIT_ADAM_OPTIMIZER = "onebitadam"
+ZERO_ONE_ADAM_OPTIMIZER = "zerooneadam"
+ONEBIT_LAMB_OPTIMIZER = "onebitlamb"
+DEEPSPEED_OPTIMIZERS = [
+    ADAM_OPTIMIZER, ADAMW_OPTIMIZER, FUSED_ADAM_OPTIMIZER, CPU_ADAM_OPTIMIZER,
+    LAMB_OPTIMIZER, FUSED_LAMB_OPTIMIZER, SGD_OPTIMIZER, ADAGRAD_OPTIMIZER,
+    ONEBIT_ADAM_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER,
+]
+
+#############################################
+# Precision (fp16 / bf16 / fp32)
+#############################################
+FP16 = "fp16"
+FP16_ENABLED = "enabled"
+FP16_ENABLED_DEFAULT = False
+FP16_LOSS_SCALE = "loss_scale"
+FP16_LOSS_SCALE_DEFAULT = 0  # 0 => dynamic
+FP16_INITIAL_SCALE_POWER = "initial_scale_power"
+FP16_INITIAL_SCALE_POWER_DEFAULT = 16
+FP16_LOSS_SCALE_WINDOW = "loss_scale_window"
+FP16_LOSS_SCALE_WINDOW_DEFAULT = 1000
+FP16_HYSTERESIS = "hysteresis"
+FP16_HYSTERESIS_DEFAULT = 2
+FP16_MIN_LOSS_SCALE = "min_loss_scale"
+FP16_MIN_LOSS_SCALE_DEFAULT = 1.0
+FP16_MASTER_WEIGHTS_AND_GRADS = "fp16_master_weights_and_grads"
+FP16_MASTER_WEIGHTS_AND_GRADS_DEFAULT = False
+
+BFLOAT16 = "bf16"
+BFLOAT16_OLD = "bfloat16"
+BFLOAT16_ENABLED = "enabled"
+BFLOAT16_ENABLED_DEFAULT = False
+
+PRECISION_DEFAULT = "fp32"
+
+#############################################
+# Gradient clipping / misc training knobs
+#############################################
+GRADIENT_CLIPPING = "gradient_clipping"
+GRADIENT_CLIPPING_DEFAULT = 0.0
+PRESCALE_GRADIENTS = "prescale_gradients"
+PRESCALE_GRADIENTS_DEFAULT = False
+GRADIENT_PREDIVIDE_FACTOR = "gradient_predivide_factor"
+GRADIENT_PREDIVIDE_FACTOR_DEFAULT = 1.0
+STEPS_PER_PRINT = "steps_per_print"
+STEPS_PER_PRINT_DEFAULT = 10
+WALL_CLOCK_BREAKDOWN = "wall_clock_breakdown"
+WALL_CLOCK_BREAKDOWN_DEFAULT = False
+MEMORY_BREAKDOWN = "memory_breakdown"
+MEMORY_BREAKDOWN_DEFAULT = False
+DUMP_STATE = "dump_state"
+DUMP_STATE_DEFAULT = False
+DISABLE_ALLGATHER = "disable_allgather"
+DISABLE_ALLGATHER_DEFAULT = False
+SPARSE_GRADIENTS = "sparse_gradients"
+SPARSE_GRADIENTS_DEFAULT = False
+
+SEED = "seed"
+SEED_DEFAULT = 1234
+
+#############################################
+# ZeRO (sharding) — ref deepspeed/runtime/zero/constants.py
+#############################################
+ZERO_OPTIMIZATION = "zero_optimization"
+ZERO_STAGE = "stage"
+ZERO_STAGE_DEFAULT = 0
+ZERO_ALLGATHER_PARTITIONS = "allgather_partitions"
+ZERO_ALLGATHER_BUCKET_SIZE = "allgather_bucket_size"
+ZERO_REDUCE_SCATTER = "reduce_scatter"
+ZERO_REDUCE_BUCKET_SIZE = "reduce_bucket_size"
+ZERO_OVERLAP_COMM = "overlap_comm"
+ZERO_CONTIGUOUS_GRADIENTS = "contiguous_gradients"
+ZERO_OFFLOAD_PARAM = "offload_param"
+ZERO_OFFLOAD_OPTIMIZER = "offload_optimizer"
+ZERO_STAGE3_MAX_LIVE_PARAMETERS = "stage3_max_live_parameters"
+ZERO_STAGE3_MAX_REUSE_DISTANCE = "stage3_max_reuse_distance"
+ZERO_STAGE3_PREFETCH_BUCKET_SIZE = "stage3_prefetch_bucket_size"
+ZERO_STAGE3_PARAM_PERSISTENCE_THRESHOLD = "stage3_param_persistence_threshold"
+ZERO_STAGE3_GATHER_16BIT_WEIGHTS_ON_MODEL_SAVE = "stage3_gather_16bit_weights_on_model_save"
+ZERO_ROUND_ROBIN_GRADIENTS = "round_robin_gradients"
+ZERO_ELASTIC_CHECKPOINT = "elastic_checkpoint"
+
+OFFLOAD_DEVICE = "device"
+OFFLOAD_DEVICE_NONE = "none"
+OFFLOAD_DEVICE_CPU = "cpu"
+OFFLOAD_DEVICE_NVME = "nvme"
+OFFLOAD_NVME_PATH = "nvme_path"
+OFFLOAD_BUFFER_COUNT = "buffer_count"
+OFFLOAD_BUFFER_SIZE = "buffer_size"
+OFFLOAD_PIN_MEMORY = "pin_memory"
+OFFLOAD_PIPELINE_READ = "pipeline_read"
+OFFLOAD_PIPELINE_WRITE = "pipeline_write"
+OFFLOAD_MAX_IN_CPU = "max_in_cpu"
+
+#############################################
+# Parallel topology (TPU-native: one mesh with named axes)
+#############################################
+MESH = "mesh"
+MESH_DATA = "data"               # pure data parallel axis
+MESH_FSDP = "fsdp"               # ZeRO-3 parameter-sharding axis
+MESH_MODEL = "model"             # tensor parallel axis
+MESH_PIPE = "pipe"               # pipeline stage axis
+MESH_EXPERT = "expert"           # expert parallel axis
+MESH_SEQUENCE = "sequence"       # sequence/context parallel axis
+
+TENSOR_PARALLEL_SIZE = "tensor_parallel_size"
+TENSOR_PARALLEL_SIZE_DEFAULT = 1
+PIPELINE_PARALLEL_SIZE = "pipeline_parallel_size"
+PIPELINE_PARALLEL_SIZE_DEFAULT = 1
+SEQUENCE_PARALLEL_SIZE = "sequence_parallel_size"
+SEQUENCE_PARALLEL_SIZE_DEFAULT = 1
+EXPERT_PARALLEL_SIZE = "expert_parallel_size"
+EXPERT_PARALLEL_SIZE_DEFAULT = 1
+
+#############################################
+# Pipeline engine
+#############################################
+PIPELINE = "pipeline"
+PIPELINE_STAGES = "stages"
+PIPELINE_PARTITION = "partition"
+PIPELINE_PARTITION_DEFAULT = "parameters"
+PIPELINE_SEED_LAYERS = "seed_layers"
+PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL = "activation_checkpoint_interval"
+
+#############################################
+# Activation checkpointing (ref runtime/activation_checkpointing/config)
+#############################################
+ACTIVATION_CHECKPOINTING = "activation_checkpointing"
+ACT_CKPT_PARTITION_ACTIVATIONS = "partition_activations"
+ACT_CKPT_NUMBER_CHECKPOINTS = "number_checkpoints"
+ACT_CKPT_CONTIGUOUS_MEMORY_OPTIMIZATION = "contiguous_memory_optimization"
+ACT_CKPT_SYNCHRONIZE_CHECKPOINT_BOUNDARY = "synchronize_checkpoint_boundary"
+ACT_CKPT_CPU_CHECKPOINTING = "cpu_checkpointing"
+ACT_CKPT_PROFILE = "profile"
+
+#############################################
+# Sparse / flash / ring attention
+#############################################
+SPARSE_ATTENTION = "sparse_attention"
+SPARSE_MODE = "mode"
+SPARSE_DENSE_MODE = "dense"
+SPARSE_FIXED_MODE = "fixed"
+SPARSE_VARIABLE_MODE = "variable"
+SPARSE_BIGBIRD_MODE = "bigbird"
+SPARSE_BSLONGFORMER_MODE = "bslongformer"
+SPARSE_BLOCK = "block"
+SPARSE_BLOCK_DEFAULT = 16
+SPARSE_DIFFERENT_LAYOUT_PER_HEAD = "different_layout_per_head"
+SPARSE_NUM_LOCAL_BLOCKS = "num_local_blocks"
+SPARSE_NUM_GLOBAL_BLOCKS = "num_global_blocks"
+SPARSE_ATTENTION_TYPE = "attention"
+SPARSE_HORIZONTAL_GLOBAL_ATTENTION = "horizontal_global_attention"
+SPARSE_NUM_DIFFERENT_GLOBAL_PATTERNS = "num_different_global_patterns"
+SPARSE_NUM_RANDOM_BLOCKS = "num_random_blocks"
+SPARSE_LOCAL_WINDOW_BLOCKS = "local_window_blocks"
+SPARSE_GLOBAL_BLOCK_INDICES = "global_block_indices"
+SPARSE_GLOBAL_BLOCK_END_INDICES = "global_block_end_indices"
+SPARSE_NUM_SLIDING_WINDOW_BLOCKS = "num_sliding_window_blocks"
+
+#############################################
+# Curriculum learning (ref runtime/data_pipeline)
+#############################################
+CURRICULUM_LEARNING = "curriculum_learning"
+CURRICULUM_ENABLED = "enabled"
+CURRICULUM_ENABLED_DEFAULT = False
+
+#############################################
+# Progressive layer drop
+#############################################
+PROGRESSIVE_LAYER_DROP = "progressive_layer_drop"
+PLD_ENABLED = "enabled"
+PLD_ENABLED_DEFAULT = False
+PLD_THETA = "theta"
+PLD_THETA_DEFAULT = 1.0
+PLD_GAMMA = "gamma"
+PLD_GAMMA_DEFAULT = 0.001
+
+#############################################
+# Tensorboard / monitoring
+#############################################
+TENSORBOARD = "tensorboard"
+TENSORBOARD_ENABLED = "enabled"
+TENSORBOARD_ENABLED_DEFAULT = False
+TENSORBOARD_OUTPUT_PATH = "output_path"
+TENSORBOARD_OUTPUT_PATH_DEFAULT = ""
+TENSORBOARD_JOB_NAME = "job_name"
+TENSORBOARD_JOB_NAME_DEFAULT = "DeepSpeedTPUJobName"
+
+#############################################
+# Flops profiler
+#############################################
+FLOPS_PROFILER = "flops_profiler"
+FLOPS_PROFILER_ENABLED = "enabled"
+FLOPS_PROFILER_ENABLED_DEFAULT = False
+FLOPS_PROFILER_PROFILE_STEP = "profile_step"
+FLOPS_PROFILER_PROFILE_STEP_DEFAULT = 1
+FLOPS_PROFILER_MODULE_DEPTH = "module_depth"
+FLOPS_PROFILER_MODULE_DEPTH_DEFAULT = -1
+FLOPS_PROFILER_TOP_MODULES = "top_modules"
+FLOPS_PROFILER_TOP_MODULES_DEFAULT = 1
+FLOPS_PROFILER_DETAILED = "detailed"
+FLOPS_PROFILER_DETAILED_DEFAULT = True
+FLOPS_PROFILER_OUTPUT_FILE = "output_file"
+FLOPS_PROFILER_OUTPUT_FILE_DEFAULT = None
+
+#############################################
+# Autotuning
+#############################################
+AUTOTUNING = "autotuning"
+AUTOTUNING_ENABLED = "enabled"
+AUTOTUNING_ENABLED_DEFAULT = False
+
+#############################################
+# Elasticity (ref elasticity/constants.py)
+#############################################
+ELASTICITY = "elasticity"
+ELASTICITY_ENABLED = "enabled"
+ELASTICITY_ENABLED_DEFAULT = False
+MAX_ACCELERATORS = "max_train_batch_size"
+MICRO_BATCHES = "micro_batch_sizes"
+MIN_ACCELERATORS = "min_gpus"
+MAX_ACCELERATORS_KEY = "max_gpus"
+MIN_TIME = "min_time"
+VERSION = "version"
+IGNORE_NON_ELASTIC_BATCH_INFO = "ignore_non_elastic_batch_info"
+PREFER_LARGER_BATCH = "prefer_larger_batch"
+
+#############################################
+# Checkpoint
+#############################################
+CHECKPOINT = "checkpoint"
+CHECKPOINT_TAG_VALIDATION = "checkpoint_tag_validation"
+CHECKPOINT_TAG_VALIDATION_DEFAULT = "Warn"
+CHECKPOINT_TAG_VALIDATION_MODES = ["Warn", "Ignore", "Fail"]
+LOAD_UNIVERSAL_CHECKPOINT = "load_universal"
+
+#############################################
+# Quantization / MoQ (ref runtime/quantize.py config keys)
+#############################################
+QUANTIZE_TRAINING = "quantize_training"
+QUANTIZE_TRAINING_ENABLED = "enabled"
+QUANTIZE_TRAINING_ENABLED_DEFAULT = False
+
+#############################################
+# Communication compression (1-bit family)
+#############################################
+COMPRESSED_COMM = "compressed_communication"
+COMM_BACKEND_NAME = "comm_backend_name"
+COMM_BACKEND_NAME_DEFAULT = "ici"  # "ici" (XLA collectives) or "dcn_compressed"
+
+#############################################
+# Data types
+#############################################
+DATA_TYPES = "data_types"
+GRAD_ACCUM_DTYPE = "grad_accum_dtype"
+GRAD_ACCUM_DTYPE_DEFAULT = None

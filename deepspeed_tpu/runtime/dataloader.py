@@ -1,0 +1,69 @@
+"""Data loading helpers.
+
+TPU-native analog of deepspeed/runtime/dataloader.py (DeepSpeedDataLoader +
+RepeatingLoader). There is no torch DataLoader/DistributedSampler here: in
+single-controller JAX every process feeds the GLOBAL batch (sharded arrays),
+so the loader yields numpy batches of the full train_batch_size; the engine's
+input sharding scatters them over the mesh.
+"""
+
+from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
+
+import numpy as np
+
+
+class DeepSpeedDataLoader:
+    """Batches an indexable dataset of pytrees into stacked numpy arrays."""
+
+    def __init__(self, dataset: Sequence, batch_size: int,
+                 collate_fn: Optional[Callable] = None,
+                 shuffle: bool = True, seed: int = 0, drop_last: bool = True):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn or _default_collate
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self._rng = np.random.default_rng(seed)
+        self.len = len(dataset) // batch_size if drop_last else \
+            (len(dataset) + batch_size - 1) // batch_size
+
+    def __len__(self):
+        return self.len
+
+    def __iter__(self) -> Iterator[Any]:
+        order = np.arange(len(self.dataset))
+        if self.shuffle:
+            self._rng.shuffle(order)
+        for i in range(self.len):
+            idx = order[i * self.batch_size:(i + 1) * self.batch_size]
+            yield self.collate_fn([self.dataset[int(j)] for j in idx])
+
+
+def _default_collate(items):
+    first = items[0]
+    if isinstance(first, dict):
+        return {k: np.stack([it[k] for it in items]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(np.stack([it[i] for it in items])
+                           for i in range(len(first)))
+    return np.stack(items)
+
+
+class RepeatingLoader:
+    """Wraps an iterator to restart on StopIteration
+    (ref: dataloader.py RepeatingLoader)."""
+
+    def __init__(self, loader: Iterable):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            batch = next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            batch = next(self.data_iter)
+        return batch

@@ -1,0 +1,90 @@
+"""Static and dynamic loss scaling, jit-compatible.
+
+TPU-native equivalent of the reference scalers
+(ref: deepspeed/runtime/fp16/loss_scaler.py:56 LossScaler, :79
+DynamicLossScaler). The reference mutates Python state per step; here the
+scaler state is a small pytree threaded through the jitted train step so
+overflow detection + scale adjustment + step-skip all compile into the one
+XLA program (no host sync on the hot path).
+"""
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+INITIAL_LOSS_SCALE = "init_scale"
+SCALE_WINDOW = "scale_window"
+DELAYED_SHIFT = "delayed_shift"
+MIN_LOSS_SCALE = "min_scale"
+
+
+class LossScaleState(NamedTuple):
+    loss_scale: jnp.ndarray          # f32 scalar
+    good_steps: jnp.ndarray          # i32: consecutive non-overflow steps
+    hysteresis: jnp.ndarray          # i32: remaining tolerated overflows
+    overflow: jnp.ndarray            # bool: last step overflowed
+
+
+def init_state(static_scale: float = 0.0,
+               initial_scale_power: int = 16,
+               hysteresis: int = 2) -> LossScaleState:
+    scale = static_scale if static_scale > 0 else 2.0 ** initial_scale_power
+    return LossScaleState(
+        loss_scale=jnp.asarray(scale, jnp.float32),
+        good_steps=jnp.asarray(0, jnp.int32),
+        hysteresis=jnp.asarray(hysteresis, jnp.int32),
+        overflow=jnp.asarray(False, jnp.bool_),
+    )
+
+
+def has_overflow(grads: Any) -> jnp.ndarray:
+    """Global inf/nan check over a grad pytree (ref: loss_scaler.py:29
+    CheckOverflow / stage_1_and_2.py:1799 has_overflow_serial). On TPU this
+    is a single fused reduction, no host round-trip."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    if not leaves:
+        return jnp.asarray(False)
+    flags = [jnp.logical_not(jnp.all(jnp.isfinite(l.astype(jnp.float32)))) for l in leaves]
+    return jnp.any(jnp.stack(flags))
+
+
+def update(state: LossScaleState, overflow: jnp.ndarray, *,
+           dynamic: bool, scale_window: int = 1000, scale_factor: float = 2.0,
+           min_scale: float = 1.0, max_hysteresis: int = 2) -> LossScaleState:
+    """Post-step scale adjustment (ref: DynamicLossScaler.update_scale
+    loss_scaler.py:130). Pure function of (state, overflow)."""
+    if not dynamic:
+        return state._replace(overflow=overflow,
+                              good_steps=state.good_steps + 1)
+
+    def on_overflow(s: LossScaleState) -> LossScaleState:
+        hys = s.hysteresis - 1
+        new_scale = jnp.where(
+            hys <= 0,
+            jnp.maximum(s.loss_scale / scale_factor, min_scale),
+            s.loss_scale)
+        return LossScaleState(loss_scale=new_scale,
+                              good_steps=jnp.asarray(0, jnp.int32),
+                              hysteresis=jnp.maximum(hys, 0),
+                              overflow=jnp.asarray(True, jnp.bool_))
+
+    def on_good(s: LossScaleState) -> LossScaleState:
+        good = s.good_steps + 1
+        grow = good % scale_window == 0
+        new_scale = jnp.where(grow, s.loss_scale * scale_factor, s.loss_scale)
+        return LossScaleState(loss_scale=new_scale,
+                              good_steps=good,
+                              hysteresis=jnp.asarray(max_hysteresis, jnp.int32),
+                              overflow=jnp.asarray(False, jnp.bool_))
+
+    return jax.lax.cond(overflow, on_overflow, on_good, state)
+
+
+def scale_loss(loss: jnp.ndarray, state: LossScaleState) -> jnp.ndarray:
+    return loss * state.loss_scale.astype(loss.dtype)
+
+
+def unscale_grads(grads: Any, state: LossScaleState) -> Any:
+    inv = 1.0 / state.loss_scale
+    return jax.tree_util.tree_map(lambda g: (g.astype(jnp.float32) * inv), grads)

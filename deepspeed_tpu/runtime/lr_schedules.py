@@ -1,0 +1,206 @@
+"""Learning-rate schedules.
+
+TPU-native equivalents of the reference schedules
+(ref: deepspeed/runtime/lr_schedules.py — LRRangeTest :310, OneCycle :417,
+WarmupLR :706, WarmupDecayLR :802). Implemented as pure ``step -> lr``
+functions (optax-style schedules) so they trace cleanly inside a jitted
+train step; a thin stateful wrapper provides the reference's
+``step()/get_lr()/state_dict()`` object API.
+"""
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+import jax.numpy as jnp
+
+LR_SCHEDULE = "lr_schedule"
+LR_RANGE_TEST = "LRRangeTest"
+ONE_CYCLE = "OneCycle"
+WARMUP_LR = "WarmupLR"
+WARMUP_DECAY_LR = "WarmupDecayLR"
+VALID_LR_SCHEDULES = [LR_RANGE_TEST, ONE_CYCLE, WARMUP_LR, WARMUP_DECAY_LR]
+
+# config keys (ref: lr_schedules.py:29-78)
+LR_RANGE_TEST_MIN_LR = "lr_range_test_min_lr"
+LR_RANGE_TEST_STEP_RATE = "lr_range_test_step_rate"
+LR_RANGE_TEST_STEP_SIZE = "lr_range_test_step_size"
+LR_RANGE_TEST_STAIRCASE = "lr_range_test_staircase"
+
+WARMUP_MIN_LR = "warmup_min_lr"
+WARMUP_MAX_LR = "warmup_max_lr"
+WARMUP_NUM_STEPS = "warmup_num_steps"
+WARMUP_TYPE = "warmup_type"
+WARMUP_LOG_RATE = "log"
+WARMUP_LINEAR_RATE = "linear"
+TOTAL_NUM_STEPS = "total_num_steps"
+
+CYCLE_MIN_LR = "cycle_min_lr"
+CYCLE_MAX_LR = "cycle_max_lr"
+CYCLE_FIRST_STEP_SIZE = "cycle_first_step_size"
+CYCLE_SECOND_STEP_SIZE = "cycle_second_step_size"
+CYCLE_FIRST_STAIR_COUNT = "cycle_first_stair_count"
+CYCLE_SECOND_STAIR_COUNT = "cycle_second_stair_count"
+DECAY_STEP_SIZE = "decay_step_size"
+DECAY_LR_RATE = "decay_lr_rate"
+CYCLE_MIN_MOM = "cycle_min_mom"
+CYCLE_MAX_MOM = "cycle_max_mom"
+DECAY_MOM_RATE = "decay_mom_rate"
+
+Schedule = Callable[[Any], Any]  # step -> lr
+
+
+def lr_range_test(min_lr: float = 1e-3, step_rate: float = 1.0,
+                  step_size: int = 2000, staircase: bool = False) -> Schedule:
+    """LR range test: lr grows (continuously or staircase) with step
+    (ref: lr_schedules.py:310 LRRangeTest)."""
+
+    def schedule(step):
+        interval = step / step_size
+        if staircase:
+            interval = jnp.floor(interval)
+        return min_lr * (1.0 + interval * step_rate)
+
+    return schedule
+
+
+def warmup_lr(warmup_min_lr: float = 0.0, warmup_max_lr: float = 0.001,
+              warmup_num_steps: int = 1000,
+              warmup_type: str = WARMUP_LOG_RATE) -> Schedule:
+    """Warmup then constant (ref: lr_schedules.py:706 WarmupLR)."""
+    warmup_num_steps = max(2, warmup_num_steps)
+    delta = warmup_max_lr - warmup_min_lr
+    inverse_log_warm_up = 1.0 / math.log(warmup_num_steps)
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        if warmup_type == WARMUP_LOG_RATE:
+            gamma = inverse_log_warm_up * jnp.log(jnp.maximum(step, 1.0) + 1.0)
+        else:
+            gamma = step / warmup_num_steps
+        gamma = jnp.minimum(gamma, 1.0)
+        return warmup_min_lr + delta * gamma
+
+    return schedule
+
+
+def warmup_decay_lr(total_num_steps: int, warmup_min_lr: float = 0.0,
+                    warmup_max_lr: float = 0.001, warmup_num_steps: int = 1000,
+                    warmup_type: str = WARMUP_LOG_RATE) -> Schedule:
+    """Warmup then linear decay to zero (ref: lr_schedules.py:802)."""
+    base = warmup_lr(warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type)
+    warmup_num_steps_c = max(2, warmup_num_steps)
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = base(step)
+        decay = jnp.maximum(
+            0.0, (total_num_steps - step) /
+            jnp.maximum(1.0, float(total_num_steps - warmup_num_steps_c)))
+        return jnp.where(step < warmup_num_steps_c, warm, warmup_max_lr * decay)
+
+    return schedule
+
+
+def one_cycle(cycle_min_lr: float, cycle_max_lr: float,
+              cycle_first_step_size: int = 2000,
+              cycle_second_step_size: Optional[int] = None,
+              cycle_first_stair_count: int = 0,
+              cycle_second_stair_count: Optional[int] = None,
+              decay_step_size: int = 0,
+              decay_lr_rate: float = 0.0) -> Schedule:
+    """1-cycle policy: min->max over first leg, max->min over second, then
+    decay (ref: lr_schedules.py:417 OneCycle)."""
+    first = float(cycle_first_step_size)
+    second = float(cycle_second_step_size
+                   if cycle_second_step_size is not None else cycle_first_step_size)
+    total_cycle = first + second
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+
+        up_frac = jnp.clip(step / first, 0.0, 1.0)
+        down_frac = jnp.clip((step - first) / second, 0.0, 1.0)
+        in_decay = step > total_cycle
+
+        lr_up = cycle_min_lr + (cycle_max_lr - cycle_min_lr) * up_frac
+        lr_down = cycle_max_lr - (cycle_max_lr - cycle_min_lr) * down_frac
+        lr_cycle = jnp.where(step <= first, lr_up, lr_down)
+
+        if decay_step_size > 0 and decay_lr_rate > 0:
+            decay_steps = jnp.floor((step - total_cycle) / decay_step_size)
+            lr_decay = cycle_min_lr / (1.0 + decay_lr_rate * jnp.maximum(decay_steps, 0.0))
+        else:
+            lr_decay = jnp.full_like(lr_cycle, cycle_min_lr)
+        return jnp.where(in_decay, lr_decay, lr_cycle)
+
+    return schedule
+
+
+def constant_lr(lr: float) -> Schedule:
+    def schedule(step):
+        del step
+        return jnp.asarray(lr, jnp.float32)
+
+    return schedule
+
+
+def get_lr_schedule(name: Optional[str], params: Dict[str, Any],
+                    base_lr: float = 1e-3) -> Schedule:
+    """name+params (the JSON `scheduler` section) -> schedule fn
+    (ref: deepspeed/runtime/lr_schedules.py add_tuning_arguments dispatch)."""
+    if name is None:
+        return constant_lr(base_lr)
+    if name == LR_RANGE_TEST:
+        return lr_range_test(
+            min_lr=params.get(LR_RANGE_TEST_MIN_LR, 1e-3),
+            step_rate=params.get(LR_RANGE_TEST_STEP_RATE, 1.0),
+            step_size=params.get(LR_RANGE_TEST_STEP_SIZE, 2000),
+            staircase=params.get(LR_RANGE_TEST_STAIRCASE, False))
+    if name == WARMUP_LR:
+        return warmup_lr(
+            warmup_min_lr=params.get(WARMUP_MIN_LR, 0.0),
+            warmup_max_lr=params.get(WARMUP_MAX_LR, base_lr),
+            warmup_num_steps=params.get(WARMUP_NUM_STEPS, 1000),
+            warmup_type=params.get(WARMUP_TYPE, WARMUP_LOG_RATE))
+    if name == WARMUP_DECAY_LR:
+        return warmup_decay_lr(
+            total_num_steps=params[TOTAL_NUM_STEPS],
+            warmup_min_lr=params.get(WARMUP_MIN_LR, 0.0),
+            warmup_max_lr=params.get(WARMUP_MAX_LR, base_lr),
+            warmup_num_steps=params.get(WARMUP_NUM_STEPS, 1000),
+            warmup_type=params.get(WARMUP_TYPE, WARMUP_LOG_RATE))
+    if name == ONE_CYCLE:
+        return one_cycle(
+            cycle_min_lr=params[CYCLE_MIN_LR],
+            cycle_max_lr=params[CYCLE_MAX_LR],
+            cycle_first_step_size=params.get(CYCLE_FIRST_STEP_SIZE, 2000),
+            cycle_second_step_size=params.get(CYCLE_SECOND_STEP_SIZE),
+            decay_step_size=params.get(DECAY_STEP_SIZE, 0),
+            decay_lr_rate=params.get(DECAY_LR_RATE, 0.0))
+    raise ValueError(f"unknown lr schedule {name}; valid: {VALID_LR_SCHEDULES}")
+
+
+class LRScheduler:
+    """Stateful wrapper with the reference object API
+    (step/get_lr/state_dict/load_state_dict)."""
+
+    def __init__(self, schedule: Schedule, last_batch_iteration: int = -1):
+        self.schedule = schedule
+        self.last_batch_iteration = last_batch_iteration
+
+    def step(self, last_batch_iteration: Optional[int] = None):
+        if last_batch_iteration is None:
+            last_batch_iteration = self.last_batch_iteration + 1
+        self.last_batch_iteration = last_batch_iteration
+
+    def get_lr(self):
+        return [float(self.schedule(max(0, self.last_batch_iteration)))]
+
+    def get_last_lr(self):
+        return self.get_lr()
+
+    def state_dict(self):
+        return {"last_batch_iteration": self.last_batch_iteration}
+
+    def load_state_dict(self, sd):
+        self.last_batch_iteration = sd["last_batch_iteration"]

@@ -1,0 +1,85 @@
+"""Runtime numeric utilities: global norm, clipping, memory reporting.
+
+TPU-native equivalent of deepspeed/runtime/utils.py (clip_grad_norm_,
+get_global_norm, see_memory_usage, CheckOverflow). Model-parallel-aware
+norm reduction is unnecessary here: when grads are sharded over mesh axes,
+``jnp`` reductions under jit produce globally-correct norms because XLA
+inserts the cross-device psum automatically.
+"""
+
+import gc
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.logging import logger
+
+try:
+    import psutil
+    PSUTIL = True
+except ImportError:  # pragma: no cover
+    PSUTIL = False
+
+PyTree = Any
+
+
+def global_norm(tree: PyTree) -> jnp.ndarray:
+    """L2 norm over an entire pytree (ref: runtime/utils.py get_global_norm)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.asarray(0.0, jnp.float32)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves))
+
+
+def clip_by_global_norm(tree: PyTree, max_norm: float,
+                        norm: Optional[jnp.ndarray] = None) -> PyTree:
+    """Scale the whole tree so its global norm is <= max_norm
+    (ref: runtime/utils.py clip_grad_norm_)."""
+    if norm is None:
+        norm = global_norm(tree)
+    scale = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree_util.tree_map(lambda l: (l * scale).astype(l.dtype), tree)
+
+
+def count_parameters(tree: PyTree) -> int:
+    return sum(int(jnp.size(l)) for l in jax.tree_util.tree_leaves(tree))
+
+
+def tree_bytes(tree: PyTree) -> int:
+    return sum(l.size * l.dtype.itemsize for l in jax.tree_util.tree_leaves(tree)
+               if hasattr(l, "dtype"))
+
+
+def see_memory_usage(message: str, force: bool = False):
+    """Host + device memory snapshot (ref: runtime/utils.py see_memory_usage)."""
+    if not force:
+        return
+    gc.collect()
+    parts = [message]
+    try:
+        for d in jax.local_devices():
+            stats = d.memory_stats()
+            if stats:
+                used = stats.get("bytes_in_use", 0) / 2**30
+                limit = stats.get("bytes_limit", 0) / 2**30
+                parts.append(f"{d}: {used:.2f}/{limit:.2f} GB")
+    except Exception:
+        pass
+    if PSUTIL:
+        vm = psutil.virtual_memory()
+        parts.append(f"host used={vm.used / 2**30:.2f}GB ({vm.percent}%)")
+    logger.info(" | ".join(parts))
+
+
+def call_to_str(base: str, *args, **kwargs) -> str:
+    """Pretty-print a call (ref: runtime/utils.py call_to_str)."""
+    name = f"{base}("
+    if args:
+        name += ", ".join(repr(arg) for arg in args)
+        if kwargs:
+            name += ", "
+    if kwargs:
+        name += ", ".join(f"{key}={repr(arg)}" for key, arg in kwargs.items())
+    name += ")"
+    return name

@@ -1,0 +1,82 @@
+"""Rank-aware logging.
+
+TPU-native equivalent of the reference's logger factory and rank-filtered
+helpers (ref: deepspeed/utils/logging.py:16 LoggerFactory, :49 log_dist,
+:72 print_json_dist). On TPU there are no torch.distributed ranks; we use
+``jax.process_index()`` when the distributed runtime is initialized and fall
+back to rank 0 in single-process mode.
+"""
+
+import json
+import logging
+import os
+import sys
+from typing import List, Optional
+
+log_levels = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+class LoggerFactory:
+    @staticmethod
+    def create_logger(name: str = "deepspeed_tpu", level=logging.INFO) -> logging.Logger:
+        if name is None:
+            raise ValueError("name for logger cannot be None")
+        formatter = logging.Formatter(
+            "[%(asctime)s] [%(levelname)s] [%(name)s:%(lineno)d:%(funcName)s] %(message)s")
+        logger_ = logging.getLogger(name)
+        logger_.setLevel(level)
+        logger_.propagate = False
+        if not logger_.handlers:
+            ch = logging.StreamHandler(stream=sys.stdout)
+            ch.setLevel(level)
+            ch.setFormatter(formatter)
+            logger_.addHandler(ch)
+        return logger_
+
+
+logger = LoggerFactory.create_logger(
+    level=log_levels.get(os.environ.get("DSTPU_LOG_LEVEL", "info"), logging.INFO))
+
+
+def _get_rank() -> int:
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return int(os.environ.get("DSTPU_PROCESS_ID", "0"))
+
+
+def log_dist(message: str, ranks: Optional[List[int]] = None, level=logging.INFO) -> None:
+    """Log ``message`` only on the given process ranks (-1 or None = all)."""
+    rank = _get_rank()
+    if ranks is None or len(ranks) == 0 or -1 in ranks or rank in ranks:
+        logger.log(level, f"[Rank {rank}] {message}")
+
+
+def print_json_dist(message: dict, ranks: Optional[List[int]] = None,
+                    path: Optional[str] = None) -> None:
+    """Dump a json payload on the given ranks, optionally to a file."""
+    rank = _get_rank()
+    if ranks is None or len(ranks) == 0 or -1 in ranks or rank in ranks:
+        message["rank"] = rank
+        if path is None:
+            print(json.dumps(message))
+        else:
+            with open(path, "w") as f:
+                json.dump(message, f)
+                f.flush()
+
+
+def should_log_le(max_log_level_str: str) -> bool:
+    if not isinstance(max_log_level_str, str):
+        raise ValueError("max_log_level_str must be a string")
+    max_log_level_str = max_log_level_str.lower()
+    if max_log_level_str not in log_levels:
+        raise ValueError(f"{max_log_level_str} is not one of the logging levels")
+    return logger.getEffectiveLevel() <= log_levels[max_log_level_str]

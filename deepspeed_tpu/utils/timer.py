@@ -1,0 +1,227 @@
+"""Wall-clock and throughput timers.
+
+TPU-native equivalent of the reference's cuda-event timers
+(ref: deepspeed/utils/timer.py:34 SynchronizedWallClockTimer,
+:134 ThroughputTimer). CUDA events do not exist on TPU; synchronization is a
+``jax.block_until_ready`` / ``jax.effects_barrier`` on the device stream, and
+otherwise identical trim-mean throughput accounting is kept.
+"""
+
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import log_dist
+
+try:
+    import psutil
+    PSUTIL_AVAILABLE = True
+except ImportError:  # pragma: no cover
+    PSUTIL_AVAILABLE = False
+
+FORWARD_MICRO_TIMER = "fwd_microstep"
+FORWARD_GLOBAL_TIMER = "fwd"
+BACKWARD_MICRO_TIMER = "bwd_microstep"
+BACKWARD_GLOBAL_TIMER = "bwd"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+TRAIN_BATCH_TIMER = "train_batch"
+
+
+def _device_sync():
+    """Block until all dispatched device work is complete."""
+    try:
+        import jax
+        jax.effects_barrier()
+        # touch a trivial computation to flush the async dispatch queue
+        jax.device_put(0.0).block_until_ready()
+    except Exception:
+        pass
+
+
+class SynchronizedWallClockTimer:
+    """Group of named timers with optional device synchronization."""
+
+    class Timer:
+        def __init__(self, name: str):
+            self.name_ = name
+            self.started_ = False
+            self.start_time = 0.0
+            self.elapsed_records: List[float] = []
+
+        def start(self, sync: bool = False):
+            assert not self.started_, f"{self.name_} timer has already been started"
+            if sync:
+                _device_sync()
+            self.start_time = time.time()
+            self.started_ = True
+
+        def stop(self, reset: bool = False, record: bool = True, sync: bool = False):
+            assert self.started_, f"{self.name_} timer is not started"
+            if sync:
+                _device_sync()
+            elapsed = time.time() - self.start_time
+            if record:
+                self.elapsed_records.append(elapsed)
+            self.started_ = False
+
+        def reset(self):
+            self.started_ = False
+            self.elapsed_records = []
+
+        def elapsed(self, reset: bool = True) -> float:
+            """Total elapsed seconds recorded so far."""
+            total = sum(self.elapsed_records)
+            if self.started_:
+                total += time.time() - self.start_time
+            if reset:
+                self.reset()
+            return total
+
+        def mean(self) -> float:
+            if not self.elapsed_records:
+                return 0.0
+            return sum(self.elapsed_records) / len(self.elapsed_records)
+
+    def __init__(self):
+        self.timers: "OrderedDict[str, SynchronizedWallClockTimer.Timer]" = OrderedDict()
+
+    def __call__(self, name: str) -> "SynchronizedWallClockTimer.Timer":
+        if name not in self.timers:
+            self.timers[name] = self.Timer(name)
+        return self.timers[name]
+
+    def get_timers(self):
+        return self.timers
+
+    @staticmethod
+    def memory_usage() -> str:
+        if not PSUTIL_AVAILABLE:
+            return "psutil unavailable"
+        vm = psutil.virtual_memory()
+        return (f"host mem: used={vm.used / 2**30:.2f}GB "
+                f"avail={vm.available / 2**30:.2f}GB ({vm.percent}%)")
+
+    def log(self, names: List[str], normalizer: float = 1.0, reset: bool = True,
+            memory_breakdown: bool = False, ranks: Optional[List[int]] = None):
+        assert normalizer > 0.0
+        string = "time (ms)"
+        for name in names:
+            if name in self.timers:
+                elapsed_time = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                string += f" | {name}: {elapsed_time:.2f}"
+        if memory_breakdown:
+            string += f" | {self.memory_usage()}"
+        log_dist(string, ranks=ranks or [0])
+
+    def means(self, names: List[str]) -> Dict[str, float]:
+        return {n: self.timers[n].mean() for n in names if n in self.timers}
+
+
+class NoopTimer:
+    """Disabled-timer stand-in so call sites need no branching."""
+
+    class Timer:
+        def start(self, **kw):
+            ...
+
+        def stop(self, **kw):
+            ...
+
+        def reset(self):
+            ...
+
+        def elapsed(self, **kw):
+            return 0.0
+
+        def mean(self):
+            return 0.0
+
+    def __call__(self, name):
+        return self.Timer()
+
+    def get_timers(self):
+        return {}
+
+    def log(self, *a, **kw):
+        ...
+
+    def means(self, *a, **kw):
+        return {}
+
+
+class ThroughputTimer:
+    """Samples/sec meter with warm-up skip (ref: utils/timer.py:134)."""
+
+    def __init__(self, batch_size: int, start_step: int = 2,
+                 steps_per_output: int = 50, monitor_memory: bool = False,
+                 logging_fn=None):
+        self.start_time = 0.0
+        self.end_time = 0.0
+        self.started = False
+        self.batch_size = max(1, batch_size)
+        self.start_step = start_step
+        self.epoch_count = 0
+        self.micro_step_count = 0
+        self.global_step_count = 0
+        self.total_elapsed_time = 0.0
+        self.step_elapsed_time = 0.0
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.logging = logging_fn or (lambda msg: log_dist(msg, ranks=[0]))
+        self.initialized = False
+
+    def update_epoch_count(self):
+        self.epoch_count += 1
+        self.micro_step_count = 0
+
+    def _init_timer(self):
+        self.initialized = True
+
+    def start(self):
+        self._init_timer()
+        self.started = True
+        if self.global_step_count >= self.start_step:
+            _device_sync()
+            self.start_time = time.time()
+
+    def stop(self, global_step: bool = False, report_speed: bool = True):
+        if not self.started:
+            return
+        self.started = False
+        self.micro_step_count += 1
+        if global_step:
+            self.global_step_count += 1
+        if self.start_time > 0:
+            _device_sync()
+            self.end_time = time.time()
+            duration = self.end_time - self.start_time
+            self.total_elapsed_time += duration
+            self.step_elapsed_time += duration
+            self.start_time = 0.0
+            if global_step:
+                if report_speed and self.global_step_count % self.steps_per_output == 0:
+                    self.logging(
+                        f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
+                        f"global_step={self.global_step_count}, "
+                        f"RunningAvgSamplesPerSec={self.avg_samples_per_sec():.2f}, "
+                        f"CurrSamplesPerSec={self.batch_size / self.step_elapsed_time:.2f}")
+                self.step_elapsed_time = 0.0
+
+    def avg_samples_per_sec(self) -> float:
+        if self.global_step_count > self.start_step and self.total_elapsed_time > 0:
+            samples = self.batch_size * (self.global_step_count - self.start_step)
+            return samples / self.total_elapsed_time
+        return float("-inf")
+
+
+def trim_mean(data: List[float], trim_percent: float) -> float:
+    """Mean of data with the top/bottom ``trim_percent`` trimmed."""
+    assert 0.0 <= trim_percent <= 1.0
+    n = len(data)
+    if n == 0:
+        return 0.0
+    data = sorted(data)
+    trim = int(n * trim_percent)
+    trimmed = data[trim:n - trim] or data
+    return sum(trimmed) / len(trimmed)

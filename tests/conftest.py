@@ -1,0 +1,37 @@
+"""Test harness: virtual 8-device CPU mesh.
+
+TPU analog of the reference's multi-process fixture
+(ref: tests/unit/common.py:66 @distributed_test forking N local processes).
+On TPU/JAX we emulate a multi-chip host inside ONE process with
+``xla_force_host_platform_device_count`` — every sharding/collective code
+path compiles and runs exactly as on an 8-chip slice.
+
+Must set env before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the session env may point at a real TPU
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+# a sitecustomize may have imported jax (locking the platform choice from the
+# env) before this conftest ran — override through the config instead.
+jax.config.update("jax_platforms", "cpu")
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

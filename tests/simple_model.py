@@ -1,0 +1,49 @@
+"""Tiny real-model fixtures (ref: tests/unit/simple_model.py:11 SimpleModel,
+:40 SimpleMoEModel). Pure-jax: params pytree + loss function."""
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def simple_model_params(hidden_dim: int = 16, nlayers: int = 2,
+                        seed: int = 0) -> Dict:
+    """An MLP regression model: nlayers linear layers + head."""
+    rng = np.random.default_rng(seed)
+    params = {}
+    for i in range(nlayers):
+        params[f"layer_{i}"] = {
+            "kernel": jnp.asarray(
+                rng.standard_normal((hidden_dim, hidden_dim)) / np.sqrt(hidden_dim),
+                jnp.float32),
+            "bias": jnp.zeros((hidden_dim,), jnp.float32),
+        }
+    params["head"] = {
+        "kernel": jnp.asarray(
+            rng.standard_normal((hidden_dim, 1)) / np.sqrt(hidden_dim), jnp.float32),
+        "bias": jnp.zeros((1,), jnp.float32),
+    }
+    return params
+
+
+def simple_model_loss(params: Dict, batch: Tuple, rng=None) -> jnp.ndarray:
+    """MSE loss. batch = (x [B, H], y [B])."""
+    x, y = batch["x"], batch["y"]
+    h = x
+    i = 0
+    while f"layer_{i}" in params:
+        p = params[f"layer_{i}"]
+        h = jnp.tanh(h @ p["kernel"] + p["bias"])
+        i += 1
+    pred = (h @ params["head"]["kernel"] + params["head"]["bias"]).squeeze(-1)
+    return jnp.mean(jnp.square(pred - y))
+
+
+def random_batch(batch_size: int, hidden_dim: int = 16, seed: int = 0) -> Dict:
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((batch_size, hidden_dim)).astype(np.float32)
+    w = rng.standard_normal((hidden_dim,)).astype(np.float32)
+    y = np.tanh(x @ w)
+    return {"x": x, "y": y}

@@ -1,0 +1,116 @@
+"""Config-system tests (ref: tests/unit/test_config.py)."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.runtime.config import (DeepSpeedConfig, DeepSpeedConfigError)
+
+
+def test_batch_reconciliation_full():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 32,
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 2,
+    }, world_size=4)
+    assert cfg.train_batch_size == 32
+    assert cfg.train_micro_batch_size_per_gpu == 4
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_batch_infer_grad_acc():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 32,
+        "train_micro_batch_size_per_gpu": 4,
+    }, world_size=4)
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_batch_infer_micro():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 32,
+        "gradient_accumulation_steps": 2,
+    }, world_size=4)
+    assert cfg.train_micro_batch_size_per_gpu == 4
+
+
+def test_batch_only_micro():
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 4}, world_size=4)
+    assert cfg.train_batch_size == 16
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_mismatch_raises():
+    with pytest.raises(AssertionError):
+        DeepSpeedConfig({
+            "train_batch_size": 33,
+            "train_micro_batch_size_per_gpu": 4,
+            "gradient_accumulation_steps": 2,
+        }, world_size=4)
+
+
+def test_no_batch_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({}, world_size=1)
+
+
+def test_fp16_and_bf16_conflict():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({
+            "train_batch_size": 8,
+            "fp16": {"enabled": True},
+            "bf16": {"enabled": True},
+        }, world_size=1)
+
+
+def test_zero_config_parsing():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "zero_optimization": {
+            "stage": 3,
+            "offload_optimizer": {"device": "cpu", "pin_memory": True},
+            "stage3_prefetch_bucket_size": 1000,
+        },
+        "bf16": {"enabled": True},
+    }, world_size=1)
+    assert cfg.zero.stage == 3
+    assert cfg.zero.enabled
+    assert cfg.zero.offload_optimizer.device == "cpu"
+    assert cfg.zero.offload_optimizer.enabled
+    assert not cfg.zero.offload_param.enabled
+    assert cfg.zero.stage3_prefetch_bucket_size == 1000
+
+
+def test_invalid_zero_stage():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "zero_optimization": {"stage": 5}}, world_size=1)
+
+
+def test_config_from_file(tmp_path):
+    p = tmp_path / "ds_config.json"
+    p.write_text(json.dumps({
+        "train_batch_size": 16,
+        "optimizer": {"type": "adamw", "params": {"lr": 0.001}},
+        "scheduler": {"type": "WarmupLR",
+                      "params": {"warmup_max_lr": 0.001, "warmup_num_steps": 10}},
+    }))
+    cfg = DeepSpeedConfig(str(p), world_size=2)
+    assert cfg.train_batch_size == 16
+    assert cfg.optimizer.type == "adamw"
+    assert cfg.scheduler.type == "WarmupLR"
+
+
+def test_duplicate_keys_rejected(tmp_path):
+    p = tmp_path / "dup.json"
+    p.write_text('{"train_batch_size": 8, "train_batch_size": 16}')
+    with pytest.raises(ValueError):
+        DeepSpeedConfig(str(p), world_size=1)
+
+
+def test_precision_dtype():
+    import jax.numpy as jnp
+    cfg = DeepSpeedConfig({"train_batch_size": 8, "bf16": {"enabled": True}},
+                          world_size=1)
+    assert cfg.compute_dtype == jnp.bfloat16
+    assert cfg.precision_name == "bf16"

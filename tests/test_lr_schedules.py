@@ -1,0 +1,65 @@
+"""LR-schedule tests (ref: tests/unit/test_lr_schedulers.py)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (LRScheduler, constant_lr,
+                                                get_lr_schedule, lr_range_test,
+                                                one_cycle, warmup_decay_lr,
+                                                warmup_lr)
+
+
+def test_warmup_lr_reaches_max():
+    s = warmup_lr(warmup_min_lr=0.0, warmup_max_lr=0.01, warmup_num_steps=10)
+    assert float(s(0)) < 0.01
+    assert float(s(10)) == pytest.approx(0.01, rel=1e-5)
+    assert float(s(100)) == pytest.approx(0.01, rel=1e-5)
+
+
+def test_warmup_lr_monotone():
+    s = warmup_lr(warmup_min_lr=0.0, warmup_max_lr=0.01, warmup_num_steps=50)
+    vals = [float(s(i)) for i in range(0, 60, 5)]
+    assert all(b >= a - 1e-9 for a, b in zip(vals, vals[1:]))
+
+
+def test_warmup_decay_goes_to_zero():
+    s = warmup_decay_lr(total_num_steps=100, warmup_max_lr=0.01,
+                        warmup_num_steps=10)
+    assert float(s(100)) == pytest.approx(0.0, abs=1e-6)
+    assert float(s(55)) == pytest.approx(0.01 * 0.5, rel=0.1)
+
+
+def test_lr_range_test_growth():
+    s = lr_range_test(min_lr=1e-4, step_rate=1.0, step_size=100, staircase=False)
+    assert float(s(0)) == pytest.approx(1e-4)
+    assert float(s(100)) == pytest.approx(2e-4)
+    stair = lr_range_test(min_lr=1e-4, step_rate=1.0, step_size=100, staircase=True)
+    assert float(stair(50)) == pytest.approx(1e-4)
+
+
+def test_one_cycle_shape():
+    s = one_cycle(cycle_min_lr=0.001, cycle_max_lr=0.01,
+                  cycle_first_step_size=100)
+    assert float(s(0)) == pytest.approx(0.001, rel=1e-4)
+    assert float(s(100)) == pytest.approx(0.01, rel=1e-4)
+    assert float(s(200)) == pytest.approx(0.001, rel=1e-3)
+
+
+def test_get_lr_schedule_dispatch():
+    s = get_lr_schedule("WarmupLR", {"warmup_max_lr": 0.005,
+                                     "warmup_num_steps": 10})
+    assert float(s(20)) == pytest.approx(0.005, rel=1e-5)
+    s2 = get_lr_schedule(None, {}, base_lr=0.1)
+    assert float(s2(5)) == pytest.approx(0.1)
+    with pytest.raises(ValueError):
+        get_lr_schedule("NotASchedule", {})
+
+
+def test_stateful_wrapper():
+    sched = LRScheduler(constant_lr(0.5))
+    sched.step()
+    assert sched.get_lr() == [0.5]
+    sd = sched.state_dict()
+    sched2 = LRScheduler(constant_lr(0.5))
+    sched2.load_state_dict(sd)
+    assert sched2.last_batch_iteration == sched.last_batch_iteration

@@ -1,0 +1,106 @@
+"""Sharding-spec inference tests: ZeRO stages as PartitionSpecs."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel.mesh import (MeshSpec, batch_sharding, dp_world_size,
+                                         make_mesh, mesh_from_config)
+from deepspeed_tpu.parallel.sharding import (PartitionRule, megatron_rules,
+                                             opt_state_specs, param_specs)
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+
+def _params():
+    return {
+        "embed": {"embedding": jnp.zeros((4096, 256))},
+        "attn": {"qkv": {"kernel": jnp.zeros((256, 768))},
+                 "out_proj": {"kernel": jnp.zeros((256, 256))}},
+        "ln": {"scale": jnp.zeros((256,))},
+        "scalar": jnp.zeros(()),
+    }
+
+
+def test_mesh_resolution(devices):
+    mesh = make_mesh(MeshSpec(data=-1))
+    assert dp_world_size(mesh) == 8
+    mesh2 = make_mesh(MeshSpec(data=-1, model=2))
+    assert dp_world_size(mesh2) == 4
+
+
+def test_mesh_from_config(devices):
+    cfg = DeepSpeedConfig({"train_batch_size": 8,
+                           "zero_optimization": {"stage": 3}}, world_size=8)
+    mesh = mesh_from_config(cfg)
+    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    assert shape["fsdp"] == 8 and shape["data"] == 1
+
+    cfg2 = DeepSpeedConfig({"train_batch_size": 8,
+                            "mesh": {"tensor_parallel_size": 2}}, world_size=4)
+    mesh2 = mesh_from_config(cfg2)
+    shape2 = dict(zip(mesh2.axis_names, mesh2.devices.shape))
+    assert shape2["model"] == 2 and shape2["data"] == 4
+
+
+def test_stage0_replicated(devices):
+    mesh = make_mesh(MeshSpec())
+    specs = param_specs(_params(), mesh, zero_stage=0)
+    flat = jax.tree_util.tree_leaves(specs, is_leaf=lambda x: isinstance(x, P))
+    assert all(all(e is None for e in s) for s in flat)
+
+
+def test_stage3_shards_big_params(devices):
+    mesh = make_mesh(MeshSpec(data=1, fsdp=8))
+    specs = param_specs(_params(), mesh, zero_stage=3, min_shard_size=128)
+    embed = specs["embed"]["embedding"]
+    assert "fsdp" in [a for e in embed if e for a in
+                      (e if isinstance(e, tuple) else (e,))]
+    # scalars and small params stay replicated
+    assert specs["scalar"] == P()
+
+
+def test_tp_rules_apply(devices):
+    mesh = make_mesh(MeshSpec(data=-1, model=2))
+    specs = param_specs(_params(), mesh, zero_stage=0, rules=megatron_rules())
+    assert specs["attn"]["qkv"]["kernel"] == P(None, "model")
+    assert specs["attn"]["out_proj"]["kernel"] == P("model", None)
+
+
+def test_tp_plus_fsdp(devices):
+    mesh = make_mesh(MeshSpec(data=1, fsdp=4, model=2))
+    specs = param_specs(_params(), mesh, zero_stage=3,
+                        rules=megatron_rules(), min_shard_size=128)
+    qkv = specs["attn"]["qkv"]["kernel"]
+    # model on dim 1 from the rule, fsdp added on dim 0
+    assert qkv == P("fsdp", "model")
+
+
+def test_opt_state_sharded_stage1(devices):
+    import optax
+    mesh = make_mesh(MeshSpec(data=8))
+    params = _params()
+    pspecs = param_specs(params, mesh, zero_stage=1)
+    opt = optax.adam(1e-3)
+    ostate = jax.eval_shape(opt.init, params)
+    ospecs = opt_state_specs(ostate, pspecs, params, mesh, zero_stage=1,
+                             min_shard_size=128)
+    leaves = jax.tree_util.tree_leaves(ospecs, is_leaf=lambda x: isinstance(x, P))
+    # at least the embed-shaped moments should be sharded over 'data'
+    sharded = [s for s in leaves
+               if any("data" in ((e,) if not isinstance(e, tuple) else e)
+                      for e in s if e is not None)]
+    assert sharded, "no optimizer state got sharded under stage 1"
+
+
+def test_params_actually_place(devices):
+    """End-to-end placement: put a param tree with stage-3 specs."""
+    mesh = make_mesh(MeshSpec(data=1, fsdp=8))
+    params = _params()
+    from deepspeed_tpu.parallel.sharding import to_named
+    specs = to_named(param_specs(params, mesh, zero_stage=3, min_shard_size=128), mesh)
+    placed = jax.device_put(params, specs)
+    emb = placed["embed"]["embedding"]
+    # each device holds 1/8 of the embedding rows
+    assert emb.sharding.shard_shape(emb.shape)[0] == 4096 // 8

@@ -1,0 +1,74 @@
+"""Topology math tests — no devices needed
+(ref: tests/unit/test_topology.py)."""
+
+import pytest
+
+from deepspeed_tpu.parallel.topology import (PipeDataParallelTopology,
+                                             PipeModelDataParallelTopology,
+                                             PipelineParallelGrid,
+                                             ProcessTopology)
+
+
+def test_topology_2d():
+    topo = ProcessTopology(axes=["row", "col"], dims=[2, 2])
+    assert topo.get_rank(row=0, col=0) == 0
+    assert topo.get_rank(row=0, col=1) == 1
+    assert topo.get_rank(row=1, col=0) == 2
+    assert topo.get_rank(row=1, col=1) == 3
+
+
+def test_topology_dims():
+    topo = ProcessTopology(axes=["a", "b", "c"], dims=[2, 3, 4])
+    assert topo.world_size() == 24
+    assert topo.get_dim("b") == 3
+    assert topo.get_dim("missing") == 0
+
+
+def test_topology_comm_lists():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=2)
+    # ranks: (pipe,data) -> 0:(0,0) 1:(0,1) 2:(1,0) 3:(1,1)
+    pipe_lists = topo.get_axis_comm_lists("pipe")
+    assert [0, 2] in pipe_lists and [1, 3] in pipe_lists
+    data_lists = topo.get_axis_comm_lists("data")
+    assert [0, 1] in data_lists and [2, 3] in data_lists
+
+
+def test_filter_match():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    ranks = topo.filter_match(pipe=0)
+    assert len(ranks) == 4
+    assert all(topo.get_coord(r).pipe == 0 for r in ranks)
+
+
+def test_grid_basic():
+    topo = PipeDataParallelTopology(num_pp=4, num_dp=2)
+    grid = PipelineParallelGrid(topo, global_rank=0)
+    assert grid.pipe_parallel_size == 4
+    assert grid.data_parallel_size == 2
+    assert grid.is_first_stage()
+    assert not grid.is_last_stage()
+    last = PipelineParallelGrid(topo, global_rank=topo.get_rank(pipe=3, data=0))
+    assert last.is_last_stage()
+
+
+def test_grid_stage_to_global():
+    topo = PipeDataParallelTopology(num_pp=4, num_dp=2)
+    grid = PipelineParallelGrid(topo, global_rank=topo.get_rank(pipe=1, data=1))
+    assert grid.get_stage_id() == 1
+    nxt = grid.stage_to_global(2)
+    assert topo.get_coord(nxt).pipe == 2
+    assert topo.get_coord(nxt).data == 1
+
+
+def test_rank_repr():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    r = topo.get_rank(pipe=1, data=0, model=1)
+    assert topo.get_rank_repr(rank=r) == "pipe_01-model_01"
+
+
+def test_p2p_groups():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=1)
+    grid = PipelineParallelGrid(topo, global_rank=0)
+    assert grid.p2p_groups  # adjacent-stage pairs exist
+    for g in grid.p2p_groups:
+        assert len(g) == 2
