@@ -1,0 +1,109 @@
+"""Benchmark: GPT training throughput (tokens/sec/chip) on the local device.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Metric: per-chip training throughput on a GPT-2-class model via the full
+deepspeed_tpu engine (bf16, ZeRO, remat, flash attention).
+
+vs_baseline: achieved model-flops utilization divided by 0.40 — the "A100
+MFU parity" bar from BASELINE.md (the reference's north star is GPT-2
+training at >= A100 MFU; 40% MFU is the strong published A100 baseline for
+GPT-scale pretraining at this size class). vs_baseline >= 1.0 means we meet
+the bar on this chip.
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+# per-chip bf16 peak FLOPS by device kind
+PEAK_FLOPS = {
+    "v5 lite": 197e12,  # v5e
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v6": 918e12,
+    "cpu": 1e12,
+}
+MFU_BAR = 0.40  # A100-parity bar (see BASELINE.md north star)
+
+
+def peak_flops() -> float:
+    kind = jax.devices()[0].device_kind.lower()
+    for k, v in PEAK_FLOPS.items():
+        if k in kind:
+            return v
+    return 197e12
+
+
+def main():
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt
+
+    on_tpu = "tpu" in (jax.devices()[0].platform +
+                       jax.devices()[0].device_kind).lower()
+    # largest GPT-2 family member that trains comfortably on one 16GB chip
+    cfg = gpt.preset("gpt2-medium", max_seq_len=1024, dtype=jnp.bfloat16,
+                     remat=True, use_flash_attention=on_tpu,
+                     flash_block_q=512, flash_block_kv=512)
+    batch, seq = (8, 1024) if on_tpu else (2, 256)
+
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    ds_config = {
+        "train_batch_size": batch,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1},
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-4,
+                                                  "weight_decay": 0.1}},
+        "steps_per_print": 10_000,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt.make_loss_fn(cfg), model_parameters=params,
+        config=ds_config)
+
+    tokens = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (batch, seq + 1)).astype(np.int32)
+    data = {"tokens": tokens}
+
+    # warmup / compile — block on the result so compile+run cost stays out
+    # of the timed loop
+    jax.block_until_ready(engine.train_batch(data))
+
+    steps = 20 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        m = engine.train_batch(data)
+    jax.block_until_ready(m["loss"])
+    dt = (time.perf_counter() - t0) / steps
+
+    tokens_per_step = batch * seq
+    tps = tokens_per_step / dt
+    flops_per_token = gpt.train_flops_per_token(cfg, seq)
+    mfu = tps * flops_per_token / peak_flops()
+
+    print(json.dumps({
+        "metric": "gpt2_medium_seq1024_train_tokens_per_sec_per_chip",
+        "value": round(tps, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / MFU_BAR, 3),
+        "detail": {
+            "model": "gpt2-medium(355M)",
+            "batch": batch, "seq": seq,
+            "step_ms": round(dt * 1e3, 2),
+            "mfu": round(mfu, 4),
+            "device": jax.devices()[0].device_kind,
+            "zero_stage": 1, "precision": "bf16",
+            "flash_attention": on_tpu,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

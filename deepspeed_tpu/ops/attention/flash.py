@@ -1,0 +1,386 @@
+"""Flash attention — Pallas TPU kernel with custom VJP.
+
+Capability equivalent of the reference's fused attention path inside the
+transformer training kernel (ref: csrc/transformer/softmax_kernels.cu +
+strided-batch GEMM attention, csrc/includes/strided_batch_gemm.h) and the
+long-sequence story of block-sparse attention (SURVEY §2.5/§5): an O(S)
+memory attention that never materializes the [S, S] score matrix.
+
+Algorithm: FlashAttention-2 style online softmax.
+Forward: grid (B, H, Q-blocks, KV-blocks), KV innermost ("arbitrary"
+dimension) with running max / sum / accumulator in VMEM scratch that
+persists across the sequential KV iterations.
+Backward: recompute-based FA2 — one kernel accumulating (dk, dv) over Q
+blocks, one accumulating dq over KV blocks, using the saved logsumexp and
+the precomputed per-row delta = rowsum(dO * O).
+
+All matmuls hit the MXU in the input dtype with fp32 accumulation
+(preferred_element_type); softmax statistics in fp32.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+LANES = 128
+STATS = 8   # lane width for per-row softmax stats (lse/delta) — sublane-aligned
+
+
+def _ceil_to(x, m):
+    return (x + m - 1) // m * m
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scratch, l_scratch, acc_scratch,
+                *, causal: bool, scale: float, block_q: int, block_kv: int,
+                num_kv: int):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    run = True
+    if causal:
+        # whole block above the diagonal -> skip
+        run = qi * block_q + block_q - 1 >= ki * block_kv
+
+    @pl.when(run if causal else True)
+    def _body():
+        q = q_ref[0, 0]                  # [block_q, d]
+        k = k_ref[0, 0]                  # [block_kv, d]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bq, bkv]
+
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + qi * block_q
+            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ki * block_kv
+            s = jnp.where(rows >= cols, s, NEG_INF)
+
+        m_prev = m_scratch[:, :1]                        # [bq, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)       # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                           # [bq, bkv] f32
+        alpha = jnp.exp(m_prev - m_new)                  # [bq, 1]
+        l_new = alpha * l_scratch[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+
+        acc_scratch[:] = acc_scratch[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+        m_scratch[:] = jnp.broadcast_to(m_new, m_scratch.shape)
+        l_scratch[:] = jnp.broadcast_to(l_new, l_scratch.shape)
+
+    @pl.when(ki == num_kv - 1)
+    def _finish():
+        l = l_scratch[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scratch[:] / l_safe).astype(o_ref.dtype)
+        lse = m_scratch[:, :1] + jnp.log(l_safe)
+        lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:]).astype(jnp.float32)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_kv):
+    # arrays are [B, H, S, D] inside the op (wrapper transposes)
+    B, H, S, D = q.shape
+    Skv = k.shape[2]
+    block_q = min(block_q, S)
+    block_kv = min(block_kv, Skv)
+    assert S % block_q == 0 and Skv % block_kv == 0, (S, Skv, block_q, block_kv)
+    num_q = S // block_q
+    num_kv = Skv // block_kv
+
+    def qmap(b, h, qi, ki):
+        return (b, h, qi, 0)
+
+    def kvmap(b, h, qi, ki):
+        return (b, h, ki, 0)
+
+    grid = (B, H, num_q, num_kv)
+    kernel = functools.partial(
+        _fwd_kernel, causal=causal, scale=scale, block_q=block_q,
+        block_kv=block_kv, num_kv=num_kv)
+
+    out_shape = [
+        jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        jax.ShapeDtypeStruct((B, H, S, STATS), jnp.float32),
+    ]
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), qmap),
+            pl.BlockSpec((1, 1, block_kv, D), kvmap),
+            pl.BlockSpec((1, 1, block_kv, D), kvmap),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, D), qmap),
+            pl.BlockSpec((1, 1, block_q, STATS), qmap),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
+    )(q, k, v)
+    return o, lse[..., 0]
+
+
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scratch, dv_scratch,
+                    *, causal: bool, scale: float, block_q: int,
+                    block_kv: int, num_q: int):
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scratch[:] = jnp.zeros_like(dk_scratch)
+        dv_scratch[:] = jnp.zeros_like(dv_scratch)
+
+    run = True
+    if causal:
+        run = qi * block_q + block_q - 1 >= ki * block_kv
+
+    @pl.when(run if causal else True)
+    def _body():
+        q = q_ref[0, 0]                # [bq, d]
+        k = k_ref[0, 0]                # [bkv, d]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]              # [bq, d]
+        lse = lse_ref[0, 0][:, :1]     # [bq, 1]
+        delta = delta_ref[0, 0][:, :1]  # [bq, 1]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + qi * block_q
+            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ki * block_kv
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)                               # [bq, bkv]
+
+        # dv += p^T @ do
+        dv_scratch[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # dp = do @ v^T ; ds = p * (dp - delta) * scale
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale                      # [bq, bkv]
+        # dk += ds^T @ q
+        dk_scratch[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_q - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_scratch[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scratch[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_scratch,
+                   *, causal: bool, scale: float, block_q: int,
+                   block_kv: int, num_kv: int):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scratch[:] = jnp.zeros_like(dq_scratch)
+
+    run = True
+    if causal:
+        run = qi * block_q + block_q - 1 >= ki * block_kv
+
+    @pl.when(run if causal else True)
+    def _body():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + qi * block_q
+            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ki * block_kv
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_scratch[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_kv - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_scratch[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd(causal, scale, block_q, block_kv, res, g):
+    q, k, v, o, lse = res
+    do = g
+    B, H, S, D = q.shape
+    Skv = k.shape[2]
+    block_q = min(block_q, S)
+    block_kv = min(block_kv, Skv)
+    num_q = S // block_q
+    num_kv = Skv // block_kv
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                                  # [B,H,S]
+    lse_b = jnp.broadcast_to(lse[..., None], (B, H, S, STATS))
+    delta_b = jnp.broadcast_to(delta[..., None], (B, H, S, STATS))
+
+    def qmap(b, h, i, j):
+        return (b, h, i, 0)
+
+    def kvmap_q_outer(b, h, i, j):
+        return (b, h, j, 0)
+
+    # ---- dq ----
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
+                          block_q=block_q, block_kv=block_kv, num_kv=num_kv),
+        grid=(B, H, num_q, num_kv),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), qmap),
+            pl.BlockSpec((1, 1, block_kv, D), kvmap_q_outer),
+            pl.BlockSpec((1, 1, block_kv, D), kvmap_q_outer),
+            pl.BlockSpec((1, 1, block_q, D), qmap),
+            pl.BlockSpec((1, 1, block_q, STATS), qmap),
+            pl.BlockSpec((1, 1, block_q, STATS), qmap),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D), qmap),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
+    )(q, k, v, do, lse_b, delta_b)
+
+    # ---- dk, dv ---- (kv outer, q inner)
+    def kvmap(b, h, ki, qi):
+        return (b, h, ki, 0)
+
+    def qmap_kv_outer(b, h, ki, qi):
+        return (b, h, qi, 0)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale,
+                          block_q=block_q, block_kv=block_kv, num_q=num_q),
+        grid=(B, H, num_kv, num_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), qmap_kv_outer),
+            pl.BlockSpec((1, 1, block_kv, D), kvmap),
+            pl.BlockSpec((1, 1, block_kv, D), kvmap),
+            pl.BlockSpec((1, 1, block_q, D), qmap_kv_outer),
+            pl.BlockSpec((1, 1, block_q, STATS), qmap_kv_outer),
+            pl.BlockSpec((1, 1, block_q, STATS), qmap_kv_outer),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_kv, D), kvmap),
+            pl.BlockSpec((1, 1, block_kv, D), kvmap),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_kv, D), jnp.float32),
+            pltpu.VMEM((block_kv, D), jnp.float32),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Skv, D), k.dtype),
+            jax.ShapeDtypeStruct((B, H, Skv, D), v.dtype),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
+    )(q, k, v, do, lse_b, delta_b)
+
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public op
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, scale, block_q, block_kv):
+    o, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_kv)
+    return o
+
+
+def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_kv):
+    o, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_kv)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_vjp_bwd(causal, scale, block_q, block_kv, res, g):
+    return _flash_bwd(causal, scale, block_q, block_kv, res, g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    causal: bool = True, scale: Optional[float] = None,
+                    block_q: int = 512, block_kv: int = 512) -> jnp.ndarray:
+    """Flash attention over [B, S, H, D] tensors.
+
+    Pads the head dim to a 128-lane multiple for the MXU; falls back is the
+    caller's job (models catch exceptions and use the jnp path).
+    """
+    B, S, H, D = q.shape
+    if scale is None:
+        scale = 1.0 / np.sqrt(D)
+    Dp = _ceil_to(D, LANES)
+    if Dp != D:
+        pad = [(0, 0), (0, 0), (0, 0), (0, Dp - D)]
+        q = jnp.pad(q, pad)
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+    # kernel-internal layout is [B, H, S, D]
+    q = q.transpose(0, 2, 1, 3)
+    k = k.transpose(0, 2, 1, 3)
+    v = v.transpose(0, 2, 1, 3)
+    out = _flash(q, k, v, causal, scale, block_q, block_kv)
+    out = out.transpose(0, 2, 1, 3)
+    if Dp != D:
+        out = out[..., :D]
+    return out
+
+
+def mha_reference(q, k, v, causal=True, scale=None):
+    """Pure-jnp reference for parity tests (analog of the python BERT
+    baselines in ref tests/unit/test_cuda_forward.py)."""
+    B, S, H, D = q.shape
+    if scale is None:
+        scale = 1.0 / np.sqrt(D)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((S, k.shape[1]), bool))
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
