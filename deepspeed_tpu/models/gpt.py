@@ -277,6 +277,84 @@ def gpt_partition_rules() -> list:
     ]
 
 
+# ---------------------------------------------------------------------------
+# pipeline parallelism integration
+# ---------------------------------------------------------------------------
+
+def gpt_pipeline_partition_rules(tp: bool = False) -> list:
+    """Partition rules for pipeline mode: the stacked layer dim is sharded
+    over 'pipe' (each stage owns n_layers/pp layers), optionally composed
+    with Megatron TP on the inner dims."""
+    model = "model" if tp else None
+    return [
+        PartitionRule(r"block/(ln1|ln2)/(scale|bias)", P("pipe", None)),
+        PartitionRule(r"block/qkv/kernel", P("pipe", None, model)),
+        PartitionRule(r"block/qkv/bias", P("pipe", model)),
+        PartitionRule(r"block/attn_out/kernel", P("pipe", model, None)),
+        PartitionRule(r"block/attn_out/bias", P("pipe", None)),
+        PartitionRule(r"block/mlp_in/kernel", P("pipe", None, model)),
+        PartitionRule(r"block/mlp_in/bias", P("pipe", model)),
+        PartitionRule(r"block/mlp_out/kernel", P("pipe", model, None)),
+        PartitionRule(r"block/mlp_out/bias", P("pipe", None)),
+    ]
+
+
+def make_pipeline_loss_fn(cfg: GPTConfig, mesh, num_stages: int,
+                          num_micro: int):
+    """Engine-contract loss running the transformer stack as a shard_map
+    pipeline over the 'pipe' mesh axis (1 stage = n_layers/pp layers).
+    Embedding + LM head run replicated over pipe (tied-weight grads are
+    psum'd across stages by shard_map's transpose — the ReduceTiedGrads
+    capability, ref pipe/engine.py:240)."""
+    from deepspeed_tpu.runtime.pipe.engine import make_pipelined_loss_fn
+
+    assert cfg.n_layers % num_stages == 0, (cfg.n_layers, num_stages)
+
+    def split_params(params):
+        other = {k: v for k, v in params.items() if k != "block"}
+        return params["block"], other
+
+    def embed_fn(other, batch):
+        tokens = batch["tokens"]
+        targets = batch.get("targets")
+        if targets is None:
+            targets = tokens[:, 1:]
+            tokens = tokens[:, :-1]
+        S = tokens.shape[1]
+        x = (other["wte"]["embedding"].astype(cfg.dtype)[tokens] +
+             other["wpe"]["embedding"].astype(cfg.dtype)[:S][None])
+        return x, targets
+
+    def stage_fn(block_local, x):
+        def body(carry, layer):
+            return _block(carry, layer, cfg, deterministic=True), None
+        y, _ = jax.lax.scan(body, x, block_local)
+        return y
+
+    def head_loss_fn(other, y, targets):
+        y = _layernorm(y, other["ln_f"]["scale"], other["ln_f"]["bias"])
+        logits = (y @ other["wte"]["embedding"].astype(cfg.dtype).T
+                  if cfg.tie_embeddings
+                  else y @ other["lm_head"]["kernel"].astype(cfg.dtype))
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
+        return -ll.mean()
+
+    # block leaves: rank 2 -> P('pipe'), rank 3 -> P('pipe')
+    def spec_of(leaf):
+        return P(*(["pipe"] + [None] * (leaf.ndim - 1)))
+
+    import jax.numpy as _jnp
+    dummy = init_params(jax.random.PRNGKey(0),
+                        GPTConfig(vocab_size=8, n_layers=num_stages,
+                                  n_heads=1, d_model=8, max_seq_len=8))
+    specs = jax.tree_util.tree_map(spec_of, dummy["block"])
+
+    return make_pipelined_loss_fn(
+        embed_fn, stage_fn, head_loss_fn, split_params,
+        num_stages, num_micro, mesh, specs, remat_stage=cfg.remat)
+
+
 def num_params(cfg: GPTConfig) -> int:
     d, L, ff, V = cfg.d_model, cfg.n_layers, cfg.ffn_dim, cfg.vocab_size
     per_layer = 3 * d * d + 3 * d + d * d + d + 2 * d * ff + ff + d + 4 * d

@@ -174,14 +174,16 @@ def opt_state_specs(opt_state: PyTree,
             return P(*([None] * len(shape)))
         if zero_stage >= 1:
             # shard over 'data' too (on top of fsdp/model placement)
-            return _add_axis(base, shape, "data", data_size, min_shard_size)
+            return _add_axis(base, shape, "data", data_size, min_shard_size,
+                             mesh_shape=dict(zip(mesh.axis_names,
+                                                 mesh.devices.shape)))
         return base
 
     return jax.tree_util.tree_map(spec_for, opt_state)
 
 
 def _add_axis(spec: P, shape: Tuple[int, ...], axis: str, axis_size: int,
-              min_size: int) -> P:
+              min_size: int, mesh_shape: Optional[Dict[str, int]] = None) -> P:
     if axis_size <= 1:
         return spec
     entries = list(spec) + [None] * (len(shape) - len(spec))
@@ -201,14 +203,20 @@ def _add_axis(spec: P, shape: Tuple[int, ...], axis: str, axis_size: int,
         if d % axis_size == 0 and d >= min_size and d > best_dim:
             best, best_dim = i, d
     if best < 0:
-        # try stacking onto an existing sharded dim if divisible by both
+        # try stacking onto an existing sharded dim — only if the dim stays
+        # divisible by the combined shard product
+        mesh_shape = mesh_shape or {}
         for i, d in enumerate(shape):
             e = entries[i]
             if e is None:
                 continue
             cur = e if isinstance(e, tuple) else (e,)
-            entries[i] = tuple(cur) + (axis,)
-            return P(*entries)
+            existing = 1
+            for a in cur:
+                existing *= mesh_shape.get(a, 1)
+            if d % (existing * axis_size) == 0:
+                entries[i] = tuple(cur) + (axis,)
+                return P(*entries)
         return P(*entries)
     entries[best] = axis
     return P(*entries)

@@ -1,0 +1,230 @@
+"""Pipeline instruction schedules — pure Python, no devices.
+
+Capability analog of the reference's schedule module
+(ref: deepspeed/runtime/pipe/schedule.py — PipeSchedule :24, TrainSchedule
+:182, InferenceSchedule :129, DataParallelSchedule :292; instruction set
+:317-463). On TPU the hot path executes as ONE fused shard_map program
+(deepspeed_tpu/runtime/pipe/engine.py) rather than an interpreted
+instruction stream, but the schedule objects remain: they document and test
+the 1F1B ordering, drive the (host-side) offload scheduler, and give users
+the same introspection surface (see ref tests/unit/test_pipe_schedule.py,
+which validates instruction streams without any GPU — mirrored in
+tests/test_pipe_schedule.py).
+"""
+
+from typing import Iterator, List
+
+
+# ---------------------------------------------------------------------------
+# instructions
+# ---------------------------------------------------------------------------
+
+class PipeInstruction:
+    """One step of work (ref: schedule.py:317)."""
+
+    def __init__(self, **kwargs):
+        self.name = self.__class__.__name__
+        self.kwargs = kwargs
+        for key, val in kwargs.items():
+            setattr(self, key, val)
+
+    def __repr__(self):
+        if not self.kwargs:
+            return self.name
+        args = ", ".join(f"{k}={v}" for k, v in sorted(self.kwargs.items()))
+        return f"{self.name}({args})"
+
+    def __eq__(self, other):
+        return (self.__class__ is other.__class__ and
+                self.kwargs == other.kwargs)
+
+    def __hash__(self):
+        return hash((self.__class__, tuple(sorted(self.kwargs.items()))))
+
+
+class OptimizerStep(PipeInstruction):
+    """Step the optimizer (all stages, after all microbatches)."""
+
+
+class ReduceGrads(PipeInstruction):
+    """Data-parallel gradient reduction."""
+
+
+class ReduceTiedGrads(PipeInstruction):
+    """Reduce gradients of tied modules across their tie group."""
+
+
+class BufferOpInstruction(PipeInstruction):
+    """Instruction operating on a pipe buffer slot
+    (ref: schedule.py:355 — carries buffer_id)."""
+
+    def __init__(self, buffer_id: int, **kwargs):
+        super().__init__(buffer_id=buffer_id, **kwargs)
+
+
+class LoadMicroBatch(BufferOpInstruction):
+    pass
+
+
+class ForwardPass(BufferOpInstruction):
+    pass
+
+
+class BackwardPass(BufferOpInstruction):
+    pass
+
+
+class SendActivation(BufferOpInstruction):
+    pass
+
+
+class RecvActivation(BufferOpInstruction):
+    pass
+
+
+class SendGrad(BufferOpInstruction):
+    pass
+
+
+class RecvGrad(BufferOpInstruction):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# schedules
+# ---------------------------------------------------------------------------
+
+class PipeSchedule:
+    """Yields lists of PipeInstructions per "clock step" for one stage
+    (ref: schedule.py:24)."""
+
+    def __init__(self, micro_batches: int, stages: int, stage_id: int):
+        assert 0 <= stage_id < stages
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+        self.prev_stage = stage_id - 1
+        self.next_stage = stage_id + 1
+
+    def steps(self) -> Iterator[List[PipeInstruction]]:
+        raise NotImplementedError
+
+    def num_pipe_buffers(self) -> int:
+        return self.micro_batches
+
+    @property
+    def is_first_stage(self) -> bool:
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self) -> bool:
+        return self.stage_id == self.stages - 1
+
+    def _buffer_idx(self, micro_batch_id: int) -> int:
+        return micro_batch_id % self.num_pipe_buffers()
+
+    def __iter__(self):
+        return iter(self.steps())
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only pipelining (ref: schedule.py:129)."""
+
+    def num_pipe_buffers(self) -> int:
+        return 2
+
+    def steps(self):
+        total_steps = self.micro_batches + self.stages - 1
+        for step_id in range(total_steps):
+            micro_batch_id = step_id - self.stage_id
+            cmds: List[PipeInstruction] = []
+            valid = 0 <= micro_batch_id < self.micro_batches
+            buf = self._buffer_idx(max(micro_batch_id, 0))
+            if valid:
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(buf))
+                else:
+                    cmds.append(RecvActivation(buf))
+                cmds.append(ForwardPass(buf))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(buf))
+            yield cmds
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B: warmup forwards, steady-state interleave, cooldown backwards
+    (ref: schedule.py:182 TrainSchedule.steps).
+
+    Per-stage sequence for stage s of P with M microbatches:
+      warmup   = min(P - 1 - s, M) forwards
+      steady   = interleaved 1F1B
+      cooldown = remaining backwards
+    Peak live activations on stage s = warmup + 1 (the 1F1B memory win
+    over GPipe's M).
+    """
+
+    def num_pipe_buffers(self) -> int:
+        buffers = min(self.stages - self.stage_id, self.micro_batches)
+        return max(2, buffers)
+
+    def steps(self):
+        M, P, s = self.micro_batches, self.stages, self.stage_id
+        warmup = min(P - 1 - s, M)
+        fwd_id = 0
+        bwd_id = 0
+        cmds_seq: List[List[PipeInstruction]] = []
+
+        # warmup forwards
+        for _ in range(warmup):
+            cmds_seq.append(self._fwd_cmds(fwd_id))
+            fwd_id += 1
+        # steady state: 1F1B
+        while fwd_id < M:
+            cmds_seq.append(self._fwd_cmds(fwd_id))
+            fwd_id += 1
+            cmds_seq.append(self._bwd_cmds(bwd_id))
+            bwd_id += 1
+        # cooldown backwards
+        while bwd_id < M:
+            cmds_seq.append(self._bwd_cmds(bwd_id))
+            bwd_id += 1
+        # epilogue: grad reduction + optimizer step
+        cmds_seq.append([ReduceTiedGrads(), ReduceGrads(), OptimizerStep()])
+        yield from cmds_seq
+
+    def _fwd_cmds(self, micro_batch_id: int) -> List[PipeInstruction]:
+        buf = self._buffer_idx(micro_batch_id)
+        cmds: List[PipeInstruction] = []
+        if self.is_first_stage:
+            cmds.append(LoadMicroBatch(buf))
+        else:
+            cmds.append(RecvActivation(buf))
+        cmds.append(ForwardPass(buf))
+        if not self.is_last_stage:
+            cmds.append(SendActivation(buf))
+        return cmds
+
+    def _bwd_cmds(self, micro_batch_id: int) -> List[PipeInstruction]:
+        buf = self._buffer_idx(micro_batch_id)
+        cmds: List[PipeInstruction] = []
+        if not self.is_last_stage:
+            cmds.append(RecvGrad(buf))
+        cmds.append(BackwardPass(buf))
+        if not self.is_first_stage:
+            cmds.append(SendGrad(buf))
+        return cmds
+
+
+class DataParallelSchedule(PipeSchedule):
+    """Degenerate schedule for pure DP (ref: schedule.py:292)."""
+
+    def num_pipe_buffers(self) -> int:
+        return 1
+
+    def steps(self):
+        for micro_batch_id in range(self.micro_batches):
+            cmds: List[PipeInstruction] = [
+                LoadMicroBatch(0), ForwardPass(0), BackwardPass(0)]
+            if micro_batch_id == self.micro_batches - 1:
+                cmds.extend([ReduceGrads(), OptimizerStep()])
+            yield cmds

@@ -1,0 +1,127 @@
+"""Schedule/partitioning unit tests — no devices
+(ref: tests/unit/test_pipe_schedule.py:157 pattern: validate instruction
+streams directly)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.pipe.module import (LayerSpec, PipelineModule,
+                                               TiedLayerSpec,
+                                               partition_balanced,
+                                               partition_uniform)
+from deepspeed_tpu.runtime.pipe.schedule import (BackwardPass, ForwardPass,
+                                                 InferenceSchedule,
+                                                 LoadMicroBatch, OptimizerStep,
+                                                 RecvActivation, RecvGrad,
+                                                 ReduceGrads, ReduceTiedGrads,
+                                                 SendActivation, SendGrad,
+                                                 TrainSchedule)
+
+
+def _flat(sched):
+    cmds = []
+    for step in sched.steps():
+        cmds.extend(step)
+    return cmds
+
+
+def test_train_schedule_counts():
+    """Every stage does M forwards and M backwards + epilogue."""
+    for stage in range(4):
+        sched = TrainSchedule(micro_batches=8, stages=4, stage_id=stage)
+        cmds = _flat(sched)
+        assert sum(isinstance(c, ForwardPass) for c in cmds) == 8
+        assert sum(isinstance(c, BackwardPass) for c in cmds) == 8
+        assert sum(isinstance(c, OptimizerStep) for c in cmds) == 1
+        assert sum(isinstance(c, ReduceGrads) for c in cmds) == 1
+
+
+def test_train_schedule_first_last_stage_io():
+    first = _flat(TrainSchedule(micro_batches=4, stages=2, stage_id=0))
+    assert any(isinstance(c, LoadMicroBatch) for c in first)
+    assert not any(isinstance(c, RecvActivation) for c in first)
+    assert any(isinstance(c, SendActivation) for c in first)
+    assert any(isinstance(c, RecvGrad) for c in first)
+    assert not any(isinstance(c, SendGrad) for c in first)
+
+    last = _flat(TrainSchedule(micro_batches=4, stages=2, stage_id=1))
+    assert any(isinstance(c, RecvActivation) for c in last)
+    assert not any(isinstance(c, SendActivation) for c in last)
+    assert any(isinstance(c, SendGrad) for c in last)
+    assert not any(isinstance(c, RecvGrad) for c in last)
+
+
+def test_train_schedule_1f1b_order():
+    """First stage: P-1 warmup forwards before the first backward."""
+    sched = TrainSchedule(micro_batches=8, stages=4, stage_id=0)
+    kinds = [type(c).__name__ for c in _flat(sched)
+             if type(c).__name__ in ("ForwardPass", "BackwardPass")]
+    first_bwd = kinds.index("BackwardPass")
+    assert kinds[:first_bwd].count("ForwardPass") == 3 + 1  # warmup + 1 steady fwd
+    # last stage alternates F,B from the start
+    sched_last = TrainSchedule(micro_batches=8, stages=4, stage_id=3)
+    kinds_last = [type(c).__name__ for c in _flat(sched_last)
+                  if type(c).__name__ in ("ForwardPass", "BackwardPass")]
+    assert kinds_last[:4] == ["ForwardPass", "BackwardPass"] * 2
+
+
+def test_train_schedule_buffer_bound():
+    """1F1B memory: num buffers shrinks for later stages."""
+    assert TrainSchedule(8, 4, 0).num_pipe_buffers() == 4
+    assert TrainSchedule(8, 4, 2).num_pipe_buffers() == 2
+    assert TrainSchedule(8, 4, 3).num_pipe_buffers() == 2
+
+
+def test_inference_schedule():
+    sched = InferenceSchedule(micro_batches=4, stages=2, stage_id=0)
+    cmds = _flat(sched)
+    assert sum(isinstance(c, ForwardPass) for c in cmds) == 4
+    assert not any(isinstance(c, BackwardPass) for c in cmds)
+    steps = list(sched.steps())
+    assert len(steps) == 4 + 2 - 1
+
+
+def test_instruction_repr_eq():
+    assert ForwardPass(3) == ForwardPass(3)
+    assert ForwardPass(3) != ForwardPass(4)
+    assert "buffer_id=3" in repr(ForwardPass(3))
+
+
+# ---- partitioning ---------------------------------------------------------
+
+def test_partition_uniform():
+    assert partition_uniform(10, 2) == [0, 5, 10]
+    assert partition_uniform(10, 3) == [0, 4, 7, 10]
+    assert partition_uniform(2, 4) == [0, 1, 2, 2, 2]
+
+
+def test_partition_balanced():
+    parts = partition_balanced([10, 1, 1, 1, 1, 10], 2)
+    # balanced split puts the two heavy layers in different parts
+    assert parts[0] == 0 and parts[-1] == 6
+    w = [10, 1, 1, 1, 1, 10]
+    left = sum(w[parts[0]:parts[1]])
+    right = sum(w[parts[1]:parts[2]])
+    assert max(left, right) <= 14
+
+
+def test_pipeline_module_partition_methods():
+    layers = [LayerSpec("Embed", None, lambda: 100)] + \
+        [LayerSpec("Block", None, lambda: 10) for _ in range(6)] + \
+        [LayerSpec("Head", None, lambda: 100)]
+    pm_u = PipelineModule(layers, num_stages=2, partition_method="uniform")
+    assert pm_u.parts == [0, 4, 8]
+    pm_p = PipelineModule(layers, num_stages=2, partition_method="parameters")
+    assert pm_p.parts[0] == 0 and pm_p.parts[-1] == 8
+    pm_t = PipelineModule(layers, num_stages=2, partition_method="type:Block")
+    counts = [sum(1 for i in pm_t.layers_of_stage(s)
+                  if layers[i].typename == "Block") for s in range(2)]
+    assert counts == [3, 3]
+
+
+def test_tied_layers():
+    layers = [TiedLayerSpec("Embed", None, lambda: 10, key="embed")] + \
+        [LayerSpec("Block", None, lambda: 10) for _ in range(4)] + \
+        [TiedLayerSpec("Head", None, lambda: 10, key="embed")]
+    pm = PipelineModule(layers, num_stages=2, partition_method="uniform")
+    assert pm.tied_groups["embed"] == [0, 5]
+    assert pm.tied_stages("embed") == [0, 1]

@@ -1,0 +1,85 @@
+"""Pipelined-execution tests on the 8-device CPU mesh: parity with
+non-pipelined forward, convergence, and composition with the engine."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import gpt
+from deepspeed_tpu.parallel.mesh import MeshSpec, make_mesh
+
+
+def tiny_cfg(n_layers=4, **kw):
+    d = dict(vocab_size=128, n_layers=n_layers, n_heads=4, d_model=32,
+             max_seq_len=32, use_flash_attention=False, remat=False,
+             dtype=jnp.float32)
+    d.update(kw)
+    return gpt.GPTConfig(**d)
+
+
+def test_pipeline_loss_matches_dense(devices):
+    """Pipelined loss over 4 stages == plain loss (same params/batch)."""
+    cfg = tiny_cfg(n_layers=4)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = np.random.default_rng(0).integers(0, 128, (8, 17)).astype(np.int32)
+    batch = {"tokens": jnp.asarray(tokens)}
+
+    ref = float(gpt.loss_fn(params, dict(batch), jax.random.PRNGKey(0), cfg,
+                            deterministic=True))
+
+    mesh = make_mesh(MeshSpec(pipe=4, data=-1))
+    loss_fn = gpt.make_pipeline_loss_fn(cfg, mesh, num_stages=4, num_micro=2)
+    with jax.set_mesh(mesh):
+        pl_loss = float(jax.jit(loss_fn)(params, batch, jax.random.PRNGKey(0)))
+    np.testing.assert_allclose(ref, pl_loss, rtol=1e-5)
+
+
+def test_pipeline_grads_match_dense(devices):
+    """Pipeline autodiff (incl. tied embedding psum) == dense grads."""
+    cfg = tiny_cfg(n_layers=2)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = np.random.default_rng(0).integers(0, 128, (4, 17)).astype(np.int32)
+    batch = {"tokens": jnp.asarray(tokens)}
+
+    g_ref = jax.grad(lambda p: gpt.loss_fn(p, dict(batch),
+                                           jax.random.PRNGKey(0), cfg,
+                                           deterministic=True))(params)
+    mesh = make_mesh(MeshSpec(pipe=2, data=-1))
+    loss_fn = gpt.make_pipeline_loss_fn(cfg, mesh, num_stages=2, num_micro=2)
+    with jax.set_mesh(mesh):
+        g_pl = jax.jit(jax.grad(
+            lambda p: loss_fn(p, batch, jax.random.PRNGKey(0))))(params)
+
+    flat_ref = jax.tree_util.tree_leaves_with_path(g_ref)
+    flat_pl = dict(jax.tree_util.tree_leaves_with_path(g_pl))
+    for path, leaf in flat_ref:
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(flat_pl[path]),
+            rtol=2e-4, atol=2e-5,
+            err_msg=jax.tree_util.keystr(path))
+
+
+def test_pipeline_engine_trains(devices):
+    """Full engine integration: pp=4 x dp=2, ZeRO-1, loss decreases."""
+    cfg = tiny_cfg(n_layers=4)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    mesh = make_mesh(MeshSpec(pipe=4, data=-1))
+    loss_fn = gpt.make_pipeline_loss_fn(cfg, mesh, num_stages=4, num_micro=4)
+    ds = {
+        "train_batch_size": 8,
+        "zero_optimization": {"stage": 1},
+        "optimizer": {"type": "adamw", "params": {"lr": 3e-3}},
+        "steps_per_print": 1000,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=loss_fn, model_parameters=params, config=ds, mesh=mesh,
+        partition_rules=gpt.gpt_pipeline_partition_rules())
+    data = np.random.default_rng(0).integers(0, 128, (8, 33)).astype(np.int32)
+    losses = [float(engine.train_batch({"tokens": data})["loss"])
+              for _ in range(12)]
+    assert losses[-1] < losses[0] - 0.5, losses
+    # block params must actually be sharded over pipe
+    qkv = engine.state.params["block"]["qkv"]["kernel"]
+    assert qkv.sharding.shard_shape(qkv.shape)[0] == cfg.n_layers // 4
