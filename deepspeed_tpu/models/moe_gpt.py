@@ -1,0 +1,146 @@
+"""GPT with Mixture-of-Experts FFNs — the GPT-MoE workload
+(ref: BASELINE.json config #5 "GPT-MoE NLG"; reference wiring in
+deepspeed/moe/layer.py applied to every-other FFN in Megatron-MoE).
+
+Same stacked-layer lax.scan design as models/gpt.py; every layer's MLP is
+a GShard MoE (top-1/top-2, capacity, load-balance aux loss). Expert
+weights are stacked [L, E, ...] and sharded over the data axes on the E
+dim (expert-data parallelism); the dispatch einsum inside the scan emits
+the per-layer all-to-all.
+"""
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.models import gpt as gpt_lib
+from deepspeed_tpu.models.gpt import GPTConfig, _attention, _layernorm
+from deepspeed_tpu.moe.experts import ffn_expert_fn
+from deepspeed_tpu.moe.layer import MoEConfig
+from deepspeed_tpu.moe.sharded_moe import TopKGate, moe_layer_apply
+from deepspeed_tpu.parallel.sharding import PartitionRule
+
+
+@dataclass
+class MoEGPTConfig(GPTConfig):
+    num_experts: int = 8
+    moe_k: int = 1
+    capacity_factor: float = 1.25
+    min_capacity: int = 4
+    aux_loss_weight: float = 0.01
+    noisy_gate_policy: Optional[str] = None
+
+
+def init_params(rng: jax.Array, cfg: MoEGPTConfig) -> Dict:
+    base = gpt_lib.init_params(rng, cfg)
+    L, E, d, ff = cfg.n_layers, cfg.num_experts, cfg.d_model, cfg.ffn_dim
+    ks = jax.random.split(jax.random.fold_in(rng, 99), 3)
+    init = jax.nn.initializers.normal(0.02)
+    # replace dense MLP with per-layer expert stacks + gate
+    block = base["block"]
+    del block["mlp_in"], block["mlp_out"]
+    block["moe"] = {
+        "gate": {"wg": init(ks[0], (L, d, E), jnp.float32)},
+        "experts": {
+            "wi": {"kernel": init(ks[1], (L, E, d, ff), jnp.float32),
+                   "bias": jnp.zeros((L, E, ff), jnp.float32)},
+            "wo": {"kernel": init(ks[2], (L, E, ff, d), jnp.float32),
+                   "bias": jnp.zeros((L, E, d), jnp.float32)},
+        },
+    }
+    return base
+
+
+def _moe_block(x, layer_params, cfg: MoEGPTConfig, rng, train: bool):
+    """One transformer block with MoE FFN. x: [B, S, D]."""
+    B, S, D = x.shape
+    H, Dh = cfg.n_heads, cfg.head_dim
+    p = layer_params
+
+    h = _layernorm(x, p["ln1"]["scale"], p["ln1"]["bias"])
+    qkv = h @ p["qkv"]["kernel"].astype(h.dtype) + p["qkv"]["bias"].astype(h.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    attn = _attention(q.reshape(B, S, H, Dh), k.reshape(B, S, H, Dh),
+                      v.reshape(B, S, H, Dh), cfg).reshape(B, S, D)
+    attn = attn @ p["attn_out"]["kernel"].astype(attn.dtype) + \
+        p["attn_out"]["bias"].astype(attn.dtype)
+    x = x + attn
+
+    h = _layernorm(x, p["ln2"]["scale"], p["ln2"]["bias"])
+    gate = TopKGate(k=cfg.moe_k, capacity_factor=cfg.capacity_factor,
+                    min_capacity=cfg.min_capacity,
+                    noisy_gate_policy=cfg.noisy_gate_policy)
+    y, l_aux, _counts = moe_layer_apply(
+        gate, p["moe"]["gate"], p["moe"]["experts"], ffn_expert_fn,
+        h, rng, train)
+    return x + y, l_aux
+
+
+def forward(params: Dict, tokens: jnp.ndarray, cfg: MoEGPTConfig,
+            rng: Optional[jax.Array] = None,
+            train: bool = True) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """-> (logits [B,S,V], total_l_aux)."""
+    B, S = tokens.shape
+    dtype = cfg.dtype
+    wte = params["wte"]["embedding"].astype(dtype)
+    x = wte[tokens] + params["wpe"]["embedding"].astype(dtype)[:S][None]
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    def body(carry, layer):
+        x, aux, r = carry
+        r, lr = jax.random.split(r)
+        y, l_aux = _moe_block(x, layer, cfg, lr, train)
+        return (y, aux + l_aux, r), None
+
+    body_fn = body
+    if cfg.remat:
+        body_fn = jax.checkpoint(body, policy=jax.checkpoint_policies.nothing_saveable)
+    (x, aux, _), _ = jax.lax.scan(
+        body_fn, (x, jnp.zeros([], jnp.float32), rng), params["block"])
+
+    x = _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    logits = x @ wte.T if cfg.tie_embeddings else \
+        x @ params["lm_head"]["kernel"].astype(dtype)
+    return logits, aux / cfg.n_layers
+
+
+def loss_fn(params, batch, rng, cfg: MoEGPTConfig, train: bool = True):
+    tokens = batch["tokens"]
+    targets = batch.get("targets")
+    if targets is None:
+        targets = tokens[:, 1:]
+        tokens = tokens[:, :-1]
+    logits, l_aux = forward(params, tokens, cfg, rng, train)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
+    lm_loss = -ll.mean()
+    return lm_loss + cfg.aux_loss_weight * l_aux
+
+
+def make_loss_fn(cfg: MoEGPTConfig):
+    def _loss(params, batch, rng):
+        return loss_fn(params, batch, rng, cfg)
+    return _loss
+
+
+def moe_gpt_partition_rules(tp: bool = False) -> list:
+    """Expert-parallel rules for the [L, E, ...] stacks: shard E (dim 1)
+    over the data axes; attention follows the dense GPT TP rules."""
+    model = "model" if tp else None
+    rules = [
+        PartitionRule(r"block/moe/experts/(wi|wo)/kernel",
+                      P(None, ("data", "fsdp"), None, None)),
+        PartitionRule(r"block/moe/experts/(wi|wo)/bias",
+                      P(None, ("data", "fsdp"), None)),
+    ]
+    if tp:
+        rules += [
+            PartitionRule(r"block/qkv/kernel", P(None, None, model)),
+            PartitionRule(r"block/qkv/bias", P(None, model)),
+            PartitionRule(r"block/attn_out/kernel", P(None, model, None)),
+        ]
+    return rules

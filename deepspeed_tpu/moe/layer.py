@@ -1,0 +1,101 @@
+"""MoE facade (ref: deepspeed/moe/layer.py:18 MoE).
+
+Bundles gate + experts + optional residual MLP (PR-MoE, ref layer.py:19
+``use_residual``) behind init/apply, plus the partition rules that realize
+expert parallelism: expert-stacked leaves sharded over the data axes so the
+dispatch einsum emits the all-to-all (the reference's explicit expert
+process groups, utils/groups.py:107/160/206, dissolve into this sharding).
+"""
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.moe.experts import ffn_expert_fn, init_ffn_experts
+from deepspeed_tpu.moe.sharded_moe import TopKGate, moe_layer_apply
+from deepspeed_tpu.parallel.sharding import PartitionRule
+
+
+@dataclass
+class MoEConfig:
+    num_experts: int = 8
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
+    use_residual: bool = False      # PR-MoE
+    aux_loss_weight: float = 0.01
+
+
+class MoE:
+    """init/apply MoE block over [G, S, d] activations."""
+
+    def __init__(self, d_model: int, d_ff: int, cfg: MoEConfig):
+        self.d_model = d_model
+        self.d_ff = d_ff
+        self.cfg = cfg
+        self.gate = TopKGate(
+            k=cfg.k, capacity_factor=cfg.capacity_factor,
+            eval_capacity_factor=cfg.eval_capacity_factor,
+            min_capacity=cfg.min_capacity,
+            noisy_gate_policy=cfg.noisy_gate_policy,
+            drop_tokens=cfg.drop_tokens)
+
+    def init_params(self, rng) -> Dict:
+        kg, ke, kr, kc = jax.random.split(rng, 4)
+        params = {
+            "gate": TopKGate.init_params(kg, self.d_model, self.cfg.num_experts),
+            "experts": init_ffn_experts(ke, self.cfg.num_experts,
+                                        self.d_model, self.d_ff),
+        }
+        if self.cfg.use_residual:
+            init = jax.nn.initializers.normal(0.02)
+            params["residual_mlp"] = {
+                "wi": {"kernel": init(kr, (self.d_model, self.d_ff), jnp.float32),
+                       "bias": jnp.zeros((self.d_ff,), jnp.float32)},
+                "wo": {"kernel": init(kc, (self.d_ff, self.d_model), jnp.float32),
+                       "bias": jnp.zeros((self.d_model,), jnp.float32)},
+            }
+            params["coefficient"] = {
+                "kernel": jnp.zeros((self.d_model, 2), jnp.float32),
+                "bias": jnp.zeros((2,), jnp.float32)}
+        return params
+
+    def apply(self, params: Dict, x: jnp.ndarray,
+              rng: Optional[jax.Array] = None,
+              train: bool = True) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """x: [G, S, d] -> (y, l_aux, exp_counts)."""
+        y, l_aux, exp_counts = moe_layer_apply(
+            self.gate, params["gate"], params["experts"], ffn_expert_fn,
+            x, rng, train)
+        if self.cfg.use_residual:
+            # PR-MoE: blend with a dense residual MLP via learned coefficients
+            r = params["residual_mlp"]
+            h = jax.nn.gelu(x @ r["wi"]["kernel"].astype(x.dtype) +
+                            r["wi"]["bias"].astype(x.dtype), approximate=True)
+            mlp_out = h @ r["wo"]["kernel"].astype(x.dtype) + \
+                r["wo"]["bias"].astype(x.dtype)
+            c = params["coefficient"]
+            coef = jax.nn.softmax(
+                (x @ c["kernel"].astype(x.dtype) + c["bias"].astype(x.dtype)),
+                axis=-1)
+            y = y * coef[..., 0:1] + mlp_out * coef[..., 1:2]
+        return y, l_aux, exp_counts
+
+
+def moe_partition_rules(prefix: str = "") -> list:
+    """Expert-parallel sharding: stacked expert leaves split on dim 0 over
+    the data axes (expert-data parallelism). Requires
+    num_experts % (data*fsdp) == 0 or falls back to replication via the
+    engine's divisibility checks."""
+    return [
+        PartitionRule(rf"{prefix}experts/(wi|wo)/kernel",
+                      P(("data", "fsdp"), None, None)),
+        PartitionRule(rf"{prefix}experts/(wi|wo)/bias",
+                      P(("data", "fsdp"), None)),
+    ]

@@ -1,0 +1,204 @@
+"""GShard-style sharded Mixture of Experts.
+
+Capability analog of the reference MoE layer
+(ref: deepspeed/moe/sharded_moe.py — MOELayer :432, TopKGate :344,
+top1gating :170, top2gating :271, _AllToAll :84). TPU-native design:
+
+- tokens are arranged [groups, tokens_per_group, d] with the group dim
+  sharded over the data axes; expert weights are stacked [E, ...] and
+  sharded over the SAME axes (expert-data parallelism, ref
+  utils/groups.py:107) — the dispatch/combine einsums then force XLA to
+  emit the all-to-all over ICI that the reference performs with the
+  explicit _AllToAll autograd function;
+- gating is pure jnp with static shapes: capacity-bounded one-hot dispatch
+  tensors, cumsum-based position assignment, load-balance auxiliary loss;
+- everything differentiates through jax.grad — no custom autograd.
+"""
+
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PyTree = Any
+
+
+class GateOutput(NamedTuple):
+    l_aux: jnp.ndarray          # load-balance loss (scalar)
+    combine: jnp.ndarray        # [G, S, E, C] float — combine weights
+    dispatch: jnp.ndarray       # [G, S, E, C] bool  — dispatch mask
+    exp_counts: jnp.ndarray     # [E] tokens routed per expert (pre-drop)
+
+
+def _one_hot(x, num_classes, dtype=jnp.float32):
+    return jax.nn.one_hot(x, num_classes, dtype=dtype)
+
+
+def _capacity(tokens_per_group: int, num_experts: int,
+              capacity_factor: float, min_capacity: int) -> int:
+    cap = int(np.ceil(tokens_per_group / num_experts * capacity_factor))
+    return max(cap, min_capacity)
+
+
+def top1gating(logits: jnp.ndarray,
+               capacity_factor: float = 1.0,
+               min_capacity: int = 4,
+               rng: Optional[jax.Array] = None,
+               noisy_gate_policy: Optional[str] = None,
+               drop_tokens: bool = True,
+               used_token_mask: Optional[jnp.ndarray] = None) -> GateOutput:
+    """Top-1 gating (ref: sharded_moe.py:170).
+
+    logits: [G, S, E]. Capacity C = ceil(S/E * cf). Tokens beyond an
+    expert's capacity are dropped (their combine weights are zero), with
+    optional RSample noise on routing (noisy_gate_policy='RSample').
+    """
+    G, S, E = logits.shape
+    if noisy_gate_policy == "RSample":
+        assert rng is not None
+        logits_w_noise = logits + jax.random.normal(rng, logits.shape)
+    else:
+        logits_w_noise = logits
+
+    gates = jax.nn.softmax(logits, axis=-1)                   # [G,S,E]
+    index1 = jnp.argmax(logits_w_noise, axis=-1)              # [G,S]
+    mask1 = _one_hot(index1, E)                               # [G,S,E]
+    if used_token_mask is not None:
+        mask1 = mask1 * used_token_mask[..., None]
+
+    # load-balance loss: E * mean_e(importance * load)
+    me = jnp.mean(gates, axis=1)                              # [G,E]
+    ce = jnp.mean(mask1, axis=1)                              # [G,E]
+    l_aux = jnp.mean(jnp.sum(me * ce, axis=-1)) * E
+
+    exp_counts = jnp.sum(mask1, axis=(0, 1))                  # [E]
+
+    if drop_tokens:
+        C = _capacity(S, E, capacity_factor, min_capacity)
+    else:
+        C = S
+    # position of each token within its expert's queue
+    locations1 = jnp.cumsum(mask1, axis=1) - mask1            # [G,S,E]
+    mask1 = mask1 * (locations1 < C)
+    loc1 = jnp.sum(locations1 * mask1, axis=-1)               # [G,S]
+
+    gate1 = jnp.sum(gates * mask1, axis=-1)                   # [G,S]
+
+    combine = (gate1[..., None, None] *
+               mask1[..., None] *
+               _one_hot(loc1.astype(jnp.int32), C)[..., None, :])               # [G,S,E,C]
+    dispatch = combine > 0
+    return GateOutput(l_aux.astype(jnp.float32), combine, dispatch, exp_counts)
+
+
+def top2gating(logits: jnp.ndarray,
+               capacity_factor: float = 1.0,
+               min_capacity: int = 4,
+               rng: Optional[jax.Array] = None,
+               drop_tokens: bool = True) -> GateOutput:
+    """Top-2 gating with normalized gate weights (ref: sharded_moe.py:271)."""
+    G, S, E = logits.shape
+    gates = jax.nn.softmax(logits, axis=-1)
+
+    index1 = jnp.argmax(gates, axis=-1)
+    mask1 = _one_hot(index1, E)
+    logits_except1 = jnp.where(mask1 > 0, -jnp.inf, logits)
+    index2 = jnp.argmax(logits_except1, axis=-1)
+    mask2 = _one_hot(index2, E)
+
+    # capacity
+    C = _capacity(S, E, 2 * capacity_factor, min_capacity) if drop_tokens else S
+
+    locations1 = jnp.cumsum(mask1, axis=1) - mask1
+    # second choices queue after ALL first choices of that expert
+    locations2 = jnp.cumsum(mask2, axis=1) - mask2 + \
+        jnp.sum(mask1, axis=1, keepdims=True)
+
+    me = jnp.mean(gates, axis=1)
+    ce = jnp.mean(mask1, axis=1)
+    l_aux = jnp.mean(jnp.sum(me * ce, axis=-1)) * E
+
+    exp_counts = jnp.sum(mask1 + mask2, axis=(0, 1))
+
+    mask1 = mask1 * (locations1 < C)
+    mask2 = mask2 * (locations2 < C)
+    loc1 = jnp.sum(locations1 * mask1, axis=-1)
+    loc2 = jnp.sum(locations2 * mask2, axis=-1)
+
+    gate1 = jnp.sum(gates * mask1, axis=-1)
+    gate2 = jnp.sum(gates * mask2, axis=-1)
+    denom = jnp.clip(gate1 + gate2, 1e-9, None)
+    gate1 /= denom
+    gate2 /= denom
+
+    combine = (gate1[..., None, None] * mask1[..., None] *
+               _one_hot(loc1.astype(jnp.int32), C)[..., None, :] +
+               gate2[..., None, None] * mask2[..., None] *
+               _one_hot(loc2.astype(jnp.int32), C)[..., None, :])
+    dispatch = combine > 0
+    return GateOutput(l_aux.astype(jnp.float32), combine, dispatch, exp_counts)
+
+
+class TopKGate:
+    """Gate config holder + apply (ref: sharded_moe.py:344 TopKGate)."""
+
+    def __init__(self, k: int = 1, capacity_factor: float = 1.0,
+                 eval_capacity_factor: float = 1.0, min_capacity: int = 4,
+                 noisy_gate_policy: Optional[str] = None,
+                 drop_tokens: bool = True):
+        assert k in (1, 2), "Only top-1 and top-2 gatings are supported"
+        self.k = k
+        self.capacity_factor = capacity_factor
+        self.eval_capacity_factor = eval_capacity_factor
+        self.min_capacity = min_capacity
+        self.noisy_gate_policy = noisy_gate_policy
+        self.drop_tokens = drop_tokens
+
+    @staticmethod
+    def init_params(rng, d_model: int, num_experts: int) -> Dict:
+        # fp32 gate weights (the reference keeps the gate in fp32 too)
+        w = jax.nn.initializers.normal(0.02)(rng, (d_model, num_experts),
+                                             jnp.float32)
+        return {"wg": w}
+
+    def __call__(self, params: Dict, x: jnp.ndarray,
+                 rng: Optional[jax.Array] = None,
+                 train: bool = True) -> GateOutput:
+        logits = x.astype(jnp.float32) @ params["wg"]
+        cf = self.capacity_factor if train else self.eval_capacity_factor
+        if self.k == 1:
+            return top1gating(logits, cf, self.min_capacity, rng,
+                              self.noisy_gate_policy if train else None,
+                              self.drop_tokens)
+        return top2gating(logits, cf, self.min_capacity, rng,
+                          self.drop_tokens)
+
+
+def moe_layer_apply(gate: TopKGate,
+                    gate_params: Dict,
+                    expert_params: PyTree,
+                    expert_fn,
+                    x: jnp.ndarray,
+                    rng: Optional[jax.Array] = None,
+                    train: bool = True) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """The MOELayer forward (ref: sharded_moe.py:480-540).
+
+    x: [G, S, d] (G sharded over data axes). expert_params leaves are
+    stacked [E, ...] (sharded over the same axes -> all-to-all).
+    expert_fn(expert_params, tokens[E, C_total, d]) -> [E, C_total, d],
+    vmapped over the expert dim.
+    Returns (y [G, S, d], l_aux, exp_counts).
+    """
+    out = gate(gate_params, x, rng, train)
+    dtype = x.dtype
+    dispatch = out.dispatch.astype(dtype)                     # [G,S,E,C]
+    # dispatch: -> [E, G*C, d]  (the einsum's resharding IS the all-to-all)
+    dispatched = jnp.einsum("gsec,gsm->egcm", dispatch, x)
+    E, G, C, d = dispatched.shape
+    dispatched = dispatched.reshape(E, G * C, d)
+    expert_out = expert_fn(expert_params, dispatched)         # [E, G*C, d]
+    expert_out = expert_out.reshape(E, G, C, d)
+    combined = jnp.einsum("gsec,egcm->gsm",
+                          out.combine.astype(dtype), expert_out)
+    return combined, out.l_aux, out.exp_counts
