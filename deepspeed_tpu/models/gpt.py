@@ -46,6 +46,10 @@ class GPTConfig:
     flash_block_q: int = 512
     flash_block_kv: int = 512
     tie_embeddings: bool = True
+    # sequence/context parallelism: shard the token dim over the 'sequence'
+    # mesh axis and run ring attention over ICI (set mesh too)
+    sequence_parallel: bool = False
+    mesh: Any = None
 
     @property
     def head_dim(self) -> int:
@@ -145,6 +149,9 @@ def _flash_eligible(cfg: GPTConfig, seq_len: int) -> bool:
 
 def _attention(q, k, v, cfg: GPTConfig):
     """Causal multi-head attention. q,k,v: [B, S, H, Dh]."""
+    if cfg.sequence_parallel and cfg.mesh is not None:
+        from deepspeed_tpu.ops.attention.ring import ring_attention
+        return ring_attention(q, k, v, cfg.mesh, causal=True)
     if _flash_eligible(cfg, q.shape[1]):
         from deepspeed_tpu.ops.attention.flash import flash_attention
         return flash_attention(q, k, v, causal=True,

@@ -1,0 +1,95 @@
+"""Ring attention — sequence/context parallelism over ICI.
+
+The reference version has NO sequence parallelism (SURVEY §2.2: absent at
+v0.6.4; its long-sequence story is block-sparse attention). This module is
+the modern TPU-native equivalent capability called for by BASELINE.md's
+north star: exact attention over sequences sharded across chips.
+
+Design (Ring Attention / blockwise attention):
+- the sequence dim of Q, K, V is sharded over the 'sequence' mesh axis;
+- each device computes attention of its local Q block against the K/V
+  block it currently holds, maintaining online-softmax running stats
+  (max, sum, accumulator) exactly like flash attention;
+- K/V blocks rotate around the ring via `lax.ppermute` each step, so after
+  n_seq steps every Q block has seen every K/V block; peak memory is
+  O(S/n) per chip and the rotation overlaps with compute via XLA's
+  latency-hiding scheduler;
+- causal masking uses global token positions, so the result is exactly
+  standard causal attention.
+"""
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _ring_attention_local(q, k, v, *, axis: str, causal: bool, scale: float):
+    """Inside shard_map: q,k,v local [B, S_loc, H, D]; returns [B,S_loc,H,D]."""
+    n = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    B, S_loc, H, D = q.shape
+    qf = q.astype(jnp.float32)
+
+    q_pos = idx * S_loc + jax.lax.broadcasted_iota(
+        jnp.int32, (S_loc, S_loc), 0)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, i):
+        k_cur, v_cur, m, l, acc = carry
+        # the block currently held originated at ring position (idx - i) % n
+        src = (idx - i) % n
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_cur.astype(jnp.float32)) * scale
+        if causal:
+            k_pos = src * S_loc + jax.lax.broadcasted_iota(
+                jnp.int32, (S_loc, S_loc), 1)
+            mask = q_pos[None, None] >= k_pos[None, None]
+            s = jnp.where(mask, s, -1e30)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)            # [B,H,Sq,1]
+        m_new = jnp.maximum(m, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.einsum("bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32))
+        acc_new = acc * alpha.transpose(0, 1, 2, 3) + pv
+        k_nxt = jax.lax.ppermute(k_cur, axis, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis, perm)
+        return (k_nxt, v_nxt, m_new, l_new, acc_new), None
+
+    m0 = jnp.full((B, H, S_loc, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((B, H, S_loc, 1), jnp.float32)
+    acc0 = jnp.zeros((B, H, S_loc, D), jnp.float32)
+    (_, _, m, l, acc), _ = jax.lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(n))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / l_safe).transpose(0, 2, 1, 3)                # [B,S_loc,H,D]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   mesh: Mesh, *, causal: bool = True,
+                   scale: Optional[float] = None,
+                   axis: str = "sequence") -> jnp.ndarray:
+    """Exact (causal) attention with the sequence dim sharded over ``axis``.
+
+    q,k,v: [B, S, H, D] global arrays whose S dim is (or will be) sharded
+    over the 'sequence' mesh axis. Batch/head dims stay auto-sharded.
+    """
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    inner = partial(_ring_attention_local, axis=axis, causal=causal,
+                    scale=scale)
+    spec = P(None, axis, None, None)
+    mapped = jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names={axis},
+        check_vma=False)
+    # partial-manual shard_map mis-canonicalizes out_specs when traced
+    # eagerly in this jax version; under jit it is correct — force it.
+    return jax.jit(mapped)(q, k, v)

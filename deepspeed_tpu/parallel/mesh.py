@@ -109,9 +109,21 @@ def axis_size(mesh: Mesh, axis: str) -> int:
     return shape.get(axis, 1)
 
 
-def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Sharding for a [batch, ...] input: split over both dp axes."""
-    return NamedSharding(mesh, P(BATCH_AXES))
+def batch_sharding(mesh: Mesh):
+    """Sharding fn for batch pytrees: leading dim over the dp axes, and —
+    when sequence parallelism is on — dim 1 (tokens) over 'sequence'."""
+    seq = axis_size(mesh, "sequence")
+
+    def shard_leaf(leaf):
+        shape = tuple(getattr(leaf, "shape", ()))
+        # token dim joins 'sequence' only when divisible (e.g. the +1-shifted
+        # LM input of length S+1 stays batch-sharded; the model's internal
+        # slice gets resharded by the ring attention's shard_map)
+        if seq > 1 and len(shape) >= 2 and shape[1] % seq == 0:
+            return NamedSharding(mesh, P(BATCH_AXES, "sequence"))
+        return NamedSharding(mesh, P(BATCH_AXES))
+
+    return shard_leaf
 
 
 def batch_pspec() -> P:

@@ -351,15 +351,21 @@ class DeepSpeedEngine:
             scale_state=jax.tree_util.tree_map(
                 lambda _: NamedSharding(self.mesh, P()), self.state.scale_state),
             rng=NamedSharding(self.mesh, P()))
-        batch_sh = mesh_lib.batch_sharding(self.mesh)
         metrics_sh = NamedSharding(self.mesh, P())
 
         self._state_shardings = state_shardings
+        self._batch_shard_leaf = mesh_lib.batch_sharding(self.mesh)
         return jax.jit(
             step_fn,
-            in_shardings=(state_shardings, batch_sh),
+            in_shardings=(state_shardings, None),  # batch: committed by _shard_batch
             out_shardings=(state_shardings, metrics_sh),
             donate_argnums=(0,) if donate_state else ())
+
+    def _shard_batch(self, batch: PyTree) -> PyTree:
+        """Place a host batch on the mesh: leading dim over the dp axes,
+        token dim over 'sequence' when sequence parallelism is active."""
+        shardings = jax.tree_util.tree_map(self._batch_shard_leaf, batch)
+        return jax.device_put(batch, shardings)
 
     def _build_eval_step(self):
         compute_dtype = self.compute_dtype
@@ -373,8 +379,7 @@ class DeepSpeedEngine:
 
         return jax.jit(
             eval_fn,
-            in_shardings=(self.param_shardings, mesh_lib.batch_sharding(self.mesh),
-                          NamedSharding(self.mesh, P())),
+            in_shardings=(self.param_shardings, None, None),
             out_shardings=NamedSharding(self.mesh, P()))
 
     # ------------------------------------------------------------------
@@ -386,6 +391,7 @@ class DeepSpeedEngine:
         forward+backward+step triple into one XLA program."""
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
+        batch = self._shard_batch(batch)
         self.state, metrics = self._train_step(self.state, batch)
         self.timers(TRAIN_BATCH_TIMER).stop()
         self.tput_timer.stop(global_step=True)
@@ -405,12 +411,12 @@ class DeepSpeedEngine:
     def forward(self, batch, rng: Optional[jax.Array] = None):
         """Inference/eval forward (loss only; ref: engine.py:1523)."""
         rng = rng if rng is not None else jax.random.PRNGKey(0)
-        loss, _ = self._eval_step(self.state.params, batch, rng)
+        loss, _ = self._eval_step(self.state.params, self._shard_batch(batch), rng)
         return loss
 
     def eval_batch(self, batch, rng: Optional[jax.Array] = None):
         rng = rng if rng is not None else jax.random.PRNGKey(0)
-        return self._eval_step(self.state.params, batch, rng)
+        return self._eval_step(self.state.params, self._shard_batch(batch), rng)
 
     def backward(self, loss):  # pragma: no cover - API parity shim
         raise RuntimeError(

@@ -1,0 +1,85 @@
+"""Ring-attention (sequence parallelism) tests on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.ops.attention.flash import mha_reference
+from deepspeed_tpu.ops.attention.ring import ring_attention
+from deepspeed_tpu.parallel.mesh import MeshSpec, make_mesh
+
+
+def _qkv(B=2, S=64, H=2, D=16, seed=0, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return tuple(jax.random.normal(k, (B, S, H, D), dtype) for k in ks)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_matches_dense(devices, causal):
+    q, k, v = _qkv()
+    mesh = make_mesh(MeshSpec(data=1, sequence=8))
+    out = ring_attention(q, k, v, mesh, causal=causal)
+    ref = mha_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ring_grads_match_dense(devices):
+    q, k, v = _qkv(B=1, S=32, H=2, D=8)
+    mesh = make_mesh(MeshSpec(data=1, sequence=8))
+
+    g_ring = jax.grad(lambda q, k, v: jnp.sum(
+        ring_attention(q, k, v, mesh, causal=True) ** 2), argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(lambda q, k, v: jnp.sum(
+        mha_reference(q, k, v, causal=True) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for a, b, n in zip(g_ring, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5, err_msg=n)
+
+
+def test_ring_with_data_parallel_axes(devices):
+    """sequence=4 combined with data=2."""
+    q, k, v = _qkv(S=32)
+    mesh = make_mesh(MeshSpec(data=2, sequence=4))
+    out = ring_attention(q, k, v, mesh, causal=True)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_sequence_parallel_gpt_trains(devices):
+    """GPT with sequence_parallel: loss matches dense-GPT loss and trains."""
+    from deepspeed_tpu.models import gpt
+    mesh = make_mesh(MeshSpec(data=2, sequence=4))
+    cfg = gpt.GPTConfig(vocab_size=128, n_layers=2, n_heads=4, d_model=32,
+                        max_seq_len=64, use_flash_attention=False,
+                        remat=False, dtype=jnp.float32,
+                        sequence_parallel=True, mesh=mesh)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+
+    cfg_dense = gpt.GPTConfig(vocab_size=128, n_layers=2, n_heads=4,
+                              d_model=32, max_seq_len=64,
+                              use_flash_attention=False, remat=False,
+                              dtype=jnp.float32)
+    tokens = np.random.default_rng(0).integers(0, 128, (8, 65)).astype(np.int32)
+    ref = float(gpt.loss_fn(params, {"tokens": jnp.asarray(tokens)},
+                            jax.random.PRNGKey(0), cfg_dense,
+                            deterministic=True))
+
+    ds = {"train_batch_size": 8,
+          "mesh": {"sequence_parallel_size": 4},
+          "optimizer": {"type": "adamw", "params": {"lr": 3e-3}},
+          "steps_per_print": 1000}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt.make_loss_fn(cfg), model_parameters=params, config=ds,
+        mesh=mesh)
+    losses = [float(engine.train_batch({"tokens": tokens})["loss"])
+              for _ in range(8)]
+    np.testing.assert_allclose(losses[0], ref, rtol=1e-4)
+    assert losses[-1] < losses[0] - 0.3
+    # divisible token arrays get sequence-sharded (the 65-long shifted input
+    # intentionally stays batch-only)
+    sharded = engine._shard_batch({"x": tokens[:, :64]})
+    assert sharded["x"].sharding.shard_shape((8, 64))[1] == 16
