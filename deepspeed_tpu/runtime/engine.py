@@ -121,6 +121,8 @@ class DeepSpeedEngine:
 
         self.dp_world_size = mesh_lib.dp_world_size(self.mesh)
         self.mp_world_size = mesh_lib.axis_size(self.mesh, "model")
+        from deepspeed_tpu.utils import groups as groups_lib
+        groups_lib.set_mesh(self.mesh)
 
         # --- precision ------------------------------------------------
         self.compute_dtype = config.compute_dtype

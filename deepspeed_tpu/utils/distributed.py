@@ -1,0 +1,96 @@
+"""Multi-host distributed bootstrap.
+
+Capability analog of the reference's init_distributed
+(ref: deepspeed/utils/distributed.py:12 init_distributed, :56 mpi_discovery).
+On TPU pods there is no NCCL rendezvous: `jax.distributed.initialize` joins
+the JAX runtime across hosts (GCE metadata auto-discovery on Cloud TPU, or
+env/args for manual setups), after which `jax.devices()` spans the pod and
+ONE global mesh replaces all process groups.
+"""
+
+import os
+from typing import Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+_initialized = False
+
+
+def init_distributed(dist_backend: str = "xla",
+                     coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None,
+                     auto_mpi_discovery: bool = True,
+                     timeout: Optional[int] = None,
+                     init_method: Optional[str] = None) -> bool:
+    """Join the multi-host runtime. Safe to call multiple times.
+
+    Resolution order (mirrors the reference's env:// + MPI discovery):
+      1. explicit args,
+      2. OMPI_* env (MPI launches, ref mpi_discovery :56),
+      3. DSTPU_* / standard env vars,
+      4. single-process fallback (no-op).
+    """
+    global _initialized
+    if _initialized:
+        return True
+    del dist_backend, init_method  # XLA collectives only; kept for API parity
+
+    import jax
+
+    if coordinator_address is None:
+        if "OMPI_COMM_WORLD_SIZE" in os.environ and auto_mpi_discovery:
+            num_processes = int(os.environ["OMPI_COMM_WORLD_SIZE"])
+            process_id = int(os.environ["OMPI_COMM_WORLD_RANK"])
+            coordinator_address = os.environ.get("MASTER_ADDR", "127.0.0.1") + \
+                ":" + os.environ.get("MASTER_PORT", "29500")
+        elif "DSTPU_COORDINATOR" in os.environ:
+            coordinator_address = os.environ["DSTPU_COORDINATOR"]
+            num_processes = int(os.environ.get("DSTPU_NUM_PROCESSES", "1"))
+            process_id = int(os.environ.get("DSTPU_PROCESS_ID", "0"))
+
+    try:
+        if coordinator_address is not None:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id)
+        elif os.environ.get("TPU_WORKER_HOSTNAMES") or \
+                os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):
+            # Cloud TPU pod: args auto-discovered from metadata
+            jax.distributed.initialize()
+        else:
+            logger.info("single-process mode (no coordinator configured)")
+            _initialized = True
+            return True
+    except Exception as e:  # already initialized or single-host
+        logger.warning(f"jax.distributed.initialize skipped: {e}")
+    _initialized = True
+    logger.info(
+        f"distributed runtime up: process {get_rank()}/{get_world_size()} "
+        f"with {len(jax.local_devices())} local / "
+        f"{len(jax.devices())} global devices")
+    return True
+
+
+def get_rank() -> int:
+    import jax
+    return jax.process_index()
+
+
+def get_world_size() -> int:
+    import jax
+    return jax.process_count()
+
+
+def get_local_rank() -> int:
+    return int(os.environ.get("DSTPU_LOCAL_RANK", "0"))
+
+
+def barrier():
+    """Host-level barrier via a trivial global psum."""
+    import jax
+    import jax.numpy as jnp
+    jax.block_until_ready(
+        jax.pmap(lambda x: jax.lax.psum(x, "i"), axis_name="i")(
+            jnp.ones((jax.local_device_count(),))))

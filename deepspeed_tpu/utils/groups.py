@@ -1,0 +1,92 @@
+"""Mesh-axis accessors — the dissolution of process groups.
+
+The reference maintains dictionaries of torch process groups
+(ref: deepspeed/utils/groups.py:305 _clone_world_group, :321
+_get_data_parallel_group, expert groups :107/:160/:206). On TPU a single
+named-axis Mesh subsumes them; this module provides the same *query*
+surface (sizes/ranks per parallel dimension) against a registered mesh so
+user code migrating from the reference keeps its call sites.
+"""
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh
+
+from deepspeed_tpu.parallel import mesh as mesh_lib
+
+_MESH: Optional[Mesh] = None
+
+
+def set_mesh(mesh: Mesh) -> None:
+    global _MESH
+    _MESH = mesh
+
+
+def get_mesh() -> Mesh:
+    assert _MESH is not None, \
+        "no mesh registered — deepspeed_tpu.initialize() does this"
+    return _MESH
+
+
+def _axis(axis: str) -> int:
+    return mesh_lib.axis_size(get_mesh(), axis)
+
+
+# --- world ---------------------------------------------------------------
+
+def get_world_size() -> int:
+    return int(get_mesh().devices.size)
+
+
+def get_global_rank() -> int:
+    return jax.process_index()
+
+
+# --- data parallel (ref :321) -------------------------------------------
+
+def get_data_parallel_world_size() -> int:
+    return mesh_lib.dp_world_size(get_mesh())
+
+
+def get_data_parallel_group() -> tuple:
+    """On TPU the "group" IS the axis names."""
+    return ("data", "fsdp")
+
+
+# --- model parallel ------------------------------------------------------
+
+def get_model_parallel_world_size() -> int:
+    return _axis("model")
+
+
+def get_model_parallel_group() -> tuple:
+    return ("model",)
+
+
+# --- pipeline ------------------------------------------------------------
+
+def get_pipe_parallel_world_size() -> int:
+    return _axis("pipe")
+
+
+# --- sequence ------------------------------------------------------------
+
+def get_sequence_parallel_world_size() -> int:
+    return _axis("sequence")
+
+
+# --- expert parallel (ref :107/:160/:206) --------------------------------
+
+def get_expert_parallel_world_size(num_experts: Optional[int] = None) -> int:
+    """Experts shard over the dp axes; the EP degree is min(dp, experts)."""
+    dp = get_data_parallel_world_size()
+    if num_experts is None:
+        return dp
+    return min(dp, num_experts)
+
+
+def get_expert_data_parallel_world_size(num_experts: int) -> int:
+    dp = get_data_parallel_world_size()
+    ep = get_expert_parallel_world_size(num_experts)
+    return max(1, dp // ep)

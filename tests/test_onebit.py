@@ -1,0 +1,116 @@
+"""1-bit compression + compressed-optimizer tests
+(ref: tests/unit/test_onebit.py, tests/onebit/test_nccl_backend.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.parallel.compressed import (_pack_signs, _unpack_signs,
+                                               compress, compressed_allreduce,
+                                               compression_ratio)
+from deepspeed_tpu.parallel.mesh import MeshSpec, make_mesh
+from tests.simple_model import random_batch, simple_model_loss, simple_model_params
+
+
+def test_pack_unpack_roundtrip(devices):
+    x = jax.random.normal(jax.random.PRNGKey(0), (1000,))
+    packed = _pack_signs(x)
+    assert packed.dtype == jnp.uint8
+    assert packed.shape[0] == 125
+    signs = _unpack_signs(packed, 1000)
+    np.testing.assert_array_equal(np.asarray(signs),
+                                  np.where(np.asarray(x) >= 0, 1.0, -1.0))
+
+
+def test_compress_error_feedback(devices):
+    """compressed + error == corrected (lossless accounting)."""
+    x = jax.random.normal(jax.random.PRNGKey(0), (64, 32))
+    e0 = jnp.zeros_like(x)
+    packed, scale, err = compress(x, e0)
+    from deepspeed_tpu.parallel.compressed import decompress
+    comp = decompress(packed, scale, x.size, x.shape)
+    np.testing.assert_allclose(np.asarray(comp + err), np.asarray(x),
+                               rtol=1e-5, atol=1e-6)
+    # scale is the L1 mean
+    assert abs(float(scale) - float(jnp.mean(jnp.abs(x)))) < 1e-5
+
+
+def test_compression_ratio(devices):
+    assert compression_ratio((1024, 1024)) > 25  # ~32x for fp32
+
+
+def test_compressed_allreduce_approximates_mean(devices):
+    """Across 8 ranks: compressed allreduce ~ true mean in direction, and
+    error feedback accumulates the residual."""
+    mesh = make_mesh(MeshSpec(data=8))
+    x = jax.random.normal(jax.random.PRNGKey(0), (256,))
+    err = jnp.zeros_like(x)
+    out, new_err = compressed_allreduce({"g": x}, {"g": err}, mesh)
+    # every rank contributed the same value -> result == sign(x)*scale
+    _, scale, _ = compress(x, err)
+    expect = np.where(np.asarray(x) >= 0, 1.0, -1.0) * float(scale)
+    np.testing.assert_allclose(np.asarray(out["g"]), expect, rtol=1e-4)
+    # error + compressed == original
+    np.testing.assert_allclose(np.asarray(out["g"] + new_err["g"]),
+                               np.asarray(x), rtol=1e-4, atol=1e-5)
+
+
+HIDDEN = 32
+BASE = {
+    "train_batch_size": 16,
+    "steps_per_print": 1000,
+}
+
+
+def _train(opt_cfg, steps=40):
+    cfg = dict(BASE)
+    cfg["optimizer"] = opt_cfg
+    params = simple_model_params(hidden_dim=HIDDEN, nlayers=2)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_model_loss, model_parameters=params, config=cfg)
+    losses = []
+    for i in range(steps):
+        m = engine.train_batch(random_batch(16, HIDDEN, seed=i % 4))
+        losses.append(float(m["loss"]))
+    return losses
+
+
+def test_onebit_adam_converges(devices):
+    """1-bit Adam tracks Adam convergence after warmup
+    (ref: test_onebit.py convergence pattern)."""
+    adam = _train({"type": "adamw", "params": {"lr": 1e-2}})
+    onebit = _train({"type": "onebitadam",
+                     "params": {"lr": 1e-2, "freeze_step": 10}})
+    assert onebit[-1] < onebit[0] * 0.6
+    # within 2x of adam's final loss
+    assert onebit[-1] < max(adam[-1] * 2.0, 0.1)
+
+
+def test_zero_one_adam_converges(devices):
+    losses = _train({"type": "zerooneadam",
+                     "params": {"lr": 1e-2, "var_freeze_step": 20}})
+    assert losses[-1] < losses[0] * 0.6
+
+
+def test_onebit_lamb_converges(devices):
+    losses = _train({"type": "onebitlamb",
+                     "params": {"lr": 1e-2, "freeze_step": 10}})
+    assert losses[-1] < losses[0] * 0.7
+
+
+def test_variance_frozen_after_freeze_step(devices):
+    """nu must stop changing after freeze_step."""
+    from deepspeed_tpu.runtime.comm.onebit import onebit_adam
+    opt = onebit_adam(1e-2, freeze_step=3)
+    params = {"w": jnp.ones((4,))}
+    state = opt.init(params)
+    g = {"w": jnp.full((4,), 0.5)}
+    nus = []
+    for i in range(6):
+        upd, state = opt.update(g, state, params)
+        nus.append(np.asarray(state.nu["w"]).copy())
+    assert not np.allclose(nus[1], nus[2])   # still warming up
+    np.testing.assert_array_equal(nus[3], nus[4])  # frozen
+    np.testing.assert_array_equal(nus[4], nus[5])
