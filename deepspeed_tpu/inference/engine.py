@@ -1,0 +1,273 @@
+"""Inference engine: TP-sharded KV-cache generation.
+
+Capability analog of the reference inference stack
+(ref: deepspeed/inference/engine.py:23 InferenceEngine — MP group creation
+:143, injection :225, checkpoint load :281, forward :355; fused kernel
+modules ops/transformer/inference/transformer_inference.py:113/408/549 with
+KV-cache management via the global Context workspace). TPU-native design:
+
+- "kernel injection" = running the model through our fused JAX/Pallas GPT
+  blocks (flash attention prefill, fused decode attention); policies
+  (inference/policy.py) map foreign checkpoints (HF GPT-2 et al) into this
+  layout — the analog of replace_transformer_layer
+  (module_inject/replace_module.py:123);
+- tensor parallelism = the same Megatron partition rules as training; the
+  attn/MLP output allreduces the reference issues by hand
+  (LinearAllreduce, transformer_inference.py MP allreduce) come from XLA;
+- the KV cache is a preallocated [L, B, S_max, H, D] pytree threaded
+  functionally through a jitted, cache-donating decode step; generation is
+  a host loop over compiled prefill + decode programs.
+"""
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.models import gpt as gpt_lib
+from deepspeed_tpu.models.gpt import GPTConfig, _layernorm
+from deepspeed_tpu.parallel import mesh as mesh_lib
+from deepspeed_tpu.parallel import sharding as sharding_lib
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+PyTree = Any
+
+
+@dataclass
+class InferenceConfig:
+    mp_size: int = 1
+    dtype: Any = jnp.bfloat16
+    max_seq_len: int = 2048
+    max_batch_size: int = 8
+    replace_with_kernel_inject: bool = True   # API parity; always fused here
+
+
+def _split_heads(t, B, S, H, Dh):
+    return t.reshape(B, S, H, Dh)
+
+
+def _block_prefill(x, p, cfg: GPTConfig):
+    """Forward one block over the full prompt, returning (y, k, v)."""
+    B, S, D = x.shape
+    H, Dh = cfg.n_heads, cfg.head_dim
+    h = _layernorm(x, p["ln1"]["scale"], p["ln1"]["bias"])
+    qkv = h @ p["qkv"]["kernel"].astype(h.dtype) + p["qkv"]["bias"].astype(h.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q, k, v = (_split_heads(t, B, S, H, Dh) for t in (q, k, v))
+    attn = gpt_lib._attention(q, k, v, cfg).reshape(B, S, D)
+    attn = attn @ p["attn_out"]["kernel"].astype(attn.dtype) + \
+        p["attn_out"]["bias"].astype(attn.dtype)
+    x = x + attn
+    h = _layernorm(x, p["ln2"]["scale"], p["ln2"]["bias"])
+    h = h @ p["mlp_in"]["kernel"].astype(h.dtype) + p["mlp_in"]["bias"].astype(h.dtype)
+    h = jax.nn.gelu(h, approximate=True)
+    h = h @ p["mlp_out"]["kernel"].astype(h.dtype) + p["mlp_out"]["bias"].astype(h.dtype)
+    return x + h, k, v
+
+
+def _block_decode(x, k_cache, v_cache, pos, p, cfg: GPTConfig):
+    """One block for ONE new token. x: [B, 1, D]; caches [B, S_max, H, Dh].
+    Fused decode attention with positional masking over the cache
+    (ref: softmax_context + KV-cache path, transformer_inference.py:113)."""
+    B, _, D = x.shape
+    H, Dh = cfg.n_heads, cfg.head_dim
+    S_max = k_cache.shape[1]
+
+    h = _layernorm(x, p["ln1"]["scale"], p["ln1"]["bias"])
+    qkv = h @ p["qkv"]["kernel"].astype(h.dtype) + p["qkv"]["bias"].astype(h.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, H, Dh)
+    k_cache = jax.lax.dynamic_update_slice_in_dim(
+        k_cache, k.reshape(B, 1, H, Dh), pos, axis=1)
+    v_cache = jax.lax.dynamic_update_slice_in_dim(
+        v_cache, v.reshape(B, 1, H, Dh), pos, axis=1)
+
+    scores = jnp.einsum("bhd,bshd->bhs", q, k_cache).astype(jnp.float32)
+    scores *= 1.0 / np.sqrt(Dh)
+    idx = jax.lax.broadcasted_iota(jnp.int32, (1, 1, S_max), 2)
+    scores = jnp.where(idx <= pos, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    attn = jnp.einsum("bhs,bshd->bhd", probs, v_cache).reshape(B, 1, D)
+    attn = attn @ p["attn_out"]["kernel"].astype(attn.dtype) + \
+        p["attn_out"]["bias"].astype(attn.dtype)
+    x = x + attn
+
+    h = _layernorm(x, p["ln2"]["scale"], p["ln2"]["bias"])
+    h = h @ p["mlp_in"]["kernel"].astype(h.dtype) + p["mlp_in"]["bias"].astype(h.dtype)
+    h = jax.nn.gelu(h, approximate=True)
+    h = h @ p["mlp_out"]["kernel"].astype(h.dtype) + p["mlp_out"]["bias"].astype(h.dtype)
+    return x + h, k_cache, v_cache
+
+
+class InferenceEngine:
+    """Generation engine over a GPT-layout parameter pytree.
+
+    Construct via ``deepspeed_tpu.init_inference(model=...)`` where model is
+    either (GPTConfig, params) from this framework or anything a policy in
+    inference/policy.py can convert (e.g. an HF GPT-2 checkpoint).
+    """
+
+    def __init__(self, model=None, *, config: Optional[GPTConfig] = None,
+                 params: Optional[PyTree] = None, mp_size: int = 1,
+                 dtype=jnp.bfloat16, max_seq_len: Optional[int] = None,
+                 mesh: Optional[Mesh] = None,
+                 replace_with_kernel_inject: bool = True,
+                 checkpoint: Optional[str] = None, **kwargs):
+        if model is not None and (config is None or params is None):
+            from deepspeed_tpu.inference.policy import resolve_model
+            config, params = resolve_model(model)
+        assert config is not None and params is not None, \
+            "need (config, params) or a model a policy understands"
+        self.cfg = config
+        self.dtype = dtype
+        self.max_seq_len = max_seq_len or config.max_seq_len
+        self.mp_size = mp_size
+        self.latency_ms: Dict[str, float] = {}
+
+        if mesh is None:
+            n = len(jax.devices())
+            assert n % mp_size == 0, (n, mp_size)
+            mesh = mesh_lib.make_mesh(
+                mesh_lib.MeshSpec(data=n // mp_size, model=mp_size))
+        self.mesh = mesh
+
+        if checkpoint is not None:
+            from deepspeed_tpu.runtime.checkpointing import \
+                load_fp32_state_dict_from_zero_checkpoint
+            params = load_fp32_state_dict_from_zero_checkpoint(checkpoint)
+
+        # dtype conversion (ref: engine.py:335 _convert_to_dtype) + TP placement
+        params = jax.tree_util.tree_map(
+            lambda x: jnp.asarray(x, dtype) if jnp.issubdtype(
+                jnp.asarray(x).dtype, jnp.floating) else jnp.asarray(x),
+            params)
+        rules = gpt_lib.gpt_partition_rules() if mp_size > 1 else []
+        pspecs = sharding_lib.param_specs(params, mesh, zero_stage=0,
+                                          rules=rules)
+        self.params = jax.device_put(
+            params, sharding_lib.to_named(pspecs, mesh))
+
+        self._prefill = jax.jit(self._prefill_fn)
+        self._decode = jax.jit(self._decode_fn, donate_argnums=(1,))
+        log_dist(f"inference engine: {config.n_layers}L/{config.d_model}d "
+                 f"mp={mp_size} dtype={jnp.dtype(dtype).name}", ranks=[0])
+
+    # ------------------------------------------------------------------
+    def _embed(self, tokens):
+        S = tokens.shape[1]
+        wte = self.params["wte"]["embedding"]
+        wpe = self.params["wpe"]["embedding"]
+        return wte[tokens] + wpe[:S][None]
+
+    def _logits(self, x):
+        x = _layernorm(x, self.params["ln_f"]["scale"],
+                       self.params["ln_f"]["bias"])
+        if self.cfg.tie_embeddings:
+            return x @ self.params["wte"]["embedding"].T
+        return x @ self.params["lm_head"]["kernel"]
+
+    def _prefill_fn(self, params, tokens):
+        """Run the prompt, build the cache, return last-position logits."""
+        cfg = self.cfg
+        B, S = tokens.shape
+        x = self._embed(tokens)
+        S_max = self.max_seq_len
+        H, Dh = cfg.n_heads, cfg.head_dim
+
+        def body(x, layer_p):
+            y, k, v = _block_prefill(x, layer_p, cfg)
+            return y, (k, v)
+
+        x, (ks, vs) = jax.lax.scan(body, x, params["block"])
+        # ks: [L, B, S, H, Dh] -> pad to S_max
+        pad = [(0, 0), (0, 0), (0, S_max - S), (0, 0), (0, 0)]
+        k_cache = jnp.pad(ks, pad)
+        v_cache = jnp.pad(vs, pad)
+        logits = self._logits(x[:, -1:])
+        return logits, {"k": k_cache, "v": v_cache}
+
+    def _decode_fn(self, params, cache, token, pos):
+        """One token step. token: [B, 1]; pos: scalar int."""
+        cfg = self.cfg
+        wte = params["wte"]["embedding"]
+        wpe = params["wpe"]["embedding"]
+        x = wte[token] + jax.lax.dynamic_slice_in_dim(wpe, pos, 1)[None]
+
+        def body(x, layer):
+            layer_p, kc, vc = layer
+            y, kc, vc = _block_decode(x, kc, vc, pos, layer_p, cfg)
+            return y, (kc, vc)
+
+        x, (ks, vs) = jax.lax.scan(body, x,
+                                   (params["block"], cache["k"], cache["v"]))
+        logits = self._logits(x)
+        return logits, {"k": ks, "v": vs}
+
+    # ------------------------------------------------------------------
+    def forward(self, tokens) -> jnp.ndarray:
+        """Full-sequence logits (ref: engine.py:355 forward)."""
+        import time
+        t0 = time.perf_counter()
+        tokens = jnp.asarray(tokens, jnp.int32)
+        x = self._embed(tokens)
+
+        def body(x, layer_p):
+            y, _, _ = _block_prefill(x, layer_p, self.cfg)
+            return y, None
+
+        x, _ = jax.jit(lambda p, x: jax.lax.scan(
+            lambda c, l: (_block_prefill(c, l, self.cfg)[0], None),
+            x, p["block"]))(self.params, x)
+        out = self._logits(x)
+        jax.block_until_ready(out)
+        self.latency_ms["forward"] = (time.perf_counter() - t0) * 1e3
+        return out
+
+    def __call__(self, tokens):
+        return self.forward(tokens)
+
+    def generate(self, tokens, max_new_tokens: int = 32,
+                 temperature: float = 0.0, top_k: int = 0,
+                 seed: int = 0) -> np.ndarray:
+        """Greedy (temperature=0) or sampled generation."""
+        import time
+        tokens = jnp.asarray(tokens, jnp.int32)
+        B, S = tokens.shape
+        assert S + max_new_tokens <= self.max_seq_len
+
+        t0 = time.perf_counter()
+        logits, cache = self._prefill(self.params, tokens)
+        jax.block_until_ready(logits)
+        self.latency_ms["prefill"] = (time.perf_counter() - t0) * 1e3
+
+        rng = jax.random.PRNGKey(seed)
+        out = [np.asarray(tokens)]
+
+        def pick(logits, rng):
+            logits = logits[:, -1].astype(jnp.float32)
+            if temperature <= 0.0:
+                return jnp.argmax(logits, axis=-1)
+            logits = logits / temperature
+            if top_k > 0:
+                kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+                logits = jnp.where(logits < kth, -1e30, logits)
+            return jax.random.categorical(rng, logits, axis=-1)
+
+        t0 = time.perf_counter()
+        token = pick(logits, rng)
+        for i in range(max_new_tokens):
+            out.append(np.asarray(token)[:, None])
+            if i == max_new_tokens - 1:
+                break
+            rng, r = jax.random.split(rng)
+            logits, cache = self._decode(self.params, cache,
+                                         token[:, None],
+                                         jnp.asarray(S + i, jnp.int32))
+            token = pick(logits, r)
+        self.latency_ms["decode_per_token"] = \
+            (time.perf_counter() - t0) * 1e3 / max(1, max_new_tokens - 1)
+        return np.concatenate(out, axis=1)

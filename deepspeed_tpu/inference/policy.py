@@ -1,0 +1,112 @@
+"""Injection policies: foreign checkpoints -> fused TPU layout.
+
+Capability analog of the reference's policy registry
+(ref: deepspeed/module_inject/replace_policy.py — HFBertLayerPolicy :49,
+HFGPTNEOLayerPolicy :112, HFGPTJLayerPolicy :157, MegatronLayerPolicy :202,
+HFGPT2LayerPolicy; applied by replace_transformer_layer
+module_inject/replace_module.py:123). Instead of swapping nn.Modules
+in-place, a policy converts a source model's weights into the framework's
+stacked-layer GPT pytree, after which the fused JAX/Pallas blocks and TP
+partition rules apply unchanged.
+"""
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.models.gpt import GPTConfig
+from deepspeed_tpu.utils.logging import logger
+
+_POLICIES = {}
+
+
+def register_policy(name: str):
+    def deco(cls):
+        _POLICIES[name] = cls
+        return cls
+    return deco
+
+
+def resolve_model(model) -> Tuple[GPTConfig, Dict]:
+    """Dispatch a user-passed model object/name to a policy."""
+    if isinstance(model, tuple) and len(model) == 2:
+        return model  # (config, params) passthrough
+    for policy in _POLICIES.values():
+        if policy.matches(model):
+            return policy.convert(model)
+    raise ValueError(
+        f"no inference policy matches {type(model)}; known: "
+        f"{list(_POLICIES)}")
+
+
+@register_policy("hf_gpt2")
+class HFGPT2Policy:
+    """HuggingFace GPT-2 (torch) -> fused GPT layout
+    (ref: HFGPT2LayerPolicy in replace_policy.py)."""
+
+    @staticmethod
+    def matches(model) -> bool:
+        return type(model).__name__ in ("GPT2LMHeadModel", "GPT2Model")
+
+    @staticmethod
+    def convert(model) -> Tuple[GPTConfig, Dict]:
+        import jax.numpy as jnp
+        hf_cfg = model.config
+        cfg = GPTConfig(
+            vocab_size=hf_cfg.vocab_size,
+            n_layers=hf_cfg.n_layer,
+            n_heads=hf_cfg.n_head,
+            d_model=hf_cfg.n_embd,
+            max_seq_len=hf_cfg.n_positions,
+            tie_embeddings=True)
+        sd = {k: v.detach().cpu().numpy() for k, v in model.state_dict().items()}
+        pre = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+
+        def stack(fmt):
+            return jnp.asarray(np.stack(
+                [sd[pre + fmt.format(i)] for i in range(cfg.n_layers)]))
+
+        params = {
+            "wte": {"embedding": jnp.asarray(sd[pre + "wte.weight"])},
+            "wpe": {"embedding": jnp.asarray(sd[pre + "wpe.weight"])},
+            "block": {
+                "ln1": {"scale": stack("h.{}.ln_1.weight"),
+                        "bias": stack("h.{}.ln_1.bias")},
+                # HF GPT-2 uses Conv1D: weight already [in, out]
+                "qkv": {"kernel": stack("h.{}.attn.c_attn.weight"),
+                        "bias": stack("h.{}.attn.c_attn.bias")},
+                "attn_out": {"kernel": stack("h.{}.attn.c_proj.weight"),
+                             "bias": stack("h.{}.attn.c_proj.bias")},
+                "ln2": {"scale": stack("h.{}.ln_2.weight"),
+                        "bias": stack("h.{}.ln_2.bias")},
+                "mlp_in": {"kernel": stack("h.{}.mlp.c_fc.weight"),
+                           "bias": stack("h.{}.mlp.c_fc.bias")},
+                "mlp_out": {"kernel": stack("h.{}.mlp.c_proj.weight"),
+                            "bias": stack("h.{}.mlp.c_proj.bias")},
+            },
+            "ln_f": {"scale": jnp.asarray(sd[pre + "ln_f.weight"]),
+                     "bias": jnp.asarray(sd[pre + "ln_f.bias"])},
+        }
+        logger.info(f"injected HF GPT-2: {cfg.n_layers}L/{cfg.d_model}d")
+        return cfg, params
+
+
+@register_policy("gpt_tuple")
+class NativePolicy:
+    """Our own (GPTConfig, params) tuples."""
+
+    @staticmethod
+    def matches(model) -> bool:
+        return (isinstance(model, tuple) and len(model) == 2 and
+                isinstance(model[0], GPTConfig))
+
+    @staticmethod
+    def convert(model):
+        return model
+
+
+def revert_transformer_layer(*a, **k):  # pragma: no cover
+    """The reference's reverse op (replace_module.py:732) is meaningless
+    here: conversion is out-of-place; the source model is untouched."""
+    raise NotImplementedError(
+        "conversion is out-of-place; the original model object is unchanged")

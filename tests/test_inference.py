@@ -1,0 +1,104 @@
+"""Inference-engine tests: decode==prefill parity, greedy generation,
+TP inference, HF GPT-2 injection parity (ref: tests for
+inference/engine.py + module_inject)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.inference.engine import InferenceEngine
+from deepspeed_tpu.models import gpt
+
+
+def tiny():
+    cfg = gpt.GPTConfig(vocab_size=128, n_layers=2, n_heads=4, d_model=32,
+                        max_seq_len=64, use_flash_attention=False,
+                        remat=False, dtype=jnp.float32)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def test_forward_matches_training_model(devices):
+    cfg, params = tiny()
+    eng = InferenceEngine(config=cfg, params=params, dtype=jnp.float32)
+    tokens = np.random.default_rng(0).integers(0, 128, (2, 16)).astype(np.int32)
+    out = eng.forward(tokens)
+    ref = gpt.forward(params, jnp.asarray(tokens), cfg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_decode_matches_prefill(devices):
+    """Token-by-token decode must reproduce full-sequence logits."""
+    cfg, params = tiny()
+    eng = InferenceEngine(config=cfg, params=params, dtype=jnp.float32)
+    rng = np.random.default_rng(1)
+    tokens = rng.integers(0, 128, (1, 8)).astype(np.int32)
+
+    # greedy continuation via generate (prefill + decode path)
+    gen = eng.generate(tokens, max_new_tokens=5, temperature=0.0)
+
+    # reference: greedy argmax with full forward each step
+    cur = tokens.copy()
+    for _ in range(5):
+        logits = np.asarray(gpt.forward(params, jnp.asarray(cur), cfg))
+        nxt = logits[:, -1].argmax(-1)[:, None].astype(np.int32)
+        cur = np.concatenate([cur, nxt], axis=1)
+    np.testing.assert_array_equal(gen, cur)
+
+
+def test_generate_shapes_and_latency(devices):
+    cfg, params = tiny()
+    eng = InferenceEngine(config=cfg, params=params, dtype=jnp.float32)
+    tokens = np.zeros((2, 4), np.int32)
+    out = eng.generate(tokens, max_new_tokens=6)
+    assert out.shape == (2, 10)
+    assert "prefill" in eng.latency_ms and "decode_per_token" in eng.latency_ms
+
+
+def test_sampled_generation_valid_tokens(devices):
+    cfg, params = tiny()
+    eng = InferenceEngine(config=cfg, params=params, dtype=jnp.float32)
+    out = eng.generate(np.zeros((1, 4), np.int32), max_new_tokens=8,
+                       temperature=1.0, top_k=5, seed=3)
+    assert ((out >= 0) & (out < 128)).all()
+
+
+def test_tp_inference_matches_single(devices):
+    cfg, params = tiny()
+    ref_eng = InferenceEngine(config=cfg, params=params, dtype=jnp.float32)
+    tokens = np.random.default_rng(2).integers(0, 128, (1, 8)).astype(np.int32)
+    ref = ref_eng.generate(tokens, max_new_tokens=4)
+
+    tp_eng = InferenceEngine(config=cfg, params=params, dtype=jnp.float32,
+                             mp_size=2)
+    out = tp_eng.generate(tokens, max_new_tokens=4)
+    np.testing.assert_array_equal(ref, out)
+    qkv = tp_eng.params["block"]["qkv"]["kernel"]
+    assert qkv.sharding.shard_shape(qkv.shape)[2] == qkv.shape[2] // 2
+
+
+def test_init_inference_api(devices):
+    cfg, params = tiny()
+    eng = deepspeed_tpu.init_inference(model=(cfg, params), dtype=jnp.float32)
+    assert isinstance(eng, InferenceEngine)
+
+
+def test_hf_gpt2_injection(devices):
+    """HF GPT-2 weights through the policy must reproduce HF logits."""
+    transformers = pytest.importorskip("transformers")
+    import torch
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=96, n_positions=32, n_embd=32, n_layer=2, n_head=4,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    torch.manual_seed(0)
+    hf_model = transformers.GPT2LMHeadModel(hf_cfg).eval()
+
+    eng = deepspeed_tpu.init_inference(model=hf_model, dtype=jnp.float32)
+    tokens = np.random.default_rng(0).integers(0, 96, (1, 8)).astype(np.int32)
+    ours = np.asarray(eng.forward(tokens))
+    with torch.no_grad():
+        theirs = hf_model(torch.tensor(tokens.astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-3)
