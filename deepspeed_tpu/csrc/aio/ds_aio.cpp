@@ -1,0 +1,267 @@
+// Async file I/O thread pool for NVMe/host offload tiering.
+//
+// TPU-native equivalent of the reference's libaio module
+// (ref: csrc/aio/common/deepspeed_aio_common.cpp,
+//  csrc/aio/py_lib/deepspeed_aio_thread.cpp: io_op_desc_t /
+//  deepspeed_aio_thread_t, csrc/aio/py_lib/deepspeed_py_aio_handle.cpp:
+//  deepspeed_aio_handle_t with _schedule_aio_work/_wait_for_aio_work).
+//
+// Differences from the reference, by design:
+//  - pread/pwrite across a worker-thread pool instead of io_submit: the
+//    kernel aio interface needs O_DIRECT alignment of every user buffer;
+//    a thread pool with per-thread block-sized chunks achieves comparable
+//    NVMe saturation and works on any filesystem. O_DIRECT is attempted
+//    and silently downgraded when the fs refuses it.
+//  - each request is split into block_size chunks round-robined over the
+//    pool (the reference parallelizes identically across its threads,
+//    deepspeed_aio_thread.cpp worker loop).
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in the image).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <unistd.h>
+
+namespace {
+
+struct Chunk {
+    std::string path;
+    char* buf;          // host buffer for this chunk
+    int64_t nbytes;
+    int64_t file_offset;
+    bool is_read;
+    int64_t op_id;
+};
+
+struct AioHandle {
+    int num_threads;
+    int queue_depth;   // chunks in flight per thread target (advisory)
+    int64_t block_size;
+    bool use_direct;
+
+    std::mutex mu;
+    std::condition_variable cv_work;
+    std::condition_variable cv_done;
+    std::deque<Chunk> queue;
+    int64_t inflight_chunks = 0;    // queued + executing
+    int64_t completed_ops = 0;
+    int64_t error_code = 0;         // first errno observed
+    int64_t next_op_id = 1;
+    bool shutdown = false;
+    std::vector<std::thread> workers;
+
+    // per-op remaining chunk counts (op completes when it hits zero)
+    std::mutex op_mu;
+    std::vector<std::pair<int64_t, int64_t>> op_remaining;
+};
+
+int open_file(AioHandle* h, const std::string& path, bool is_read) {
+    int flags = is_read ? O_RDONLY : (O_WRONLY | O_CREAT);
+    if (h->use_direct) {
+        int fd = ::open(path.c_str(), flags | O_DIRECT, 0644);
+        if (fd >= 0) return fd;
+    }
+    return ::open(path.c_str(), flags, 0644);
+}
+
+void finish_chunk(AioHandle* h, const Chunk& c) {
+    std::lock_guard<std::mutex> lk(h->op_mu);
+    for (auto it = h->op_remaining.begin(); it != h->op_remaining.end(); ++it) {
+        if (it->first == c.op_id) {
+            if (--it->second == 0) {
+                h->op_remaining.erase(it);
+                h->completed_ops++;
+            }
+            return;
+        }
+    }
+}
+
+void run_chunk(AioHandle* h, const Chunk& c) {
+    int fd = open_file(h, c.path, c.is_read);
+    if (fd < 0) {
+        std::lock_guard<std::mutex> lk(h->mu);
+        if (!h->error_code) h->error_code = -errno;
+        return;
+    }
+    int64_t done = 0;
+    while (done < c.nbytes) {
+        ssize_t n = c.is_read
+            ? ::pread(fd, c.buf + done, c.nbytes - done, c.file_offset + done)
+            : ::pwrite(fd, c.buf + done, c.nbytes - done, c.file_offset + done);
+        if (n < 0 && errno == EINVAL && h->use_direct) {
+            // O_DIRECT alignment refusal: reopen buffered and retry
+            ::close(fd);
+            fd = ::open(c.path.c_str(),
+                        c.is_read ? O_RDONLY : (O_WRONLY | O_CREAT), 0644);
+            if (fd < 0) break;
+            continue;
+        }
+        if (n <= 0) {
+            std::lock_guard<std::mutex> lk(h->mu);
+            if (!h->error_code) h->error_code = n < 0 ? -errno : -EIO;
+            break;
+        }
+        done += n;
+    }
+    if (fd >= 0) ::close(fd);
+}
+
+void worker_loop(AioHandle* h) {
+    for (;;) {
+        Chunk c;
+        {
+            std::unique_lock<std::mutex> lk(h->mu);
+            h->cv_work.wait(lk, [h] { return h->shutdown || !h->queue.empty(); });
+            if (h->shutdown && h->queue.empty()) return;
+            c = h->queue.front();
+            h->queue.pop_front();
+        }
+        run_chunk(h, c);
+        finish_chunk(h, c);
+        {
+            std::lock_guard<std::mutex> lk(h->mu);
+            h->inflight_chunks--;
+        }
+        h->cv_done.notify_all();
+    }
+}
+
+// split [0, nbytes) into block_size chunks and enqueue; returns op id
+int64_t submit(AioHandle* h, char* buf, int64_t nbytes, const char* path,
+               int64_t file_offset, bool is_read) {
+    if (nbytes <= 0) return -EINVAL;
+    int64_t n_chunks = (nbytes + h->block_size - 1) / h->block_size;
+    int64_t op_id;
+    {
+        std::lock_guard<std::mutex> lk(h->mu);
+        op_id = h->next_op_id++;
+    }
+    {
+        std::lock_guard<std::mutex> lk(h->op_mu);
+        h->op_remaining.emplace_back(op_id, n_chunks);
+    }
+    {
+        std::lock_guard<std::mutex> lk(h->mu);
+        for (int64_t i = 0; i < n_chunks; i++) {
+            int64_t off = i * h->block_size;
+            Chunk c{path, buf + off, std::min(h->block_size, nbytes - off),
+                    file_offset + off, is_read, op_id};
+            h->queue.push_back(c);
+            h->inflight_chunks++;
+        }
+    }
+    h->cv_work.notify_all();
+    return op_id;
+}
+
+int64_t wait_all(AioHandle* h) {
+    std::unique_lock<std::mutex> lk(h->mu);
+    h->cv_done.wait(lk, [h] { return h->inflight_chunks == 0; });
+    if (h->error_code) {
+        int64_t e = h->error_code;
+        h->error_code = 0;
+        return e;
+    }
+    int64_t n = h->completed_ops;
+    h->completed_ops = 0;
+    return n;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ds_aio_create(int num_threads, int queue_depth, int64_t block_size,
+                    int use_direct) {
+    auto* h = new AioHandle();
+    h->num_threads = num_threads > 0 ? num_threads : 1;
+    h->queue_depth = queue_depth > 0 ? queue_depth : 32;
+    h->block_size = block_size > 0 ? block_size : (1 << 20);
+    h->use_direct = use_direct != 0;
+    for (int i = 0; i < h->num_threads; i++)
+        h->workers.emplace_back(worker_loop, h);
+    return h;
+}
+
+void ds_aio_destroy(void* handle) {
+    auto* h = static_cast<AioHandle*>(handle);
+    {
+        std::lock_guard<std::mutex> lk(h->mu);
+        h->shutdown = true;
+    }
+    h->cv_work.notify_all();
+    for (auto& t : h->workers) t.join();
+    delete h;
+}
+
+// synchronous read/write: submit + wait (ref: deepspeed_py_aio_handle.cpp
+// sync_pread/sync_pwrite)
+int64_t ds_aio_pread(void* handle, void* buf, int64_t nbytes,
+                     const char* path, int64_t file_offset) {
+    auto* h = static_cast<AioHandle*>(handle);
+    int64_t id = submit(h, static_cast<char*>(buf), nbytes, path,
+                        file_offset, true);
+    if (id < 0) return id;
+    int64_t r = wait_all(h);
+    return r < 0 ? r : nbytes;
+}
+
+int64_t ds_aio_pwrite(void* handle, void* buf, int64_t nbytes,
+                      const char* path, int64_t file_offset) {
+    auto* h = static_cast<AioHandle*>(handle);
+    int64_t id = submit(h, static_cast<char*>(buf), nbytes, path,
+                        file_offset, false);
+    if (id < 0) return id;
+    int64_t r = wait_all(h);
+    return r < 0 ? r : nbytes;
+}
+
+// async: enqueue and return op id (ref: _schedule_aio_work)
+int64_t ds_aio_submit_read(void* handle, void* buf, int64_t nbytes,
+                           const char* path, int64_t file_offset) {
+    return submit(static_cast<AioHandle*>(handle), static_cast<char*>(buf),
+                  nbytes, path, file_offset, true);
+}
+
+int64_t ds_aio_submit_write(void* handle, void* buf, int64_t nbytes,
+                            const char* path, int64_t file_offset) {
+    return submit(static_cast<AioHandle*>(handle), static_cast<char*>(buf),
+                  nbytes, path, file_offset, false);
+}
+
+// wait for ALL inflight ops (ref: _wait_for_aio_work); returns #ops
+// completed since last wait, or -errno on first error.
+int64_t ds_aio_wait(void* handle) {
+    return wait_all(static_cast<AioHandle*>(handle));
+}
+
+int64_t ds_aio_inflight(void* handle) {
+    auto* h = static_cast<AioHandle*>(handle);
+    std::lock_guard<std::mutex> lk(h->mu);
+    return h->inflight_chunks;
+}
+
+// aligned host buffer for O_DIRECT-friendly transfers (the "pinned" pool
+// analog; ref: csrc/aio py buffer registration)
+void* ds_aligned_alloc(int64_t nbytes, int64_t alignment) {
+    void* p = nullptr;
+    if (posix_memalign(&p, static_cast<size_t>(alignment),
+                       static_cast<size_t>(nbytes)) != 0)
+        return nullptr;
+    return p;
+}
+
+void ds_aligned_free(void* p) { free(p); }
+
+}  // extern "C"

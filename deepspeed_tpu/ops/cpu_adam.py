@@ -1,0 +1,117 @@
+"""Host-side (offloaded) optimizer steps backed by the native AVX kernels.
+
+Parity surface of the reference's ``DeepSpeedCPUAdam``/``DeepSpeedCPUAdagrad``
+(ref: deepspeed/ops/adam/cpu_adam.py:13, csrc/adam/cpu_adam.cpp:284) used by
+ZeRO-Offload: optimizer state lives in host RAM as fp32 numpy arrays and the
+step runs on host cores while the device is busy with the next microbatch.
+"""
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from deepspeed_tpu.ops.op_builder import CPUAdamBuilder
+
+
+def _fp(a: np.ndarray):
+    assert a.dtype == np.float32 and a.flags["C_CONTIGUOUS"]
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+class DeepSpeedCPUAdam:
+    """Fused host Adam/AdamW over flat fp32 buffers.
+
+    State (exp_avg, exp_avg_sq) is allocated lazily per param buffer id the
+    first time :meth:`step` sees it, mirroring the reference's per-group
+    state tensors.
+    """
+
+    def __init__(self, lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, adamw_mode: bool = True):
+        self._lib = CPUAdamBuilder().load()
+        self.lr = lr
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adamw_mode = adamw_mode
+        self.state = {}  # id -> dict(step, exp_avg, exp_avg_sq)
+
+    def _get_state(self, key, numel: int):
+        st = self.state.get(key)
+        if st is None:
+            st = {"step": 0,
+                  "exp_avg": np.zeros(numel, np.float32),
+                  "exp_avg_sq": np.zeros(numel, np.float32)}
+            self.state[key] = st
+        return st
+
+    def step(self, key, params: np.ndarray, grads: np.ndarray,
+             lr: Optional[float] = None,
+             params_bf16_out: Optional[np.ndarray] = None) -> int:
+        """One Adam step on a flat fp32 partition; optional simultaneous
+        bf16 copy-back into the device-bound staging buffer."""
+        st = self._get_state(key, params.size)
+        st["step"] += 1
+        t = st["step"]
+        lr = self.lr if lr is None else lr
+        bias_c1 = 1.0 / (1.0 - self.beta1 ** t)
+        bias_c2 = 1.0 / np.sqrt(1.0 - self.beta2 ** t)
+        common = (params.size, _fp(params), _fp(grads), _fp(st["exp_avg"]),
+                  _fp(st["exp_avg_sq"]), lr, self.beta1, self.beta2, self.eps,
+                  self.weight_decay, bias_c1, bias_c2,
+                  1 if self.adamw_mode else 0)
+        if params_bf16_out is None:
+            self._lib.ds_adam_update(*common)
+        else:
+            assert params_bf16_out.dtype == np.uint16
+            self._lib.ds_adam_update_copy_bf16(
+                *common,
+                params_bf16_out.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_uint16)))
+        return t
+
+    def state_arrays(self, key):
+        return self.state[key]
+
+    def load_state(self, key, step: int, exp_avg: np.ndarray,
+                   exp_avg_sq: np.ndarray):
+        self.state[key] = {"step": int(step),
+                           "exp_avg": np.ascontiguousarray(exp_avg, np.float32),
+                           "exp_avg_sq": np.ascontiguousarray(exp_avg_sq,
+                                                              np.float32)}
+
+
+class DeepSpeedCPUAdagrad:
+    """Host Adagrad (ref: csrc/adagrad/cpu_adagrad.cpp)."""
+
+    def __init__(self, lr: float = 1e-2, eps: float = 1e-10,
+                 weight_decay: float = 0.0):
+        self._lib = CPUAdamBuilder().load()
+        self.lr = lr
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.state = {}
+
+    def step(self, key, params: np.ndarray, grads: np.ndarray,
+             lr: Optional[float] = None):
+        st = self.state.get(key)
+        if st is None:
+            st = {"step": 0, "exp_avg_sq": np.zeros(params.size, np.float32)}
+            self.state[key] = st
+        st["step"] += 1
+        self._lib.ds_adagrad_update(
+            params.size, _fp(params), _fp(grads), _fp(st["exp_avg_sq"]),
+            self.lr if lr is None else lr, self.eps, self.weight_decay)
+        return st["step"]
+
+
+def lamb_trust_ratio(lib, params: np.ndarray, update: np.ndarray) -> float:
+    """||w|| / ||update|| via the native reduction (ref:
+    csrc/lamb/fused_lamb_cuda_kernel.cu trust-ratio reductions)."""
+    out = np.zeros(2, np.float32)
+    lib.ds_lamb_norms(params.size, _fp(params), _fp(update), _fp(out))
+    w_norm, u_norm = float(np.sqrt(out[0])), float(np.sqrt(out[1]))
+    if w_norm == 0.0 or u_norm == 0.0:
+        return 1.0
+    return w_norm / u_norm

@@ -53,6 +53,18 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     ckptr.save(os.path.join(path, STATE_DIR), payload, force=True)
     ckptr.wait_until_finished()
 
+    # host-resident optimizer state (ZeRO-Offload): fp32 masters + moments
+    # (analog of the per-DP-rank optim_states.pt shards, engine.py:2327)
+    if getattr(engine, "offload_enabled", False) and jax.process_index() == 0:
+        sd = engine.host_optimizer.state_dict()
+        arrays = {"step": np.asarray(sd["step"])}
+        for i, m in enumerate(sd["master"]):
+            arrays[f"master_{i}"] = m
+        for key, st in sd["state"].items():
+            arrays[f"exp_avg_{key}"] = st["exp_avg"]
+            arrays[f"exp_avg_sq_{key}"] = st["exp_avg_sq"]
+        np.savez(os.path.join(path, "host_optim_states.npz"), **arrays)
+
     meta = {
         "tag": tag,
         "global_steps": engine.global_steps,
@@ -119,6 +131,29 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     from deepspeed_tpu.runtime.loss_scaler import LossScaleState
     scale_state = LossScaleState(**restored["scale_state"])
     opt_state = restored["opt_state"] if load_optimizer_states else state.opt_state
+
+    if getattr(engine, "offload_enabled", False):
+        host_path = os.path.join(path, "host_optim_states.npz")
+        if load_optimizer_states and os.path.isfile(host_path):
+            z = np.load(host_path)
+            n = len(engine.host_optimizer.master)
+            engine.host_optimizer.load_state_dict({
+                "step": int(z["step"]),
+                "master": [z[f"master_{i}"] for i in range(n)],
+                "state": {str(i): {"exp_avg": z[f"exp_avg_{i}"],
+                                   "exp_avg_sq": z[f"exp_avg_sq_{i}"]}
+                          for i in range(n)},
+            })
+        else:
+            # no host state on disk (non-offload save, or optimizer states
+            # skipped): re-seed the host fp32 masters from the restored
+            # device params so the next step doesn't revert to init weights
+            if load_optimizer_states:
+                logger.warning(
+                    "offload engine: %s missing; reinitializing host "
+                    "optimizer masters from restored params (moments reset)",
+                    host_path)
+            engine.host_optimizer.reset_from_params(restored["params"])
 
     from deepspeed_tpu.runtime.engine import TrainState
     engine.state = TrainState(

@@ -138,22 +138,41 @@ class DeepSpeedEngine:
             min_shard_size=config.zero.stage3_min_shard_size)
         self.param_shardings = sharding_lib.to_named(self.param_pspecs, self.mesh)
 
-        params = jax.device_put(_cast_tree(params, jnp.float32), self.param_shardings)
-
         # --- lr schedule & optimizer ---------------------------------
         self.lr_schedule = self._configure_lr_schedule(lr_schedule)
-        self.optimizer = optimizer if optimizer is not None \
-            else self._configure_basic_optimizer()
 
-        # optimizer state: shard like ZeRO stage >= 1
-        opt_shape = jax.eval_shape(self.optimizer.init, params)
-        self.opt_pspecs = sharding_lib.opt_state_specs(
-            opt_shape, self.param_pspecs, params, self.mesh,
-            zero_stage=config.zero.stage,
-            min_shard_size=config.zero.stage3_min_shard_size)
-        self.opt_shardings = sharding_lib.to_named(self.opt_pspecs, self.mesh)
-        opt_state = jax.jit(self.optimizer.init,
-                            out_shardings=self.opt_shardings)(params)
+        # host offload of optimizer state (ZeRO-Offload/Infinity; see
+        # runtime/zero/offload.py) — master weights + moments on host,
+        # only compute-dtype params on device
+        self.offload_enabled = (config.zero.offload_optimizer.enabled
+                                and optimizer is None)
+        if self.offload_enabled and jax.process_count() > 1:
+            # the host step needs fully-addressable grads; multi-host
+            # offload requires per-process shard handling (future work)
+            raise NotImplementedError(
+                "offload_optimizer currently supports single-host meshes; "
+                "multi-host offload needs per-process grad shard handling")
+        if self.offload_enabled:
+            self._configure_offload_optimizer(params)
+            self.optimizer = None
+            opt_state = None
+            params = jax.device_put(
+                self.host_optimizer.device_params(), self.param_shardings)
+        else:
+            params = jax.device_put(_cast_tree(params, jnp.float32),
+                                    self.param_shardings)
+            self.optimizer = optimizer if optimizer is not None \
+                else self._configure_basic_optimizer()
+
+            # optimizer state: shard like ZeRO stage >= 1
+            opt_shape = jax.eval_shape(self.optimizer.init, params)
+            self.opt_pspecs = sharding_lib.opt_state_specs(
+                opt_shape, self.param_pspecs, params, self.mesh,
+                zero_stage=config.zero.stage,
+                min_shard_size=config.zero.stage3_min_shard_size)
+            self.opt_shardings = sharding_lib.to_named(self.opt_pspecs, self.mesh)
+            opt_state = jax.jit(self.optimizer.init,
+                                out_shardings=self.opt_shardings)(params)
 
         scale_state = ls.init_state(
             static_scale=config.fp16.loss_scale if self.fp16_enabled else 1.0,
@@ -178,7 +197,11 @@ class DeepSpeedEngine:
             steps_per_output=config.steps_per_print)
 
         # --- compiled programs ---------------------------------------
-        self._train_step = self._build_train_step(donate_state)
+        if self.offload_enabled:
+            self._train_step = None
+            self._grad_step = self._build_grad_step()
+        else:
+            self._train_step = self._build_train_step(donate_state)
         self._eval_step = self._build_eval_step()
 
         n_params = count_parameters(params)
@@ -238,6 +261,33 @@ class DeepSpeedEngine:
                        C.ZERO_ONE_ADAM_OPTIMIZER: zero_one_adam}[name]
             return factory(lr, config_params=p)
         raise ValueError(f"unknown optimizer {name}")
+
+    def _configure_offload_optimizer(self, params: PyTree):
+        """Build the host-resident optimizer for ZeRO-Offload/Infinity
+        (ref: stage_1_and_2.py:1725 CPU Adam step path; NVMe via
+        swap_tensor swappers). Master fp32 weights + moments live on host;
+        see runtime/zero/offload.py for the architecture."""
+        from deepspeed_tpu.runtime.zero.offload import HostOffloadOptimizer
+        ocfg = self.config.optimizer
+        name = (ocfg.type or C.ADAMW_OPTIMIZER).lower()
+        if name not in (C.ADAM_OPTIMIZER, C.ADAMW_OPTIMIZER,
+                        C.FUSED_ADAM_OPTIMIZER, C.CPU_ADAM_OPTIMIZER):
+            raise ValueError(
+                f"offload_optimizer supports the Adam family, got {name}")
+        p = dict(ocfg.params or {})
+        off = self.config.zero.offload_optimizer
+        nvme = off.nvme_path if off.device == C.OFFLOAD_DEVICE_NVME else None
+        if off.device == C.OFFLOAD_DEVICE_NVME and nvme is None:
+            raise ValueError("offload_optimizer.device=nvme needs nvme_path")
+        self.host_optimizer = HostOffloadOptimizer(
+            params, self.lr_schedule,
+            betas=tuple(p.get("betas", (0.9, 0.999))),
+            eps=p.get("eps", 1e-8),
+            weight_decay=p.get("weight_decay", 0.0),
+            adamw_mode=p.get("adam_w_mode", True) or name == C.ADAMW_OPTIMIZER,
+            nvme_path=nvme,
+            pipeline_swap=off.pipeline_read or off.pipeline_write,
+            param_dtype=self.compute_dtype)
 
     # ------------------------------------------------------------------
     # compiled step construction
@@ -363,6 +413,106 @@ class DeepSpeedEngine:
             out_shardings=(state_shardings, metrics_sh),
             donate_argnums=(0,) if donate_state else ())
 
+    def _build_grad_step(self):
+        """Grad-only program for the offload path: forward+backward+clip on
+        device; the optimizer update happens on host (runtime/zero/offload)."""
+        cfg = self.config
+        gas = cfg.gradient_accumulation_steps
+        clip = cfg.gradient_clipping
+        fp16 = self.fp16_enabled
+        compute_dtype = self.compute_dtype
+        loss_fn = self.loss_fn
+        has_aux = self.has_aux
+        prescale = cfg.prescale_gradients
+        predivide = cfg.gradient_predivide_factor
+
+        def micro_loss(params, micro_batch, rng, scale_state):
+            cparams = _cast_tree(params, compute_dtype)
+            micro_batch = _cast_tree(micro_batch, compute_dtype)
+            out = loss_fn(cparams, micro_batch, rng)
+            loss, aux = out if has_aux else (out, {})
+            scaled = ls.scale_loss(loss.astype(jnp.float32), scale_state) \
+                if fp16 else loss
+            return scaled.astype(jnp.float32), (loss, aux)
+
+        grad_fn = jax.grad(micro_loss, has_aux=True)
+
+        def gstep(params, batch, rng, scale_state):
+            rng, step_rng = jax.random.split(rng)
+
+            def micro_body(carry, micro):
+                grads_acc, loss_acc, r = carry
+                r, mr = jax.random.split(r)
+                g, (loss, _aux) = grad_fn(params, micro, mr, scale_state)
+                if prescale and predivide != 1.0:
+                    g = jax.tree_util.tree_map(lambda x: x / predivide, g)
+                grads_acc = jax.tree_util.tree_map(
+                    lambda a, b: a + b.astype(jnp.float32), grads_acc, g)
+                return (grads_acc, loss_acc + loss.astype(jnp.float32), r), None
+
+            zeros = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            if gas > 1:
+                micro_batches = jax.tree_util.tree_map(
+                    lambda x: x.reshape((gas, x.shape[0] // gas) + x.shape[1:]),
+                    batch)
+                (grads, loss_sum, _), _ = jax.lax.scan(
+                    micro_body, (zeros, jnp.zeros([], jnp.float32), step_rng),
+                    micro_batches)
+            else:
+                (grads, loss_sum, _), _ = micro_body(
+                    (zeros, jnp.zeros([], jnp.float32), step_rng), batch)
+
+            grads = jax.tree_util.tree_map(lambda g: g / gas, grads)
+            if fp16:
+                grads = ls.unscale_grads(grads, scale_state)
+                overflow = ls.has_overflow(grads)
+            else:
+                overflow = jnp.asarray(False)
+            gnorm = global_norm(grads)
+            if clip > 0.0:
+                grads = clip_by_global_norm(grads, clip, norm=gnorm)
+            new_scale = ls.update(
+                scale_state, overflow,
+                dynamic=self.dynamic_loss_scale and fp16,
+                scale_window=cfg.fp16.loss_scale_window,
+                min_scale=cfg.fp16.min_loss_scale,
+                max_hysteresis=cfg.fp16.hysteresis)
+            metrics = {"loss": loss_sum / gas, "grad_norm": gnorm,
+                       "overflow": overflow,
+                       "loss_scale": new_scale.loss_scale}
+            return grads, rng, new_scale, metrics
+
+        rep = NamedSharding(self.mesh, P())
+        scale_sh = jax.tree_util.tree_map(lambda _: rep,
+                                          self.state.scale_state)
+        self._state_shardings = TrainState(
+            step=rep, params=self.param_shardings, opt_state=None,
+            scale_state=scale_sh, rng=rep)
+        self._batch_shard_leaf = mesh_lib.batch_sharding(self.mesh)
+        return jax.jit(
+            gstep,
+            in_shardings=(self.param_shardings, None, rep, scale_sh),
+            out_shardings=(self.param_shardings, rep, scale_sh, rep))
+
+    def _offload_train_batch(self, batch: PyTree) -> Dict[str, jnp.ndarray]:
+        grads, rng, new_scale, metrics = self._grad_step(
+            self.state.params, batch, self.state.rng, self.state.scale_state)
+        self.state.rng = rng
+        self.state.scale_state = new_scale
+        if not bool(metrics["overflow"]):
+            # device -> host grad stream, host AVX Adam, host -> device
+            # updated bf16 params (ref: stage_1_and_2.py:1005,1725)
+            new_params = self.host_optimizer.step(
+                jax.device_get(grads),
+                lr=float(self.lr_schedule(int(self.state.step))))
+            self.state.params = jax.device_put(new_params,
+                                               self.param_shardings)
+            self.state.step = self.state.step + 1
+        metrics["lr"] = jnp.asarray(self.lr_schedule(int(self.state.step)),
+                                    jnp.float32)
+        return metrics
+
     def _shard_batch(self, batch: PyTree) -> PyTree:
         """Place a host batch on the mesh: leading dim over the dp axes,
         token dim over 'sequence' when sequence parallelism is active."""
@@ -394,7 +544,10 @@ class DeepSpeedEngine:
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
         batch = self._shard_batch(batch)
-        self.state, metrics = self._train_step(self.state, batch)
+        if self.offload_enabled:
+            metrics = self._offload_train_batch(batch)
+        else:
+            self.state, metrics = self._train_step(self.state, batch)
         self.timers(TRAIN_BATCH_TIMER).stop()
         self.tput_timer.stop(global_step=True)
         self.global_steps += 1

@@ -1,0 +1,6 @@
+from deepspeed_tpu.runtime.swap_tensor.async_swapper import AsyncTensorSwapper
+from deepspeed_tpu.runtime.swap_tensor.optimizer_swapper import (
+    OptimizerStateSwapper, PipelinedOptimizerSwapper)
+
+__all__ = ["AsyncTensorSwapper", "OptimizerStateSwapper",
+           "PipelinedOptimizerSwapper"]

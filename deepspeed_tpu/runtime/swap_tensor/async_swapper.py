@@ -1,0 +1,55 @@
+"""Fire-and-forget tensor writes with a recycled aligned-buffer pool.
+
+TPU-native analog of the reference's ``AsyncTensorSwapper``
+(ref: deepspeed/runtime/swap_tensor/async_swapper.py:16): tensors are
+copied into page-aligned host buffers and written to NVMe by the native
+thread pool while the caller proceeds; buffers recycle once their write
+completes.
+"""
+
+from typing import Dict, List
+
+import numpy as np
+
+from deepspeed_tpu.ops.aio import AlignedBuffer, AsyncIOHandle
+
+
+class AsyncTensorSwapper:
+    def __init__(self, aio_handle: AsyncIOHandle, buffer_count: int = 4,
+                 buffer_size: int = 1 << 24):
+        self.aio = aio_handle
+        self.buffer_size = buffer_size
+        self._free: List[AlignedBuffer] = [
+            AlignedBuffer(buffer_size, dtype=np.uint8)
+            for _ in range(buffer_count)]
+        self._busy: List[AlignedBuffer] = []
+        self.swap_out_bytes = 0
+
+    def _acquire(self, nbytes: int) -> AlignedBuffer:
+        if nbytes > self.buffer_size:
+            # oversized tensor: dedicated transient buffer
+            return AlignedBuffer(nbytes, dtype=np.uint8)
+        if not self._free:
+            # all buffers in flight: drain (the reference blocks the same
+            # way when its pool is exhausted)
+            self.wait()
+        return self._free.pop()
+
+    def swap_out(self, array: np.ndarray, path: str, offset: int = 0):
+        buf = self._acquire(array.nbytes)
+        flat = buf.array[:array.nbytes]
+        flat[:] = np.frombuffer(
+            np.ascontiguousarray(array).tobytes(), np.uint8)
+        self.aio.async_pwrite(flat, path, offset)
+        self._busy.append(buf)
+        self.swap_out_bytes += array.nbytes
+
+    def wait(self):
+        """Drain all in-flight writes and recycle their buffers."""
+        self.aio.wait()
+        for buf in self._busy:
+            if buf.nbytes <= self.buffer_size:
+                self._free.append(buf)
+            else:
+                buf.free()
+        self._busy = []

@@ -1,0 +1,67 @@
+"""Native async I/O tests (analog of ref tests/unit/test_aio.py:335)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.aio import AlignedBuffer, AsyncIOHandle
+from deepspeed_tpu.ops.op_builder import AsyncIOBuilder
+
+
+@pytest.fixture(scope="module")
+def aio():
+    assert AsyncIOBuilder().is_compatible()
+    h = AsyncIOHandle(block_size=1 << 16, thread_count=4)
+    yield h
+    h.close()
+
+
+def test_sync_write_read_roundtrip(aio, tmp_path):
+    data = np.random.default_rng(0).standard_normal(100_000).astype(np.float32)
+    path = str(tmp_path / "t.bin")
+    assert aio.sync_pwrite(data, path) == data.nbytes
+    out = np.empty_like(data)
+    assert aio.sync_pread(out, path) == data.nbytes
+    np.testing.assert_array_equal(data, out)
+
+
+def test_async_overlapped_ops(aio, tmp_path):
+    rng = np.random.default_rng(1)
+    bufs = [rng.standard_normal(50_000).astype(np.float32) for _ in range(8)]
+    for i, b in enumerate(bufs):
+        aio.async_pwrite(b, str(tmp_path / f"f{i}.bin"))
+    assert aio.wait() == 8
+    outs = [np.empty_like(b) for b in bufs]
+    for i, o in enumerate(outs):
+        aio.async_pread(o, str(tmp_path / f"f{i}.bin"))
+    assert aio.wait() == 8
+    for b, o in zip(bufs, outs):
+        np.testing.assert_array_equal(b, o)
+
+
+def test_offsets(aio, tmp_path):
+    path = str(tmp_path / "off.bin")
+    a = np.arange(1000, dtype=np.float32)
+    b = np.arange(1000, 2000, dtype=np.float32)
+    aio.sync_pwrite(a, path, offset=0)
+    aio.sync_pwrite(b, path, offset=a.nbytes)
+    out = np.empty(2000, np.float32)
+    aio.sync_pread(out, path)
+    np.testing.assert_array_equal(out[:1000], a)
+    np.testing.assert_array_equal(out[1000:], b)
+
+
+def test_aligned_buffer():
+    buf = AlignedBuffer(10_000, dtype=np.float32)
+    assert buf.data_ptr() % 4096 == 0
+    v = buf.view(2500)
+    v[:] = 1.5
+    assert np.all(buf.view(2500) == 1.5)
+    buf.free()
+
+
+def test_read_error(aio, tmp_path):
+    out = np.empty(10, np.float32)
+    with pytest.raises(OSError):
+        aio.sync_pread(out, str(tmp_path / "missing.bin"))
