@@ -95,12 +95,14 @@ void run_chunk(AioHandle* h, const Chunk& c) {
         return;
     }
     int64_t done = 0;
+    bool retried_buffered = false;
     while (done < c.nbytes) {
         ssize_t n = c.is_read
             ? ::pread(fd, c.buf + done, c.nbytes - done, c.file_offset + done)
             : ::pwrite(fd, c.buf + done, c.nbytes - done, c.file_offset + done);
-        if (n < 0 && errno == EINVAL && h->use_direct) {
-            // O_DIRECT alignment refusal: reopen buffered and retry
+        if (n < 0 && errno == EINVAL && h->use_direct && !retried_buffered) {
+            // O_DIRECT alignment refusal: reopen buffered and retry ONCE
+            retried_buffered = true;
             ::close(fd);
             fd = ::open(c.path.c_str(),
                         c.is_read ? O_RDONLY : (O_WRONLY | O_CREAT), 0644);

@@ -120,8 +120,15 @@ class InferenceEngine:
         if model is not None and (config is None or params is None):
             from deepspeed_tpu.inference.policy import resolve_model
             config, params = resolve_model(model)
+        if checkpoint is not None:
+            # trained weights from a sharded training checkpoint override
+            # whatever the model/policy supplied (ref: engine.py:281
+            # _load_checkpoint resharding trained weights into the skeleton)
+            from deepspeed_tpu.runtime.checkpointing import \
+                load_fp32_state_dict_from_zero_checkpoint
+            params = load_fp32_state_dict_from_zero_checkpoint(checkpoint)
         assert config is not None and params is not None, \
-            "need (config, params) or a model a policy understands"
+            "need (config, params), a checkpoint, or a model a policy understands"
         self.cfg = config
         self.dtype = dtype
         self.max_seq_len = max_seq_len or config.max_seq_len
@@ -134,11 +141,6 @@ class InferenceEngine:
             mesh = mesh_lib.make_mesh(
                 mesh_lib.MeshSpec(data=n // mp_size, model=mp_size))
         self.mesh = mesh
-
-        if checkpoint is not None:
-            from deepspeed_tpu.runtime.checkpointing import \
-                load_fp32_state_dict_from_zero_checkpoint
-            params = load_fp32_state_dict_from_zero_checkpoint(checkpoint)
 
         # dtype conversion (ref: engine.py:335 _convert_to_dtype) + TP placement
         params = jax.tree_util.tree_map(
@@ -153,30 +155,31 @@ class InferenceEngine:
 
         self._prefill = jax.jit(self._prefill_fn)
         self._decode = jax.jit(self._decode_fn, donate_argnums=(1,))
+        self._forward = jax.jit(self._forward_fn)
         log_dist(f"inference engine: {config.n_layers}L/{config.d_model}d "
                  f"mp={mp_size} dtype={jnp.dtype(dtype).name}", ranks=[0])
 
     # ------------------------------------------------------------------
-    def _embed(self, tokens):
+    # params are threaded explicitly (never via self) so jit treats the
+    # weights as arguments, not baked-in constants
+    def _embed(self, params, tokens):
         S = tokens.shape[1]
-        wte = self.params["wte"]["embedding"]
-        wpe = self.params["wpe"]["embedding"]
+        wte = params["wte"]["embedding"]
+        wpe = params["wpe"]["embedding"]
         return wte[tokens] + wpe[:S][None]
 
-    def _logits(self, x):
-        x = _layernorm(x, self.params["ln_f"]["scale"],
-                       self.params["ln_f"]["bias"])
+    def _logits(self, params, x):
+        x = _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
         if self.cfg.tie_embeddings:
-            return x @ self.params["wte"]["embedding"].T
-        return x @ self.params["lm_head"]["kernel"]
+            return x @ params["wte"]["embedding"].T
+        return x @ params["lm_head"]["kernel"]
 
     def _prefill_fn(self, params, tokens):
         """Run the prompt, build the cache, return last-position logits."""
         cfg = self.cfg
         B, S = tokens.shape
-        x = self._embed(tokens)
+        x = self._embed(params, tokens)
         S_max = self.max_seq_len
-        H, Dh = cfg.n_heads, cfg.head_dim
 
         def body(x, layer_p):
             y, k, v = _block_prefill(x, layer_p, cfg)
@@ -187,7 +190,7 @@ class InferenceEngine:
         pad = [(0, 0), (0, 0), (0, S_max - S), (0, 0), (0, 0)]
         k_cache = jnp.pad(ks, pad)
         v_cache = jnp.pad(vs, pad)
-        logits = self._logits(x[:, -1:])
+        logits = self._logits(params, x[:, -1:])
         return logits, {"k": k_cache, "v": v_cache}
 
     def _decode_fn(self, params, cache, token, pos):
@@ -204,8 +207,15 @@ class InferenceEngine:
 
         x, (ks, vs) = jax.lax.scan(body, x,
                                    (params["block"], cache["k"], cache["v"]))
-        logits = self._logits(x)
+        logits = self._logits(params, x)
         return logits, {"k": ks, "v": vs}
+
+    def _forward_fn(self, params, tokens):
+        x = self._embed(params, tokens)
+        x, _ = jax.lax.scan(
+            lambda c, l: (_block_prefill(c, l, self.cfg)[0], None),
+            x, params["block"])
+        return self._logits(params, x)
 
     # ------------------------------------------------------------------
     def forward(self, tokens) -> jnp.ndarray:
@@ -213,16 +223,7 @@ class InferenceEngine:
         import time
         t0 = time.perf_counter()
         tokens = jnp.asarray(tokens, jnp.int32)
-        x = self._embed(tokens)
-
-        def body(x, layer_p):
-            y, _, _ = _block_prefill(x, layer_p, self.cfg)
-            return y, None
-
-        x, _ = jax.jit(lambda p, x: jax.lax.scan(
-            lambda c, l: (_block_prefill(c, l, self.cfg)[0], None),
-            x, p["block"]))(self.params, x)
-        out = self._logits(x)
+        out = self._forward(self.params, tokens)
         jax.block_until_ready(out)
         self.latency_ms["forward"] = (time.perf_counter() - t0) * 1e3
         return out

@@ -29,8 +29,6 @@ def register_policy(name: str):
 
 def resolve_model(model) -> Tuple[GPTConfig, Dict]:
     """Dispatch a user-passed model object/name to a policy."""
-    if isinstance(model, tuple) and len(model) == 2:
-        return model  # (config, params) passthrough
     for policy in _POLICIES.values():
         if policy.matches(model):
             return policy.convert(model)

@@ -76,13 +76,17 @@ class OpBuilder:
         if os.path.exists(path):
             return path
         os.makedirs(_BUILD_DIR, exist_ok=True)
+        # compile to a process-private temp path, then atomically rename so a
+        # concurrent process never dlopens a half-written library
+        tmp = f"{path}.tmp.{os.getpid()}"
         cmd = ["g++"] + self.cxx_flags() + self.abs_sources() + [
-            "-o", path, "-lpthread"]
+            "-o", tmp, "-lpthread"]
         logger.info("building native op %s: %s", self.name, " ".join(cmd))
         proc = subprocess.run(cmd, capture_output=True, text=True)
         if proc.returncode != 0:
             raise RuntimeError(
                 f"failed to build op '{self.name}':\n{proc.stderr}")
+        os.replace(tmp, path)
         return path
 
     def load(self) -> ctypes.CDLL:

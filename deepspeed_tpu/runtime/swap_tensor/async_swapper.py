@@ -38,8 +38,7 @@ class AsyncTensorSwapper:
     def swap_out(self, array: np.ndarray, path: str, offset: int = 0):
         buf = self._acquire(array.nbytes)
         flat = buf.array[:array.nbytes]
-        flat[:] = np.frombuffer(
-            np.ascontiguousarray(array).tobytes(), np.uint8)
+        flat[:] = np.ascontiguousarray(array).view(np.uint8).ravel()
         self.aio.async_pwrite(flat, path, offset)
         self._busy.append(buf)
         self.swap_out_bytes += array.nbytes

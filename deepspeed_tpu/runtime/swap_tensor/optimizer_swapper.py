@@ -90,11 +90,13 @@ class PipelinedOptimizerSwapper(OptimizerStateSwapper):
         self._prefetch_key: Optional[str] = None
         self._prefetch_buf: Optional[np.ndarray] = None
         self._write_pending = False
+        self._outstanding: List[np.ndarray] = []
 
     def _fence(self):
         if self._write_pending or self._prefetch_key is not None:
             self.aio.wait()
             self._write_pending = False
+            self._outstanding.clear()
 
     def prefetch(self, key: str):
         if key not in self._info or not self._info[key].on_disk:
@@ -124,8 +126,8 @@ class PipelinedOptimizerSwapper(OptimizerStateSwapper):
             info = self._info[key]
         flat = np.concatenate([np.ascontiguousarray(t, np.float32).ravel()
                                for t in tensors])
-        # keep a reference until fenced so the buffer survives the write
-        self._outstanding = flat
+        # keep references until fenced so the buffers survive the writes
+        self._outstanding.append(flat)
         self.aio.async_pwrite(flat, self._path(key))
         info.on_disk = True
         self._write_pending = True
