@@ -106,7 +106,11 @@ void run_chunk(AioHandle* h, const Chunk& c) {
             ::close(fd);
             fd = ::open(c.path.c_str(),
                         c.is_read ? O_RDONLY : (O_WRONLY | O_CREAT), 0644);
-            if (fd < 0) break;
+            if (fd < 0) {
+                std::lock_guard<std::mutex> lk(h->mu);
+                if (!h->error_code) h->error_code = -errno;
+                break;
+            }
             continue;
         }
         if (n <= 0) {

@@ -128,7 +128,8 @@ class InferenceEngine:
                 load_fp32_state_dict_from_zero_checkpoint
             params = load_fp32_state_dict_from_zero_checkpoint(checkpoint)
         assert config is not None and params is not None, \
-            "need (config, params), a checkpoint, or a model a policy understands"
+            "need a model config: pass (config, params), or a model a " \
+            "policy understands (checkpoint= supplies weights only)"
         self.cfg = config
         self.dtype = dtype
         self.max_seq_len = max_seq_len or config.max_seq_len

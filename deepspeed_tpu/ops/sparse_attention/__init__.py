@@ -1,0 +1,21 @@
+"""Block-sparse attention (ref: deepspeed/ops/sparse_attention/)."""
+
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+    SparsityConfig, DenseSparsityConfig, FixedSparsityConfig,
+    VariableSparsityConfig, BigBirdSparsityConfig,
+    BSLongformerSparsityConfig)
+from deepspeed_tpu.ops.sparse_attention.blocksparse import (
+    blocksparse_attention, blocksparse_attention_jnp,
+    blocksparse_attention_kernel, blocksparse_reference, make_lut)
+from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import (
+    SparseSelfAttention, SparseAttentionUtils, sparse_density,
+    build_sparsity_config)
+
+__all__ = [
+    "SparsityConfig", "DenseSparsityConfig", "FixedSparsityConfig",
+    "VariableSparsityConfig", "BigBirdSparsityConfig",
+    "BSLongformerSparsityConfig", "blocksparse_attention",
+    "blocksparse_attention_jnp", "blocksparse_attention_kernel",
+    "blocksparse_reference", "make_lut", "SparseSelfAttention",
+    "SparseAttentionUtils", "sparse_density",
+]

@@ -1,0 +1,286 @@
+"""Block-sparse attention parity tests vs dense masked reference
+(ref: tests/unit/test_sparse_attention.py — compares Triton kernels
+against a dense torch implementation)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.sparse_attention import (
+    BigBirdSparsityConfig, BSLongformerSparsityConfig, DenseSparsityConfig,
+    FixedSparsityConfig, VariableSparsityConfig, SparseSelfAttention,
+    SparseAttentionUtils, blocksparse_attention, blocksparse_attention_jnp,
+    blocksparse_attention_kernel, blocksparse_reference, make_lut,
+    sparse_density)
+
+B, S, H, D = 2, 256, 4, 32
+BLOCK = 32
+
+
+def _qkv(seed=0, s=S, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    shape = (B, s, H, D)
+    return tuple(jax.random.normal(k, shape, dtype) for k in ks)
+
+
+@pytest.fixture(autouse=True)
+def _interpret_mode(monkeypatch):
+    import jax.experimental.pallas as pl
+    monkeypatch.setattr(pl, "pallas_call",
+                        functools.partial(pl.pallas_call, interpret=True))
+    yield
+
+
+# ---------------------------------------------------------------- layouts
+
+def test_dense_layout_all_ones():
+    cfg = DenseSparsityConfig(num_heads=H, block=BLOCK)
+    layout = cfg.make_layout(S)
+    assert layout.shape == (H, S // BLOCK, S // BLOCK)
+    assert layout.all()
+
+
+def test_fixed_layout_unidirectional_is_lower_triangular():
+    cfg = FixedSparsityConfig(num_heads=H, block=BLOCK, num_local_blocks=4,
+                              attention="unidirectional")
+    layout = cfg.make_layout(S)
+    assert np.triu(layout[0], 1).sum() == 0
+    # diagonal always active
+    assert np.diagonal(layout[0]).all()
+
+
+def test_fixed_layout_global_patterns_differ_per_head():
+    cfg = FixedSparsityConfig(num_heads=4, block=BLOCK, num_local_blocks=4,
+                              different_layout_per_head=True,
+                              num_different_global_patterns=4)
+    layout = cfg.make_layout(S)
+    assert not np.array_equal(layout[0], layout[1])
+
+
+def test_bigbird_layout_has_window_global_random():
+    cfg = BigBirdSparsityConfig(num_heads=H, block=BLOCK, num_random_blocks=1,
+                                num_sliding_window_blocks=3,
+                                num_global_blocks=1)
+    layout = cfg.make_layout(S)
+    nb = S // BLOCK
+    assert layout[0, 0, :].all() and layout[0, :, 0].all()  # global
+    for r in range(nb):
+        assert layout[0, r, r] == 1  # window includes diagonal
+    assert 0 < sparse_density(layout) < 1
+
+
+def test_longformer_layout():
+    cfg = BSLongformerSparsityConfig(num_heads=H, block=BLOCK,
+                                     num_sliding_window_blocks=3,
+                                     global_block_indices=[0, 2])
+    layout = cfg.make_layout(S)
+    assert layout[0, 2, :].all() and layout[0, :, 2].all()
+
+
+def test_variable_layout_rejects_bad_global_ranges():
+    with pytest.raises(ValueError):
+        VariableSparsityConfig(num_heads=H, global_block_indices=[3],
+                               global_block_end_indices=[2])
+
+
+def test_make_lut_roundtrip():
+    cfg = FixedSparsityConfig(num_heads=H, block=BLOCK, num_local_blocks=2)
+    layout = cfg.make_layout(S)
+    lut, valid = make_lut(layout)
+    nb = S // BLOCK
+    assert lut.shape[0] == H and lut.shape[1] == nb
+    # every active block appears exactly once per row
+    for h in range(H):
+        for r in range(nb):
+            cols = sorted(lut[h, r][valid[h, r]].tolist())
+            assert cols == sorted(np.nonzero(layout[h, r])[0].tolist())
+
+
+# ---------------------------------------------------------- parity: jnp path
+
+@pytest.mark.parametrize("cfg_fn", [
+    lambda: FixedSparsityConfig(num_heads=H, block=BLOCK, num_local_blocks=4,
+                                attention="bidirectional"),
+    lambda: FixedSparsityConfig(num_heads=H, block=BLOCK, num_local_blocks=4,
+                                attention="unidirectional"),
+    lambda: BigBirdSparsityConfig(num_heads=H, block=BLOCK),
+    lambda: BSLongformerSparsityConfig(num_heads=H, block=BLOCK),
+    lambda: DenseSparsityConfig(num_heads=H, block=BLOCK),
+])
+def test_jnp_parity_vs_dense(devices, cfg_fn):
+    cfg = cfg_fn()
+    layout = cfg.make_layout(S)
+    causal = getattr(cfg, "attention", "bidirectional") == "unidirectional"
+    q, k, v = _qkv()
+    out = blocksparse_attention(q, k, v, layout, causal=causal,
+                                use_kernel=False)
+    ref = blocksparse_reference(q, k, v, layout, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_jnp_parity_with_masks(devices):
+    cfg = BSLongformerSparsityConfig(num_heads=H, block=BLOCK)
+    layout = cfg.make_layout(S)
+    q, k, v = _qkv()
+    kp = np.zeros((B, S), np.float32)
+    kp[:, S - 17:] = -1e9  # pad out the tail
+    am = np.ones((S, S), np.float32)
+    am[:, :3] = 0
+    out = blocksparse_attention(q, k, v, layout, key_padding_mask=kp,
+                                key_padding_mask_mode="add", attn_mask=am,
+                                attn_mask_mode="mul", use_kernel=False)
+    ref = blocksparse_reference(q, k, v, layout, key_padding_mask=kp,
+                                key_padding_mask_mode="add", attn_mask=am,
+                                attn_mask_mode="mul")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_jnp_grads_match_dense(devices):
+    cfg = FixedSparsityConfig(num_heads=H, block=BLOCK, num_local_blocks=4,
+                              attention="unidirectional")
+    layout = cfg.make_layout(S)
+    q, k, v = _qkv()
+
+    def loss_sparse(q, k, v):
+        o = blocksparse_attention(q, k, v, layout, causal=True,
+                                  use_kernel=False)
+        return jnp.sum(o * o)
+
+    def loss_ref(q, k, v):
+        o = blocksparse_reference(q, k, v, layout, causal=True)
+        return jnp.sum(o * o)
+
+    gs = jax.grad(loss_sparse, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gs, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+# ------------------------------------------------------- parity: pallas path
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_kernel_parity(devices, causal):
+    cfg = FixedSparsityConfig(num_heads=H, block=BLOCK, num_local_blocks=4,
+                              attention=("unidirectional" if causal
+                                         else "bidirectional"))
+    layout = cfg.make_layout(S)
+    lut, valid = make_lut(layout)
+    q, k, v = _qkv()
+    out = blocksparse_attention_kernel(q, k, v, lut, valid, BLOCK,
+                                       causal=causal)
+    ref = blocksparse_reference(q, k, v, layout, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_kernel_grads(devices):
+    cfg = BigBirdSparsityConfig(num_heads=H, block=BLOCK)
+    layout = cfg.make_layout(128)
+    lut, valid = make_lut(layout)
+    q, k, v = _qkv(s=128)
+
+    def loss(q, k, v):
+        o = blocksparse_attention_kernel(q, k, v, lut, valid, BLOCK)
+        return jnp.sum(o * o)
+
+    def loss_ref(q, k, v):
+        o = blocksparse_reference(q, k, v, layout)
+        return jnp.sum(o * o)
+
+    gs = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gs, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+# ------------------------------------------------------------------- module
+
+def test_sparse_self_attention_module(devices):
+    attn = SparseSelfAttention(
+        FixedSparsityConfig(num_heads=H, block=BLOCK, num_local_blocks=4,
+                            attention="unidirectional"))
+    q, k, v = _qkv()
+    out = attn(q, k, v)
+    assert out.shape == q.shape
+    # layout cache hit
+    assert S in attn._cache
+    out2 = attn(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2))
+
+
+def test_pad_to_block_size():
+    ids = jnp.ones((2, 100), jnp.int32)
+    mask = jnp.ones((2, 100), jnp.float32)
+    pad_len, ids_p, mask_p, _, _, _ = SparseAttentionUtils.pad_to_block_size(
+        block=32, input_ids=ids, attention_mask=mask, pad_token_id=7)
+    assert pad_len == 28 and ids_p.shape == (2, 128)
+    assert int(ids_p[0, -1]) == 7 and float(mask_p[0, -1]) == 0.0
+    out = SparseAttentionUtils.unpad_sequence_output(pad_len,
+                                                     jnp.ones((2, 128, 8)))
+    assert out.shape == (2, 100, 8)
+
+
+def test_build_sparsity_config_from_engine_config():
+    from deepspeed_tpu.runtime.config import SparseAttentionConfig
+    from deepspeed_tpu.ops.sparse_attention import build_sparsity_config
+    for mode, cls in [("dense", DenseSparsityConfig),
+                      ("fixed", FixedSparsityConfig),
+                      ("variable", VariableSparsityConfig),
+                      ("bigbird", BigBirdSparsityConfig),
+                      ("bslongformer", BSLongformerSparsityConfig)]:
+        sa = SparseAttentionConfig.from_dict({"mode": mode, "block": BLOCK})
+        cfg = build_sparsity_config(sa, num_heads=H)
+        assert isinstance(cfg, cls)
+        assert cfg.make_layout(S).shape == (H, S // BLOCK, S // BLOCK)
+
+
+def test_rpe_parity(devices):
+    cfg = BSLongformerSparsityConfig(num_heads=H, block=BLOCK)
+    layout = cfg.make_layout(S)
+    q, k, v = _qkv()
+    rpe = np.random.default_rng(1).normal(size=(S, S)).astype(np.float32)
+    am = np.ones((S, S), np.float32)
+    am[:, 5:9] = 0  # mul mask must still mask when rpe is present
+    out = blocksparse_attention(q, k, v, layout, attn_mask=am,
+                                attn_mask_mode="mul", rpe=rpe,
+                                use_kernel=False)
+    ref = blocksparse_reference(q, k, v, layout, attn_mask=am,
+                                attn_mask_mode="mul", rpe=rpe)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_kernel_fully_masked_row_outputs_zero(devices):
+    # layout whose row 0 only attends to a block entirely above the causal
+    # diagonal: the kernel must emit zeros like the jnp path
+    nb = 4
+    layout = np.zeros((1, nb, nb), np.int64)
+    layout[0, 0, 2] = 1            # above diagonal for causal rows in block 0
+    layout[0, 1:, 0] = 1
+    np.fill_diagonal(layout[0][1:, 1:], 1)
+    lut, valid = make_lut(layout)
+    s = nb * BLOCK
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (1, s, 1, D)) for kk in ks)
+    out_k = blocksparse_attention_kernel(q, k, v, lut, valid, BLOCK,
+                                         causal=True)
+    out_j = blocksparse_attention_jnp(q, k, v, lut, valid, BLOCK, causal=True)
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_j),
+                               rtol=2e-5, atol=2e-5)
+    assert np.abs(np.asarray(out_k)[0, :BLOCK]).max() == 0.0
+
+
+def test_max_seq_length_enforced(devices):
+    attn = SparseSelfAttention(
+        FixedSparsityConfig(num_heads=H, block=BLOCK, num_local_blocks=2),
+        max_seq_length=128)
+    q, k, v = _qkv()
+    with pytest.raises(ValueError, match="max_seq_length"):
+        attn(q, k, v)
