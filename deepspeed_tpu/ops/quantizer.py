@@ -1,0 +1,183 @@
+"""Group-wise quantization primitives (TPU-native).
+
+Capability match for the reference's CUDA quantization kernels
+(ref: csrc/quantization/quantizer.cu, bindings csrc/transformer/inference/
+csrc/pt_binding.cpp:62-74 ds_quantize_fp16 / ds_sr_quantize_asym_fp16 / ...)
+and the python fallback math in deepspeed/runtime/quantize.py:158-205.
+
+On TPU these are bandwidth-bound elementwise ops: a hand-written kernel
+buys nothing because XLA fuses the whole quantize→dequantize chain into
+one HBM pass (and into the surrounding matmul when used inline), so the
+idiomatic implementation is pure jax under ``jit``. All functions are
+functional and differentiable-through via straight-through estimation
+where noted.
+
+Conventions
+-----------
+* ``groups`` splits the *flattened* tensor into equal contiguous groups,
+  each with its own scale (same layout as the reference kernels).
+* ``bits`` is the target precision; symmetric range is
+  ``[-2^(bits-1), 2^(bits-1)-1]``, asymmetric is ``[0, 2^bits-1]``.
+* Stochastic rounding draws from ``rng`` (jax PRNG key) — the reference
+  uses curand inside the kernel.
+"""
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _grouped(x: jnp.ndarray, groups: int) -> jnp.ndarray:
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    if n % groups != 0:
+        raise ValueError(f"tensor size {n} not divisible by groups={groups}")
+    return flat.reshape(groups, n // groups)
+
+
+# ----------------------------------------------------------------------
+# fake-quantization (quantize→dequantize in one pass) — MoQ training path
+# ----------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("groups", "bits", "symmetric", "stochastic"))
+def quantize_dequantize(x: jnp.ndarray,
+                        groups: int = 1,
+                        bits: int = 8,
+                        symmetric: bool = True,
+                        stochastic: bool = False,
+                        rng: Optional[jax.Array] = None) -> jnp.ndarray:
+    """Fake-quantize ``x`` group-wise at ``bits`` precision.
+
+    Mirrors the reference python fallback (deepspeed/runtime/quantize.py:
+    158-205: scale = q_range / (2*absmax), round/clamp, rescale) and the
+    sr_quantize path (:88) for stochastic rounding.
+    """
+    orig_dtype = x.dtype
+    g = _grouped(x, groups).astype(jnp.float32)
+    q_range = jnp.float32(2 ** bits)
+
+    if symmetric:
+        absmax = jnp.max(jnp.abs(g), axis=1, keepdims=True)
+        scale = q_range / (2.0 * absmax + 1e-8)
+        scaled = g * scale
+        if stochastic:
+            if rng is None:
+                rng = jax.random.PRNGKey(0)
+            noise = jax.random.uniform(rng, scaled.shape, dtype=jnp.float32)
+            q = jnp.floor(scaled + noise)
+        else:
+            q = jnp.round(scaled)
+        q = jnp.clip(q, -(q_range / 2), q_range / 2 - 1)
+        out = q / scale
+    else:
+        gmin = jnp.min(g, axis=1, keepdims=True)
+        gmax = jnp.max(g, axis=1, keepdims=True)
+        scale = (gmax - gmin) / q_range + 1e-8
+        scaled = (g - gmin) / scale
+        if stochastic:
+            if rng is None:
+                rng = jax.random.PRNGKey(0)
+            noise = jax.random.uniform(rng, scaled.shape, dtype=jnp.float32)
+            q = jnp.floor(scaled + noise)
+        else:
+            q = jnp.round(scaled)
+        q = jnp.clip(q, 0, q_range - 1)
+        out = q * scale + gmin
+
+    return out.reshape(x.shape).astype(orig_dtype)
+
+
+def quantize_dequantize_ste(x, groups=1, bits=8, symmetric=True):
+    """Straight-through-estimator variant: forward fake-quant, identity
+    gradient. For quantize-aware training losses."""
+    q = quantize_dequantize(x, groups=groups, bits=bits, symmetric=symmetric)
+    return x + jax.lax.stop_gradient(q - x)
+
+
+# ----------------------------------------------------------------------
+# real quantization (int8 storage + scales) — inference weight path
+# ----------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("groups", "bits"))
+def quantize(x: jnp.ndarray,
+             groups: int = 1,
+             bits: int = 8) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric group-wise quantization to int8 storage.
+
+    Returns ``(q, scale)`` with ``q`` int8 of x.shape and ``scale``
+    float32 of shape (groups,) such that ``x ≈ q / scale`` (same scale
+    convention as the reference: scale multiplies the float to get the
+    integer, ref deepspeed/runtime/weight_quantizer.py:14-27).
+    """
+    if bits > 8:
+        raise ValueError(f"int8 storage holds at most 8 bits, got {bits} "
+                         "(use quantize_dequantize for wider fake-quant)")
+    g = _grouped(x, groups).astype(jnp.float32)
+    q_range = jnp.float32(2 ** bits)
+    absmax = jnp.max(jnp.abs(g), axis=1, keepdims=True)
+    scale = q_range / (2.0 * absmax + 1e-5)
+    q = jnp.clip(jnp.round(g * scale), -(q_range / 2), q_range / 2 - 1)
+    return q.reshape(x.shape).astype(jnp.int8), scale.reshape(-1)
+
+
+@partial(jax.jit, static_argnames=("groups", "dtype"))
+def dequantize(q: jnp.ndarray,
+               scale: jnp.ndarray,
+               groups: int = 1,
+               dtype=jnp.bfloat16) -> jnp.ndarray:
+    """Inverse of :func:`quantize` (ref: csrc .../dequantize.cu)."""
+    g = _grouped(q.astype(jnp.float32), groups)
+    out = g / scale.reshape(-1, 1)
+    return out.reshape(q.shape).astype(dtype)
+
+
+@partial(jax.jit, static_argnames=("groups", "bits"))
+def quantize_asym(x: jnp.ndarray,
+                  groups: int = 1,
+                  bits: int = 8) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Asymmetric group-wise quantization: returns (q int8 shifted by
+    -2^(bits-1), scale, min) with ``x ≈ (q + 2^(bits-1)) * scale + min``."""
+    if bits > 8:
+        raise ValueError(f"int8 storage holds at most 8 bits, got {bits} "
+                         "(use quantize_dequantize for wider fake-quant)")
+    g = _grouped(x, groups).astype(jnp.float32)
+    q_range = jnp.float32(2 ** bits)
+    gmin = jnp.min(g, axis=1, keepdims=True)
+    gmax = jnp.max(g, axis=1, keepdims=True)
+    scale = (gmax - gmin) / q_range + 1e-8
+    q = jnp.clip(jnp.round((g - gmin) / scale), 0, q_range - 1)
+    # store shifted to int8 range
+    q = (q - q_range / 2).astype(jnp.int8)
+    return q.reshape(x.shape), scale.reshape(-1), gmin.reshape(-1)
+
+
+@partial(jax.jit, static_argnames=("groups", "bits", "dtype"))
+def dequantize_asym(q: jnp.ndarray,
+                    scale: jnp.ndarray,
+                    gmin: jnp.ndarray,
+                    groups: int = 1,
+                    bits: int = 8,
+                    dtype=jnp.bfloat16) -> jnp.ndarray:
+    """Inverse of :func:`quantize_asym`; ``bits`` must match."""
+    g = _grouped(q.astype(jnp.float32), groups)
+    half_range = jnp.float32(2 ** bits) / 2
+    out = (g + half_range) * scale.reshape(-1, 1) + gmin.reshape(-1, 1)
+    return out.reshape(q.shape).astype(dtype)
+
+
+# ----------------------------------------------------------------------
+# quantized matmul helper (dequantize-on-the-fly, fused by XLA)
+# ----------------------------------------------------------------------
+
+def quantized_matmul(x: jnp.ndarray,
+                     q_weight: jnp.ndarray,
+                     scale: jnp.ndarray,
+                     groups: int = 1) -> jnp.ndarray:
+    """``x @ dequantize(q_weight)`` with the dequantize fused into the
+    HBM→MXU load by XLA. int8 weights halve the HBM traffic of the
+    matmul — the same win the reference's int8 inference GEMMs target
+    (ref: csrc/transformer/inference qkv_gemm int8 variants)."""
+    w = dequantize(q_weight, scale, groups=groups, dtype=x.dtype)
+    return x @ w

@@ -21,6 +21,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.utils.tree import tree_path_str
 
 PyTree = Any
 
@@ -31,17 +32,7 @@ PyTree = Any
 
 def _path_str(path) -> str:
     """Render a jax tree path as 'a/b/c'."""
-    parts = []
-    for p in path:
-        if hasattr(p, "key"):
-            parts.append(str(p.key))
-        elif hasattr(p, "idx"):
-            parts.append(str(p.idx))
-        elif hasattr(p, "name"):
-            parts.append(str(p.name))
-        else:
-            parts.append(str(p))
-    return "/".join(parts)
+    return tree_path_str(path, sep="/")
 
 
 # ---------------------------------------------------------------------------

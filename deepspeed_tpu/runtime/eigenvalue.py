@@ -1,0 +1,183 @@
+"""Block-Hessian dominant-eigenvalue estimation (MoQ sensitivity signal).
+
+Capability match for the reference's ``Eigenvalue``
+(ref: deepspeed/runtime/eigenvalue.py:7): power iteration on each
+transformer layer's Hessian; the dominant eigenvalue (normalized to
+[0,1] across layers) slows the MoQ precision schedule for sensitive
+layers.
+
+TPU-native design: the reference does reverse-over-reverse autograd on
+retained graphs (torch.autograd.grad(grads, params, grad_outputs=v)).
+Here the Hessian-vector product is forward-over-reverse —
+``jax.jvp(jax.grad(loss), (params,), (v,))`` — which XLA compiles into
+one fused program, re-used across all power iterations and all blocks
+(the block only changes the tangent's support, not the program).
+
+Blocks: models with stacked per-layer weights (leading layer axis, as
+our scan-based GPT) declare a ``layer_name`` pytree prefix; block ``i``
+is the slice ``leaf[i]`` of every stacked leaf under that prefix.
+"""
+
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.logging import log_dist
+from deepspeed_tpu.utils.tree import tree_path_str as _path_str
+
+
+class Eigenvalue:
+    def __init__(self,
+                 verbose: bool = False,
+                 max_iter: int = 100,
+                 tol: float = 1e-2,
+                 stability: float = 1e-6,
+                 gas_boundary_resolution: int = 1,
+                 layer_name: str = "blocks",
+                 layer_num: int = 0):
+        self.verbose = verbose
+        self.max_iter = max_iter
+        self.tol = tol
+        self.stability = stability
+        self.gas_boundary_resolution = gas_boundary_resolution
+        self.layer_name = layer_name
+        self.layer_num = layer_num
+        assert len(layer_name) > 0 and layer_num > 0
+        self._hvp = None
+        log_dist(
+            f"eigenvalue enabled: max_iter={max_iter}, tol={tol}, "
+            f"layer_name={layer_name}, layer_num={layer_num}", ranks=[0])
+
+    # -- helpers -------------------------------------------------------
+
+    def _is_block_leaf(self, path, leaf) -> bool:
+        return (self.layer_name in _path_str(path)
+                and hasattr(leaf, "ndim") and leaf.ndim >= 1
+                and leaf.shape[0] == self.layer_num)
+
+    def _block_tangent(self, params, v_block, i):
+        """Zero tangent tree with block ``i`` of stacked leaves set to v."""
+        idx = [0]
+
+        def visit(path, leaf):
+            if self._is_block_leaf(path, leaf):
+                z = jnp.zeros_like(leaf)
+                z = z.at[i].set(v_block[idx[0]])
+                idx[0] += 1
+                return z
+            return jnp.zeros_like(leaf)
+
+        return jax.tree_util.tree_map_with_path(visit, params)
+
+    def _extract_block(self, tree, i):
+        out = []
+
+        def visit(path, leaf):
+            if self._is_block_leaf(path, leaf):
+                out.append(leaf[i])
+            return leaf
+
+        jax.tree_util.tree_map_with_path(visit, tree)
+        return out
+
+    @staticmethod
+    def _inner(xs, ys):
+        return sum(jnp.vdot(x.astype(jnp.float32), y.astype(jnp.float32))
+                   for x, y in zip(xs, ys))
+
+    def _normalize(self, vs):
+        norm = jnp.sqrt(self._inner(vs, vs)) + self.stability
+        return [jnp.nan_to_num(v / norm, posinf=0.0, neginf=0.0) for v in vs]
+
+    # -- main ----------------------------------------------------------
+
+    def compute_eigenvalue(self,
+                           loss_fn: Callable,
+                           params,
+                           batch,
+                           rng: jax.Array,
+                           scale: float = 1.0) -> Dict[str, Tuple[float, int]]:
+        """Power iteration per block (ref: eigenvalue.py:61
+        compute_eigenvalue). ``loss_fn(params, batch, rng) -> loss``.
+
+        Returns {``<path>.<i>``: (normalized eigenvalue, layer_id)} keyed
+        the way runtime/quantize.py's block_eigenvalue expects.
+
+        The jitted HVP is cached on the instance: pass the *same*
+        ``loss_fn`` object across calls to reuse the compiled program
+        (batch/rng are traced arguments, so refreshes don't retrace).
+        """
+        if self._hvp is None or self._hvp[0] is not loss_fn:
+            def grad_fn(p, b, r):
+                return jax.grad(lambda q: jnp.asarray(
+                    loss_fn(q, b, r), jnp.float32))(p)
+
+            @jax.jit
+            def hvp_fn(p, tangent, b, r):
+                return jax.jvp(lambda q: grad_fn(q, b, r), (p,), (tangent,))[1]
+
+            self._hvp = (loss_fn, hvp_fn)
+        _, hvp_cached = self._hvp
+
+        def hvp(p, tangent):
+            return hvp_cached(p, tangent, batch, rng)
+
+        key = jax.random.PRNGKey(0)  # fixed seed, as the reference
+        # saves/restores torch rng state (eigenvalue.py:70-82)
+        block_eigenvalue = []
+        block_paths = []
+
+        def collect(path, leaf):
+            if self._is_block_leaf(path, leaf):
+                block_paths.append(_path_str(path))
+            return leaf
+
+        jax.tree_util.tree_map_with_path(collect, params)
+        if not block_paths:
+            log_dist("model has no stacked block leaves; eigenvalue "
+                     "computation skipped.", ranks=[0])
+            return {}
+
+        template = self._extract_block(params, 0)
+        for i in range(self.layer_num):
+            key, sub = jax.random.split(key)
+            v = [jax.random.normal(k, t.shape, jnp.float32)
+                 for k, t in zip(jax.random.split(sub, len(template)), template)]
+            v = self._normalize(v)
+
+            ev_cur, ev_prev, it = 1.0, 0.0, 0
+            while (it < self.max_iter and abs(ev_cur) > 0
+                   and abs((ev_cur - ev_prev) / ev_cur) >= self.tol):
+                ev_prev = ev_cur
+                tangent = self._block_tangent(params, v, i)
+                hv = self._extract_block(hvp(params, tangent), i)
+                hv = [jnp.nan_to_num(h.astype(jnp.float32),
+                                     posinf=0.0, neginf=0.0) for h in hv]
+                ev_cur = float(self._inner(hv, v))
+                v = self._normalize(hv)
+                v = [x / scale for x in v]
+                it += 1
+
+            ev_cur *= scale
+            block_eigenvalue.append(ev_cur)
+            if self.verbose:
+                log_dist(f"block {i}: iters={it} eigenvalue={ev_cur}",
+                         ranks=[0])
+
+        block_eigenvalue = self.post_process(block_eigenvalue)
+        ev_dict: Dict[str, Tuple[float, int]] = {}
+        for i, value in enumerate(block_eigenvalue):
+            for path in block_paths:
+                ev_dict[f"{path}.{i}"] = (value, i)
+        return ev_dict
+
+    def post_process(self, values):
+        """Map |eigenvalues| to [0,1]; invalid (0) blocks get 1.0 —
+        maximum caution (ref: eigenvalue.py:152)."""
+        if not values:
+            return values
+        max_value = abs(max(values, key=abs))
+        if max_value == 0.0:
+            return [1.0] * len(values)
+        return [abs(v) / max_value if v != 0.0 else 1.0 for v in values]

@@ -196,7 +196,39 @@ class DeepSpeedEngine:
             batch_size=config.train_batch_size,
             steps_per_output=config.steps_per_print)
 
+        # --- MoQ quantize-aware training (ref: engine.py:1789-1800) ---
+        qt = config.quantize_training
+        if qt.enabled:
+            if self.offload_enabled:
+                raise NotImplementedError(
+                    "quantize_training with offload_optimizer is not "
+                    "supported (host masters + in-jit fake-quant don't "
+                    "compose yet)")
+            from deepspeed_tpu.runtime.quantize import Quantizer
+            self.quantizer = Quantizer.from_config(qt)
+            if qt.eigenvalue.enabled:
+                from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+                ecfg = qt.eigenvalue
+                self.eigenvalue = Eigenvalue(
+                    verbose=ecfg.verbose, max_iter=ecfg.max_iter,
+                    tol=ecfg.tol, stability=ecfg.stability,
+                    gas_boundary_resolution=ecfg.gas_boundary_resolution,
+                    layer_name=ecfg.layer_name, layer_num=ecfg.layer_num)
+            else:
+                self.eigenvalue = None
+        else:
+            self.quantizer = None
+            self.eigenvalue = None
+        self.block_eigenvalue = {}
+
+        def _eigenvalue_loss(p, b, r):
+            out = self.loss_fn(p, b, r)
+            return out[0] if self.has_aux else out
+        # stable identity so Eigenvalue's jitted HVP cache hits
+        self._eigenvalue_loss = _eigenvalue_loss
+
         # --- compiled programs ---------------------------------------
+        self._donate_state = donate_state
         if self.offload_enabled:
             self._train_step = None
             self._grad_step = self._build_grad_step()
@@ -304,8 +336,17 @@ class DeepSpeedEngine:
         prescale = cfg.prescale_gradients
         predivide = cfg.gradient_predivide_factor
 
+        # MoQ: fake-quantize the compute-dtype copy inside the step; the
+        # fp32 masters stay full precision (ref: engine.py:1789-1800
+        # quantizes optimizer.bit16_groups, not the fp32 masters)
+        quant_fn = self.quantizer.make_transform() \
+            if (self.quantizer is not None and self.quantizer.active) else None
+
         def micro_loss(params, micro_batch, rng, scale_state):
             cparams = _cast_tree(params, compute_dtype)
+            if quant_fn is not None:
+                rng, qr = jax.random.split(rng)
+                cparams = quant_fn(cparams, qr)
             # cast float inputs too (ref: engine.py:951 half()/bfloat16() cast
             # of module AND inputs) so activations genuinely run on the MXU in
             # the reduced precision
@@ -550,6 +591,8 @@ class DeepSpeedEngine:
             self.state, metrics = self._train_step(self.state, batch)
         self.timers(TRAIN_BATCH_TIMER).stop()
         self.tput_timer.stop(global_step=True)
+        if self.quantizer is not None:
+            self._take_quantize_step(batch, bool(metrics["overflow"]))
         self.global_steps += 1
         self.micro_steps += self.config.gradient_accumulation_steps
         self.global_samples += self.config.train_batch_size
@@ -558,6 +601,30 @@ class DeepSpeedEngine:
         if self.global_steps % self.config.steps_per_print == 0:
             self._report_progress(metrics)
         return metrics
+
+    def _take_quantize_step(self, batch, overflow: bool) -> None:
+        """Post-step MoQ hook: optionally refresh block eigenvalues at a
+        GAS boundary, advance the bit schedule, and recompile the train
+        step when a precision switch happened (ref: engine.py:1789-1800;
+        the quantization itself runs inside the jitted step, see
+        _build_train_step)."""
+        if self.eigenvalue is not None and self.global_steps % \
+                self.eigenvalue.gas_boundary_resolution == 0 and \
+                self.quantizer.any_precision_switch():
+            # one micro-batch only: the HVP costs ~2x a backward pass and
+            # must fit in the same HBM the gas-split train step fits in
+            micro_bs = self.config.train_micro_batch_size_per_gpu * \
+                self.dp_world_size
+            micro = jax.tree_util.tree_map(lambda x: x[:micro_bs], batch)
+            self.block_eigenvalue = self.eigenvalue.compute_eigenvalue(
+                self._eigenvalue_loss, self.state.params, micro,
+                self.state.rng)
+        switched = self.quantizer.advance(
+            overflow=overflow,
+            eigenvalue_enabled=self.eigenvalue is not None,
+            block_eigenvalue=self.block_eigenvalue)
+        if switched:
+            self._train_step = self._build_train_step(self._donate_state)
 
     # familiarity wrappers --------------------------------------------
     def __call__(self, batch):

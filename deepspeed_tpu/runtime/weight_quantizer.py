@@ -1,0 +1,94 @@
+"""Inference weight quantization (int8 storage + merged scales).
+
+Capability match for the reference's ``WeightQuantization``
+(ref: deepspeed/runtime/weight_quantizer.py:5): group-wise symmetric
+quantization of transformer weights at checkpoint-load time, with extra
+grouping for MLP matrices and per-layer scale merging for the fused
+inference kernels.
+
+TPU-native: weights live as int8 jax arrays + float32 scales; matmuls
+dequantize on the fly (XLA fuses the rescale into the HBM→MXU load),
+halving weight HBM traffic — the same win the reference's int8 GEMMs
+target. Scale bookkeeping keeps the reference's category split
+(qkv / dense / mlp h→4h / mlp 4h→h) and merge layout.
+"""
+
+from typing import List, Tuple
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops import quantizer as qops
+
+
+class WeightQuantization:
+    def __init__(self, mlp_extra_grouping: bool = True, mp_size: int = 1):
+        self.dense_scales: List[jnp.ndarray] = []
+        self.qkv_scales: List[jnp.ndarray] = []
+        self.mlp4hh_scales: List[jnp.ndarray] = []
+        self.mlph4h_scales: List[jnp.ndarray] = []
+        self.mlp_extra_grouping = mlp_extra_grouping
+        self.mp_size = mp_size
+
+    # shape heuristics (ref: weight_quantizer.py:29-36)
+    def is_mlp(self, data, merge_count: int = 1) -> bool:
+        return ((self.mp_size * data.shape[0] * merge_count) / data.shape[1] == 4
+                or (self.mp_size * data.shape[1] * merge_count) / data.shape[0] == 4)
+
+    def is_qkv(self, data) -> bool:
+        return ((self.mp_size * data.shape[0]) / data.shape[1] == 3
+                or (self.mp_size * data.shape[1]) / data.shape[0] == 3)
+
+    def quantize_data(self, data: jnp.ndarray, quantize_bits: int,
+                      groups: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """One tensor → (int8 tensor, per-group scale); ``x ≈ q/scale``
+        (ref: weight_quantizer.py:14 quantize_data)."""
+        return qops.quantize(data, groups=groups, bits=quantize_bits)
+
+    def Quantize(self, value_list: List[jnp.ndarray], quantize_bits: int,
+                 groups: int, key: str) -> List[jnp.ndarray]:
+        """Quantize a (possibly TP-split) list of weights for one layer
+        slot, recording inverse scales by category
+        (ref: weight_quantizer.py:37)."""
+        if self.mlp_extra_grouping and \
+                self.is_mlp(value_list[0], merge_count=len(value_list)):
+            groups *= 2
+        q_scale = []
+        for index, data in enumerate(value_list):
+            data_int, data_scale = self.quantize_data(data, quantize_bits, groups)
+            q_scale.append(data_scale)
+            value_list[index] = data_int
+        q_scale = 1.0 / jnp.concatenate(q_scale).reshape(1, -1)
+        if "mlp.dense_4h_to_h.weight" in key or "fc_out" in key:
+            self.mlp4hh_scales.append(q_scale)
+        elif "mlp.dense_h_to_4h.weight" in key or "fc_in" in key:
+            self.mlph4h_scales.append(q_scale)
+        elif "query_key_value" in key or "qkv" in key:
+            self.qkv_scales.append(q_scale)
+        else:
+            self.dense_scales.append(q_scale)
+        return value_list
+
+    def merge_layer_scales(self, layer_scales: List[jnp.ndarray]) -> jnp.ndarray:
+        """Pad per-category scales to a common width and stack
+        (ref: weight_quantizer.py:61)."""
+        max_dim = max(s.shape[-1] for s in layer_scales)
+        padded = [
+            jnp.concatenate(
+                [s, jnp.zeros((1, max_dim - s.shape[-1]), s.dtype)], axis=-1)
+            if s.shape[-1] < max_dim else s for s in layer_scales
+        ]
+        return jnp.concatenate(padded)[None, ...]
+
+    def merge_scales(self) -> jnp.ndarray:
+        all_scales = []
+        for dense_scale, qkv_scale, m4hh_scale, mh4h_scale in zip(
+                self.dense_scales, self.qkv_scales,
+                self.mlp4hh_scales, self.mlph4h_scales):
+            all_scales.append(self.merge_layer_scales(
+                [qkv_scale, dense_scale, mh4h_scale, m4hh_scale]))
+        return jnp.concatenate(all_scales)
+
+    def merge_scales_split(self, split_count: int) -> List[jnp.ndarray]:
+        """Per-TP-rank scale split (ref: weight_quantizer.py:84)."""
+        merged = self.merge_scales()
+        return list(jnp.split(merged, split_count, axis=-1))

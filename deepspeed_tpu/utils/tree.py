@@ -1,0 +1,17 @@
+"""Pytree path helpers shared across the framework."""
+
+
+def tree_path_str(path, sep: str = ".") -> str:
+    """Render a jax tree-path (tuple of DictKey/SequenceKey/GetAttrKey/
+    FlattenedIndexKey entries) as a ``sep``-joined string."""
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return sep.join(parts)
