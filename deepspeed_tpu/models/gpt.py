@@ -202,8 +202,14 @@ def _dropout(x, rate, rng):
 
 def forward(params: Dict, tokens: jnp.ndarray, cfg: GPTConfig,
             rng: Optional[jax.Array] = None,
-            deterministic: bool = True) -> jnp.ndarray:
-    """tokens [B, S] int32 -> logits [B, S, V] (compute dtype)."""
+            deterministic: bool = True,
+            pld_theta: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """tokens [B, S] int32 -> logits [B, S, V] (compute dtype).
+
+    pld_theta: optional progressive-layer-drop keep-base (traced scalar;
+    ref: deepspeed/runtime/progressive_layer_drop.py + arXiv:2010.13369):
+    layer l survives with prob 1 - (l/L)*(1-theta), deeper layers dropped
+    more often. Training-only (pass None for eval)."""
     B, S = tokens.shape
     dtype = cfg.dtype
     wte = params["wte"]["embedding"].astype(dtype)
@@ -213,17 +219,24 @@ def forward(params: Dict, tokens: jnp.ndarray, cfg: GPTConfig,
     block = params["block"]
     L = cfg.n_layers
 
-    def body(carry, layer):
+    def body(carry, scanned):
+        layer, lidx = scanned
         x, r = carry
         r, dr = jax.random.split(r) if r is not None else (None, None)
         y = _block(x, layer, cfg, dropout_rng=dr, deterministic=deterministic)
+        if pld_theta is not None and not deterministic:
+            kr = jax.random.fold_in(dr, jnp.int32(7))
+            keep_p = 1.0 - (lidx.astype(jnp.float32) / L) * \
+                (1.0 - pld_theta.astype(jnp.float32))
+            keep = jax.random.bernoulli(kr, keep_p)
+            y = jnp.where(keep, y, x)
         return (y, r), None
 
     if cfg.remat:
         body = jax.checkpoint(body, policy=jax.checkpoint_policies.nothing_saveable)
 
     rng = rng if rng is not None else jax.random.PRNGKey(0)
-    (x, _), _ = jax.lax.scan(body, (x, rng), block)
+    (x, _), _ = jax.lax.scan(body, (x, rng), (block, jnp.arange(L)))
 
     x = _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
     if cfg.tie_embeddings:
@@ -242,7 +255,8 @@ def loss_fn(params: Dict, batch: Dict, rng: jax.Array, cfg: GPTConfig,
     if targets is None:
         targets = tokens[:, 1:]
         tokens = tokens[:, :-1]
-    logits = forward(params, tokens, cfg, rng, deterministic=deterministic)
+    logits = forward(params, tokens, cfg, rng, deterministic=deterministic,
+                     pld_theta=batch.get("pld_theta"))
     logits = logits.astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)

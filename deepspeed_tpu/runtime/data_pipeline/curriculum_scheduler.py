@@ -1,0 +1,112 @@
+"""Curriculum learning: step-indexed difficulty schedule (seqlen).
+
+Capability match for the reference's ``CurriculumScheduler``
+(ref: deepspeed/runtime/data_pipeline/curriculum_scheduler.py:8) with the
+same three schedule types — ``fixed_discrete``, ``fixed_linear``,
+``fixed_root`` — and the same state dict for checkpointing.
+
+TPU note: the reference injects ``curriculum_seqlen`` as a forward
+kwarg; here the engine *truncates the batch's sequence axis* before the
+jitted step instead. Each distinct difficulty value is a distinct XLA
+program, so ``difficulty_step`` (multiples of 8/16 for Tensor Cores in
+the reference) doubles as the recompile throttle on TPU — and keeps the
+sequence dim friendly to the 128-lane layout.
+"""
+
+import math
+from typing import Any, Dict
+
+from deepspeed_tpu.utils.logging import logger
+
+FIXED_DISCRETE = "fixed_discrete"
+FIXED_LINEAR = "fixed_linear"
+FIXED_ROOT = "fixed_root"
+
+
+class CurriculumScheduler:
+    def __init__(self, config: Dict[str, Any]):
+        self.state: Dict[str, Any] = {}
+        for key in ("curriculum_type", "min_difficulty", "max_difficulty",
+                    "schedule_type"):
+            assert key in config, \
+                f"Curriculum learning requires the config '{key}'"
+        self.state["min_difficulty"] = config["min_difficulty"]
+        self.state["max_difficulty"] = config["max_difficulty"]
+        self.state["current_difficulty"] = config["min_difficulty"]
+        self.state["schedule_type"] = config["schedule_type"]
+        self.first_step = True
+        schedule_config = config.get("schedule_config", {})
+        stype = config["schedule_type"]
+
+        if stype == FIXED_DISCRETE:
+            # difficulty list + max_step list (one shorter; last difficulty
+            # holds for all following steps), ref :22-40
+            assert "difficulty" in schedule_config
+            assert "max_step" in schedule_config
+            assert len(schedule_config["max_step"]) > 0
+            assert len(schedule_config["difficulty"]) == \
+                len(schedule_config["max_step"]) + 1
+            self.state["schedule"] = schedule_config
+        elif stype in (FIXED_ROOT, FIXED_LINEAR):
+            assert "total_curriculum_step" in schedule_config
+            assert "difficulty_step" in schedule_config
+            if stype == FIXED_ROOT:
+                assert "root_degree" in schedule_config
+            if schedule_config["difficulty_step"] % 8 != 0:
+                logger.warning(
+                    "difficulty_step that is a multiple of 8 keeps the "
+                    "sequence dimension aligned to the TPU lane layout; "
+                    "other values may pad/recompile inefficiently.")
+            self.state["schedule"] = schedule_config
+        else:
+            raise RuntimeError("Unsupported curriculum schedule type")
+
+    # -- reference API -------------------------------------------------
+
+    def get_current_difficulty(self) -> int:
+        return self.state["current_difficulty"]
+
+    def set_current_difficulty(self, difficulty: int) -> None:
+        self.state["current_difficulty"] = difficulty
+
+    def get_state(self) -> Dict[str, Any]:
+        return self.state
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.state = state
+
+    def _fixed_discrete(self, global_steps: int) -> int:
+        s = self.state["schedule"]
+        if global_steps > s["max_step"][-1]:
+            return s["difficulty"][-1]
+        for i, mstep in enumerate(s["max_step"]):
+            if global_steps <= mstep:
+                return s["difficulty"][i]
+        return s["difficulty"][-1]
+
+    def _fixed_root(self, global_steps: int, root_degree=None) -> int:
+        s = self.state["schedule"]
+        if root_degree is None:
+            root_degree = s["root_degree"]
+        frac = (float(global_steps) / s["total_curriculum_step"]) \
+            ** (1.0 / root_degree)
+        next_difficulty = math.floor(
+            frac * (self.state["max_difficulty"] - self.state["min_difficulty"])
+            + self.state["min_difficulty"])
+        next_difficulty -= next_difficulty % s["difficulty_step"]
+        return min(next_difficulty, self.state["max_difficulty"])
+
+    def get_difficulty(self, global_steps: int) -> int:
+        stype = self.state["schedule_type"]
+        if stype == FIXED_DISCRETE:
+            return self._fixed_discrete(global_steps)
+        if stype == FIXED_LINEAR:
+            return self._fixed_root(global_steps, 1)
+        if stype == FIXED_ROOT:
+            return self._fixed_root(global_steps)
+        raise RuntimeError("Unsupported curriculum schedule type")
+
+    def update_difficulty(self, global_steps: int) -> int:
+        if self.state["current_difficulty"] < self.state["max_difficulty"]:
+            self.state["current_difficulty"] = self.get_difficulty(global_steps)
+        return self.state["current_difficulty"]

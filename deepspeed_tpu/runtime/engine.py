@@ -227,6 +227,32 @@ class DeepSpeedEngine:
         # stable identity so Eigenvalue's jitted HVP cache hits
         self._eigenvalue_loss = _eigenvalue_loss
 
+        # --- curriculum learning (ref: engine.py:1548-1554) -----------
+        if config.curriculum.enabled:
+            from deepspeed_tpu.runtime.data_pipeline import CurriculumScheduler
+            cc = config.curriculum
+            self.curriculum_scheduler = CurriculumScheduler({
+                "curriculum_type": cc.curriculum_type,
+                "min_difficulty": cc.min_difficulty,
+                "max_difficulty": cc.max_difficulty,
+                "schedule_type": cc.schedule_type,
+                "schedule_config": cc.schedule_config})
+        else:
+            self.curriculum_scheduler = None
+
+        # --- progressive layer drop (ref: engine.py:1542) -------------
+        if config.pld.enabled:
+            if self.offload_enabled:
+                raise NotImplementedError(
+                    "progressive_layer_drop with offload_optimizer is "
+                    "not supported")
+            from deepspeed_tpu.runtime.progressive_layer_drop import (
+                ProgressiveLayerDrop)
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=config.pld.theta, gamma=config.pld.gamma)
+        else:
+            self.progressive_layer_drop = None
+
         # --- compiled programs ---------------------------------------
         self._donate_state = donate_state
         if self.offload_enabled:
@@ -341,8 +367,9 @@ class DeepSpeedEngine:
         # quantizes optimizer.bit16_groups, not the fp32 masters)
         quant_fn = self.quantizer.make_transform() \
             if (self.quantizer is not None and self.quantizer.active) else None
+        pld_cfg = cfg.pld if cfg.pld.enabled else None
 
-        def micro_loss(params, micro_batch, rng, scale_state):
+        def micro_loss(params, micro_batch, rng, scale_state, step):
             cparams = _cast_tree(params, compute_dtype)
             if quant_fn is not None:
                 rng, qr = jax.random.split(rng)
@@ -351,6 +378,15 @@ class DeepSpeedEngine:
             # of module AND inputs) so activations genuinely run on the MXU in
             # the reduced precision
             micro_batch = _cast_tree(micro_batch, compute_dtype)
+            if pld_cfg is not None and isinstance(micro_batch, dict):
+                # PLD keep-prob: a pure function of the step counter,
+                # threaded as a traced scalar (ref: engine.py:1542 injects
+                # it as a fwd kwarg host-side)
+                from deepspeed_tpu.runtime.progressive_layer_drop import (
+                    PLD_THETA_KEY, theta_schedule)
+                micro_batch = dict(micro_batch)
+                micro_batch[PLD_THETA_KEY] = theta_schedule(
+                    step, pld_cfg.theta, pld_cfg.gamma)
             out = loss_fn(cparams, micro_batch, rng)
             if has_aux:
                 loss, aux = out
@@ -368,7 +404,8 @@ class DeepSpeedEngine:
             def micro_body(carry, micro):
                 grads_acc, loss_acc, r = carry
                 r, mr = jax.random.split(r)
-                g, (loss, _aux) = grad_fn(state.params, micro, mr, state.scale_state)
+                g, (loss, _aux) = grad_fn(state.params, micro, mr,
+                                          state.scale_state, state.step)
                 if prescale and predivide != 1.0:
                     g = jax.tree_util.tree_map(lambda x: x / predivide, g)
                 grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, g)
@@ -584,6 +621,14 @@ class DeepSpeedEngine:
         forward+backward+step triple into one XLA program."""
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
+        if self.curriculum_scheduler is not None:
+            difficulty = self.curriculum_scheduler.update_difficulty(
+                self.global_steps + 1)
+            batch = self._apply_curriculum(batch, difficulty)
+        if self.progressive_layer_drop is not None:
+            # keyed on state.step (applied steps), matching the in-jit
+            # theta_schedule exactly even when fp16 overflow skips steps
+            self.progressive_layer_drop.update_state(int(self.state.step))
         batch = self._shard_batch(batch)
         if self.offload_enabled:
             metrics = self._offload_train_batch(batch)
@@ -601,6 +646,42 @@ class DeepSpeedEngine:
         if self.global_steps % self.config.steps_per_print == 0:
             self._report_progress(metrics)
         return metrics
+
+    # batch-dict keys whose axis 1 is a sequence dimension; other leaves
+    # (class labels, masks with sequence elsewhere, ...) are left alone
+    CURRICULUM_SEQ_KEYS = ("tokens", "input_ids", "targets", "labels",
+                           "loss_mask", "attention_mask", "position_ids")
+
+    def set_curriculum_transform(self, fn) -> None:
+        """Override the seqlen truncation with a custom
+        ``fn(batch, difficulty) -> batch`` (required for non-dict
+        batches or models whose sequence axis is not axis 1)."""
+        self._curriculum_transform = fn
+
+    def _apply_curriculum(self, batch: PyTree, difficulty: int) -> PyTree:
+        """seqlen curriculum: truncate the sequence axis (axis 1) of the
+        well-known token/label keys of a dict batch. Each distinct
+        difficulty is one XLA program — difficulty_step bounds the
+        recompile count (ref: the fwd-kwarg seqlen injection,
+        engine.py:1548-1554)."""
+        custom = getattr(self, "_curriculum_transform", None)
+        if custom is not None:
+            return custom(batch, difficulty)
+        if self.config.curriculum.curriculum_type != "seqlen":
+            return batch
+        if not isinstance(batch, dict):
+            raise TypeError(
+                "seqlen curriculum needs a dict batch with token keys "
+                f"{self.CURRICULUM_SEQ_KEYS}; for other batch layouts "
+                "call engine.set_curriculum_transform(fn)")
+
+        def trunc(x):
+            if hasattr(x, "ndim") and x.ndim >= 2 and x.shape[1] > difficulty:
+                return x[:, :difficulty]
+            return x
+
+        return {k: (trunc(v) if k in self.CURRICULUM_SEQ_KEYS else v)
+                for k, v in batch.items()}
 
     def _take_quantize_step(self, batch, overflow: bool) -> None:
         """Post-step MoQ hook: optionally refresh block eigenvalues at a

@@ -1,0 +1,53 @@
+"""Progressive Layer Dropping (PLD) — arXiv:2010.13369.
+
+Capability match for the reference's ``ProgressiveLayerDrop``
+(ref: deepspeed/runtime/progressive_layer_drop.py:5): a global keep
+probability ``theta(t) = (1-theta)*exp(-gamma*t) + theta`` that decays
+from 1.0 toward ``theta``; deeper layers are dropped more aggressively
+(the model applies keep prob ``1 - l/L * (1-theta(t))`` per layer).
+
+TPU-native: theta is a deterministic function of the step counter, so
+instead of injecting a host-side kwarg each step (ref: engine.py:1542
+fwd-kwarg injection, which would force a recompile per value) the
+engine computes it *inside* the jitted step from ``state.step`` via
+:func:`theta_schedule` and threads it through the batch dict as a
+traced scalar under the key ``"pld_theta"``. Models that support PLD
+read that key (see models/gpt.py).
+"""
+
+import math
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.logging import log_dist
+
+PLD_THETA_KEY = "pld_theta"
+
+
+def theta_schedule(global_step, theta: float, gamma: float):
+    """Pure/traceable: theta(t) = (1-p)*exp(-gamma*t) + p
+    (ref: progressive_layer_drop.py:31 _prob)."""
+    return (1.0 - theta) * jnp.exp(-gamma * global_step.astype(jnp.float32)) \
+        + theta
+
+
+class ProgressiveLayerDrop:
+    """Host-side mirror of the schedule, for reporting/checkpointing
+    (the in-jit path uses :func:`theta_schedule` directly)."""
+
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+        log_dist(f"Enabled progressive layer dropping (theta = {theta})",
+                 ranks=[0])
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> None:
+        self.current_theta = (1.0 - self.theta) * \
+            math.exp(-self.gamma * global_step) + self.theta

@@ -1,0 +1,110 @@
+"""Compressed row-sparse tensor + sparse gradient allreduce.
+
+Capability match for the reference's ``SparseTensor``
+(ref: deepspeed/runtime/sparse_tensor.py:11) and the engine's sparse
+embedding-gradient allreduce (ref: runtime/engine.py:2178-2250
+sparse_allreduce_bucket: allgather indices+values, sum densely).
+
+TPU context: jax/XLA gradients are dense, so the sparse path is an
+*opt-in* bandwidth optimization for embedding-style grads whose rows
+are mostly zero — worthwhile over DCN where bytes are precious, not
+over ICI. Static shapes rule: ``from_dense`` takes ``max_rows`` (the
+row-count capacity, a trace-time constant) and pads, exactly how the
+reference's variable-length allgather becomes a fixed-size program.
+"""
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SparseTensor:
+    """Row-compressed 2-D tensor: ``indices`` (n,), ``values`` (n, cols),
+    ``dense_size`` (rows, cols). Row capacity is static; unused slots
+    hold index ``rows`` (one-past-end sentinel) and zero values so that
+    ``to_dense`` scatter-adds are a no-op for them."""
+
+    def __init__(self, indices: jnp.ndarray, values: jnp.ndarray,
+                 dense_size: Tuple[int, int]):
+        self.indices = indices
+        self.values = values
+        self.dense_size = tuple(dense_size)
+
+    @staticmethod
+    def type() -> str:
+        return "deepspeed_tpu.SparseTensor"
+
+    @classmethod
+    def from_dense(cls, dense: jnp.ndarray,
+                   max_rows: Optional[int] = None) -> "SparseTensor":
+        """Compress rows with any non-zero entry (ref:
+        sparse_tensor.py:22 nonzero of row sums). ``max_rows`` bounds
+        the static capacity (defaults to all rows — no compression win,
+        but shape-safe)."""
+        rows, _ = dense.shape
+        max_rows = max_rows if max_rows is not None else rows
+        row_mass = jnp.sum(jnp.abs(dense), axis=1)
+        # top-k by mass: static-shape stand-in for nonzero(); rows with
+        # zero mass land at the tail and are masked out
+        _, idx = jax.lax.top_k(row_mass, max_rows)
+        mask = row_mass[idx] > 0
+        indices = jnp.where(mask, idx, rows)
+        values = jnp.where(mask[:, None], dense[idx], 0.0)
+        return cls(indices, values, dense.shape)
+
+    def to_dense(self) -> jnp.ndarray:
+        rows, cols = self.dense_size
+        buf = jnp.zeros((rows + 1, cols), self.values.dtype)  # +1: sentinel row
+        buf = buf.at[self.indices].add(self.values)
+        return buf[:rows]
+
+    def sparse_size(self) -> Tuple[int, int]:
+        index_size = self.indices.shape[0]
+        value_size = self.values.shape[0] * self.values.shape[1]
+        dense_size = self.dense_size[0] * self.dense_size[1]
+        return index_size + value_size, dense_size
+
+    def add(self, b: "SparseTensor") -> None:
+        assert self.dense_size == b.dense_size
+        self.indices = jnp.concatenate([self.indices, b.indices])
+        self.values = jnp.concatenate([self.values, b.values])
+
+    def __str__(self):
+        sparse_size, dense_size = self.sparse_size()
+        return (f"deepspeed_tpu.SparseTensor(indices_size="
+                f"{self.indices.shape}, values_size={self.values.shape}, "
+                f"dense_size={self.dense_size}, "
+                f"reduction_factor={dense_size / sparse_size:.2f})")
+
+    __repr__ = __str__
+
+
+def sparse_all_reduce(indices: jnp.ndarray, values: jnp.ndarray,
+                      dense_size: Tuple[int, int],
+                      axis_name: str) -> jnp.ndarray:
+    """Allreduce of row-sparse grads inside ``shard_map``: allgather the
+    (indices, values) pairs over ``axis_name`` and densify locally —
+    the reference's sparse_allreduce_bucket recipe (ref:
+    engine.py:2211-2236: all_gather of values+indices, caller sums) with
+    XLA's ``all_gather`` riding ICI/DCN. Returns the summed DENSE grad
+    (mean is the caller's division, as in the reference's
+    ``average_sparse_gradients``)."""
+    all_idx = jax.lax.all_gather(indices, axis_name)      # (world, n)
+    all_val = jax.lax.all_gather(values, axis_name)       # (world, n, cols)
+    rows, cols = dense_size
+    buf = jnp.zeros((rows + 1, cols), values.dtype)
+    buf = buf.at[all_idx.reshape(-1)].add(
+        all_val.reshape(-1, cols))
+    return buf[:rows]
+
+
+def average_sparse(st_list: Sequence[SparseTensor],
+                   world_size: int) -> List[SparseTensor]:
+    """Scale values by 1/world (ref: engine.py:2191
+    average_sparse_gradients)."""
+    out = []
+    for st in st_list:
+        out.append(SparseTensor(st.indices, st.values / world_size,
+                                st.dense_size))
+    return out

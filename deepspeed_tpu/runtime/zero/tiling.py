@@ -1,0 +1,138 @@
+"""TiledLinear: huge linear layers computed tile-by-tile.
+
+Capability match for the reference's ``TiledLinear``
+(ref: deepspeed/runtime/zero/tiling.py:27): break a linear's input and
+output dimensions into tiles so only one tile's weights/activations are
+live at a time — there ZeRO-3 fetches/releases per tile; here the tiles
+are a stacked array sharded over the ``fsdp`` axis and the per-tile
+matmul is wrapped in ``jax.checkpoint`` so XLA frees tile activations
+between steps of the ``lax.scan`` instead of keeping the full GEMM's
+intermediates live.
+
+Functional API (params are a pytree, not a module):
+
+    params = tiled_linear_init(rng, in_features, out_features,
+                               in_splits=4, out_splits=4)
+    y = tiled_linear(x, params)
+
+Tile layout: ``kernel`` has shape (out_splits, in_splits, in_tile,
+out_tile); ``bias`` (when used) has shape (out_splits, out_tile).
+Uneven splits are handled the reference's way — CSR-style partition
+boundaries (ref: tiling.py:94 partition call) — except tiles here must
+be equal-sized for stacking; ``in_features % in_splits == 0`` is
+required (pad to a multiple, the idiomatic TPU answer anyway).
+"""
+
+from functools import partial
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def split_tensor_along_last_dim(tensor: jnp.ndarray, partitions,
+                                contiguous_split_chunks: bool = False):
+    """Reference helper parity (ref: tiling.py:12): split the last dim
+    at the given boundary list."""
+    del contiguous_split_chunks
+    return jnp.split(tensor, partitions, axis=-1)
+
+
+def tiled_linear_init(rng: jax.Array,
+                      in_features: int,
+                      out_features: int,
+                      in_splits: int = 1,
+                      out_splits: int = 1,
+                      bias: bool = True,
+                      dtype=jnp.float32,
+                      init_scale: Optional[float] = None) -> Dict:
+    if in_splits < 1 or in_splits > in_features:
+        raise RuntimeError("in splits must be in range [1, in_features].")
+    if out_splits < 1 or out_splits > out_features:
+        raise RuntimeError("out splits must be in range [1, out_features].")
+    if in_features % in_splits or out_features % out_splits:
+        raise RuntimeError(
+            "tile splits must divide features evenly on TPU (pad to a "
+            f"multiple): {in_features}%{in_splits}, {out_features}%{out_splits}")
+    in_tile = in_features // in_splits
+    out_tile = out_features // out_splits
+    scale = init_scale if init_scale is not None else 1.0 / (in_features ** 0.5)
+    kernel = jax.random.normal(
+        rng, (out_splits, in_splits, in_tile, out_tile), dtype) * scale
+    params = {"kernel": kernel}
+    if bias:
+        params["bias"] = jnp.zeros((out_splits, out_tile), dtype)
+    return params
+
+
+def from_dense(kernel: jnp.ndarray, bias: Optional[jnp.ndarray],
+               in_splits: int, out_splits: int) -> Dict:
+    """Tile an existing dense (in, out) kernel (ref: tiling.py:150
+    copy_params_from / init_linear)."""
+    in_features, out_features = kernel.shape
+    in_tile = in_features // in_splits
+    out_tile = out_features // out_splits
+    k = kernel.reshape(in_splits, in_tile, out_splits, out_tile)
+    k = k.transpose(2, 0, 1, 3)  # (out_s, in_s, in_tile, out_tile)
+    params = {"kernel": k}
+    if bias is not None:
+        params["bias"] = bias.reshape(out_splits, out_tile)
+    return params
+
+
+def to_dense(params: Dict):
+    """Inverse of :func:`from_dense`."""
+    k = params["kernel"]
+    out_s, in_s, in_t, out_t = k.shape
+    kernel = k.transpose(1, 2, 0, 3).reshape(in_s * in_t, out_s * out_t)
+    bias = params.get("bias")
+    if bias is not None:
+        bias = bias.reshape(out_s * out_t)
+    return kernel, bias
+
+
+@partial(jax.jit, static_argnames=("combine_out_splits", "use_remat"))
+def tiled_linear(x: jnp.ndarray,
+                 params: Dict,
+                 combine_out_splits: bool = True,
+                 use_remat: bool = True):
+    """y = x @ W + b computed per (out_tile, in_tile) pair
+    (ref: tiling.py:122 forward's double loop). The in_splits reduction
+    runs as a ``lax.scan`` so only one partial product is live; remat
+    drops tile intermediates on the backward pass."""
+    kernel = params["kernel"]
+    out_s, in_s, in_t, out_t = kernel.shape
+    bias = params.get("bias")
+
+    x_tiles = x.reshape(x.shape[:-1] + (in_s, in_t))
+    x_tiles = jnp.moveaxis(x_tiles, -2, 0)  # (in_s, ..., in_t)
+
+    def one_out(kernel_o, bias_o):
+        def body(acc, operand):
+            xt, kt = operand
+            if use_remat:
+                part = jax.checkpoint(lambda a, b: a @ b)(xt, kt)
+            else:
+                part = xt @ kt
+            return acc + part, None
+
+        init = jnp.zeros(x.shape[:-1] + (out_t,), x.dtype)
+        acc, _ = jax.lax.scan(body, init, (x_tiles, kernel_o))
+        if bias_o is not None:
+            acc = acc + bias_o
+        return acc
+
+    outs = jax.vmap(one_out, in_axes=(0, 0 if bias is not None else None),
+                    out_axes=-2)(kernel, bias)
+    # outs: (..., out_s, out_t)
+    if combine_out_splits:
+        return outs.reshape(x.shape[:-1] + (out_s * out_t,))
+    return [outs[..., i, :] for i in range(out_s)]
+
+
+def tiled_linear_partition_rules(prefix: str = ".*kernel"):
+    """fsdp-shard the stacked tile axes: with (out_s, in_s, ...) leading,
+    the fsdp axis splits whole tiles, the unit ZeRO-3 fetches/releases."""
+    from deepspeed_tpu.parallel.sharding import PartitionRule
+    from jax.sharding import PartitionSpec as P
+    return [PartitionRule(prefix, P("fsdp", None, None, None))]
