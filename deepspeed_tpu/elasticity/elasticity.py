@@ -1,0 +1,254 @@
+"""Elasticity v0.1 — batch-size elasticity across restarts.
+
+Capability match for the reference's elasticity module
+(ref: deepspeed/elasticity/elasticity.py:226 compute_elastic_config,
+:128 _get_compatible_gpus_v01): given acceptable micro-batch sizes and a
+max global batch size, compute ONE fixed global batch size plus the
+list of chip counts that divide it evenly — so a resource scheduler can
+scale the job up/down across restarts with zero convergence impact
+(global batch = micro_batch x grad_accum x world stays constant).
+
+This is *not* in-job fault tolerance (neither is the reference's);
+recovery remains checkpoint-resume. TPU addition: slices come in fixed
+topologies, so ``allowed_chip_counts`` (e.g. {1,4,8,16,32,...} for v5e
+slice shapes) optionally filters the valid counts to reachable slice
+sizes.
+"""
+
+import json
+import math
+import os
+from functools import reduce
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.version import __version__
+
+ELASTICITY = "elasticity"
+ENABLED = "enabled"
+ENABLED_DEFAULT = False
+MAX_ACCEPTABLE_BATCH_SIZE = "max_train_batch_size"
+MICRO_BATCHES = "micro_batch_sizes"
+MIN_CHIPS, MAX_CHIPS = "min_gpus", "max_gpus"  # reference key names kept
+MIN_TIME = "min_time"
+VERSION = "version"
+IGNORE_NON_ELASTIC_BATCH_INFO = "ignore_non_elastic_batch_info"
+LATEST_ELASTICITY_VERSION = 0.1
+MINIMUM_DEEPSPEED_VERSION = "0.1.0"
+DEEPSPEED_ELASTICITY_CONFIG = "DEEPSPEED_ELASTICITY_CONFIG"
+
+# Thirty-eight smallest highly composite numbers — supports batch sizes
+# up to 720K (ref: elasticity.py:20 HCN_LIST)
+HCN_LIST = [
+    1, 2, 4, 6, 12, 24, 36, 48, 60, 120, 180, 240, 360, 720, 840, 1260,
+    1680, 2520, 5040, 7560, 10080, 15120, 20160, 25200, 27720, 45360,
+    50400, 55440, 83160, 110880, 166320, 221760, 277200, 332640, 498960,
+    554400, 665280, 720720
+]
+
+
+class ElasticityError(Exception):
+    """Base exception for elasticity errors."""
+
+
+class ElasticityConfigError(ElasticityError):
+    pass
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    pass
+
+
+class ElasticityConfig:
+    """(ref: elasticity/config.py:27) validated elastic sub-config."""
+
+    def __init__(self, param_dict: Dict):
+        self.enabled = param_dict.get(ENABLED, ENABLED_DEFAULT)
+        if self.enabled:
+            if MAX_ACCEPTABLE_BATCH_SIZE not in param_dict:
+                raise ElasticityConfigError(
+                    f"Elasticity config missing {MAX_ACCEPTABLE_BATCH_SIZE}")
+            if MICRO_BATCHES not in param_dict:
+                raise ElasticityConfigError(
+                    f"Elasticity config missing {MICRO_BATCHES}")
+        self.max_acceptable_batch_size = param_dict.get(
+            MAX_ACCEPTABLE_BATCH_SIZE, 2000)
+        self.micro_batches = param_dict.get(MICRO_BATCHES, [2, 4, 6])
+        if not isinstance(self.micro_batches, list):
+            raise ElasticityConfigError(
+                f"{MICRO_BATCHES} must be a list, got "
+                f"{type(self.micro_batches)}")
+        if not all(isinstance(m, int) and m > 0 for m in self.micro_batches):
+            raise ElasticityConfigError(
+                f"{MICRO_BATCHES} must be positive ints: {self.micro_batches}")
+        self.min_gpus = param_dict.get(MIN_CHIPS, 1)
+        self.max_gpus = param_dict.get(MAX_CHIPS, -1)
+        self.min_time = param_dict.get(MIN_TIME, 0)
+        self.version = param_dict.get(VERSION, LATEST_ELASTICITY_VERSION)
+        self.ignore_non_elastic_batch_info = param_dict.get(
+            IGNORE_NON_ELASTIC_BATCH_INFO, False)
+        self.prefer_larger_batch_size = param_dict.get("prefer_larger_batch", True)
+        self.allowed_chip_counts = param_dict.get("allowed_chip_counts")
+
+    def repr(self) -> Dict:
+        return self.__dict__
+
+
+def get_candidate_batch_sizes(base_list: Sequence[int],
+                              max_acceptable_batch_size: int) -> List[int]:
+    """Scale each base by the largest HCN that keeps the product under
+    the cap (ref: elasticity.py:63)."""
+    candidates = set()
+    for base in base_list:
+        if base >= max_acceptable_batch_size:
+            candidates.add(base)
+        else:
+            value = max_acceptable_batch_size // base
+            hcn = max(h for h in HCN_LIST if h <= value)
+            candidates.add(hcn * base)
+    return sorted(candidates)
+
+
+def get_valid_gpus(batch_size: int, micro_batches: Sequence[int],
+                   min_valid_gpus: int, max_valid_gpus: int) -> List[int]:
+    """All chip counts w such that batch_size == micro * gas * w for some
+    acceptable micro and integer gas (ref: elasticity.py:77)."""
+    valid = set()
+    for micro_batch in micro_batches:
+        if batch_size % micro_batch != 0:
+            continue
+        max_chips = batch_size // micro_batch
+        if min_valid_gpus <= max_chips <= max_valid_gpus:
+            valid.add(max_chips)
+        for i in range(1, max_chips // 2 + 1):
+            if i > max_valid_gpus:
+                break
+            if i < min_valid_gpus:
+                continue
+            if max_chips % i == 0:
+                valid.add(i)
+    return sorted(valid)
+
+
+def get_best_candidates(candidate_batch_sizes: Sequence[int],
+                        micro_batches: Sequence[int], min_gpus: int,
+                        max_gpus: int, prefer_larger: bool
+                        ) -> Tuple[int, Optional[List[int]]]:
+    """Pick the candidate with the most compatible chip counts
+    (ref: elasticity.py:100)."""
+    max_valid = 0
+    valid_gpus = None
+    final_batch_size = int(min(micro_batches))
+    for batch_size in candidate_batch_sizes:
+        current = get_valid_gpus(batch_size, micro_batches, min_gpus, max_gpus)
+        if (len(current) > max_valid
+                or (len(current) == max_valid
+                    and ((prefer_larger and batch_size > final_batch_size)
+                         or (not prefer_larger
+                             and batch_size < final_batch_size)))):
+            max_valid = len(current)
+            valid_gpus = current
+            final_batch_size = batch_size
+    return final_batch_size, valid_gpus
+
+
+def _get_compatible_gpus_v01(micro_batches: Sequence[int],
+                             max_acceptable_batch_size: int,
+                             min_gpus: Optional[int] = None,
+                             max_gpus: Optional[int] = None,
+                             prefer_larger: bool = True
+                             ) -> Tuple[int, Optional[List[int]]]:
+    """v0.1 heuristic (ref: elasticity.py:128): candidates = each micro
+    batch and their LCM, each scaled by highly-composite multipliers;
+    winner maximizes the count of compatible chip counts."""
+    min_gpus = min_gpus or 1
+    max_gpus = max_gpus if max_gpus and max_gpus > 0 else \
+        max_acceptable_batch_size // min(micro_batches)
+    if not all(mb <= max_acceptable_batch_size for mb in micro_batches):
+        raise ValueError(
+            "All micro batches must be <= max_acceptable_batch_size "
+            f"({max_acceptable_batch_size}): {micro_batches}")
+    lcm = reduce(lambda a, b: a * b // math.gcd(a, b), micro_batches)
+    base_list = list(micro_batches) + [lcm]
+    candidates = get_candidate_batch_sizes(base_list, max_acceptable_batch_size)
+    return get_best_candidates(candidates, micro_batches, min_gpus,
+                               max_gpus, prefer_larger)
+
+
+def elasticity_enabled(ds_config: Dict) -> bool:
+    if ELASTICITY not in ds_config:
+        return False
+    return ds_config[ELASTICITY].get(ENABLED, ENABLED_DEFAULT)
+
+
+def ensure_immutable_elastic_config(runtime_elastic_config_dict: Dict) -> None:
+    """Cross-check the scheduler's view (env) against the runtime config
+    (ref: elasticity.py:192)."""
+    if DEEPSPEED_ELASTICITY_CONFIG in os.environ:
+        scheduler = ElasticityConfig(
+            json.loads(os.environ[DEEPSPEED_ELASTICITY_CONFIG]))
+        runtime = ElasticityConfig(runtime_elastic_config_dict)
+        for field in ("max_acceptable_batch_size", "micro_batches", "version"):
+            if getattr(runtime, field) != getattr(scheduler, field):
+                raise ElasticityConfigError(
+                    f"Elastic config '{field}={getattr(scheduler, field)}' "
+                    f"seen by resource scheduler does not match runtime "
+                    f"{field}={getattr(runtime, field)}")
+    else:
+        logger.warning(
+            "DEEPSPEED_ELASTICITY_CONFIG env not found; cannot guarantee "
+            "the resource scheduler will scale with compatible chip counts.")
+
+
+def compute_elastic_config(ds_config: Dict, target_deepspeed_version: str,
+                           world_size: int = 0,
+                           allowed_chip_counts: Optional[Set[int]] = None):
+    """Core elasticity API (ref: elasticity.py:226). Returns
+    (final_batch_size, valid_chip_counts, micro_batch_for_world) — the
+    third only when ``world_size`` is given.
+
+    ``allowed_chip_counts``: optional TPU slice-shape filter (a v5e pod
+    only offers 1/4/8/16/..., so other divisor counts are unreachable).
+    """
+    elastic_config_dict = ds_config.get(ELASTICITY)
+    if not elastic_config_dict:
+        raise ElasticityConfigError(
+            f"'{ELASTICITY}' is missing from config json")
+    elastic_config = ElasticityConfig(elastic_config_dict)
+    if not elastic_config.enabled:
+        raise ElasticityConfigError("Elasticity is not enabled")
+    if float(elastic_config.version) > LATEST_ELASTICITY_VERSION:
+        raise ElasticityError(
+            f"Unsupported elasticity version {elastic_config.version}")
+
+    final_batch_size, valid_gpus = _get_compatible_gpus_v01(
+        micro_batches=elastic_config.micro_batches,
+        max_acceptable_batch_size=elastic_config.max_acceptable_batch_size,
+        min_gpus=elastic_config.min_gpus,
+        max_gpus=elastic_config.max_gpus,
+        prefer_larger=elastic_config.prefer_larger_batch_size)
+
+    allowed = allowed_chip_counts or elastic_config.allowed_chip_counts
+    if allowed:
+        valid_gpus = sorted(set(valid_gpus) & set(allowed))
+        if not valid_gpus:
+            raise ElasticityError(
+                "no compatible chip count is an allowed slice shape")
+
+    logger.info(f"elastic config: final_batch_size={final_batch_size}, "
+                f"valid chip counts={valid_gpus}")
+
+    if world_size > 0:
+        if world_size not in valid_gpus:
+            raise ElasticityIncompatibleWorldSize(
+                f"World size ({world_size}) is not valid with the current "
+                f"list of valid chip counts: {valid_gpus}")
+        # pick the largest micro batch that fits evenly on this world
+        micro = None
+        for mb in sorted(elastic_config.micro_batches, reverse=True):
+            if final_batch_size // world_size % mb == 0:
+                micro = mb
+                break
+        return final_batch_size, valid_gpus, micro
+
+    return final_batch_size, valid_gpus

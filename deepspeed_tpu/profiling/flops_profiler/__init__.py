@@ -1,0 +1,6 @@
+from deepspeed_tpu.profiling.flops_profiler.profiler import (
+    FlopsProfiler, analyze_compiled, analyze_fn, get_model_profile,
+    device_peak_flops)
+
+__all__ = ["FlopsProfiler", "analyze_compiled", "analyze_fn",
+           "get_model_profile", "device_peak_flops"]

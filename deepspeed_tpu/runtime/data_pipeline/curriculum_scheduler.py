@@ -34,7 +34,6 @@ class CurriculumScheduler:
         self.state["max_difficulty"] = config["max_difficulty"]
         self.state["current_difficulty"] = config["min_difficulty"]
         self.state["schedule_type"] = config["schedule_type"]
-        self.first_step = True
         schedule_config = config.get("schedule_config", {})
         stype = config["schedule_type"]
 

@@ -16,6 +16,7 @@ allgather traffic the reference drives by hand with backward hooks
 """
 
 import os
+import time
 from functools import partial
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
@@ -121,6 +122,26 @@ class DeepSpeedEngine:
 
         self.dp_world_size = mesh_lib.dp_world_size(self.mesh)
         self.mp_world_size = mesh_lib.axis_size(self.mesh, "model")
+
+        # --- elasticity v0.1 enforcement (ref: engine.py:425 + the
+        # elastic batch resolution in deepspeed/__init__.py) -----------
+        if config.elasticity_enabled:
+            from deepspeed_tpu.elasticity import (
+                compute_elastic_config, ensure_immutable_elastic_config)
+            from deepspeed_tpu.version import __version__ as _ver
+            ensure_immutable_elastic_config(config.elasticity_dict)
+            # valid counts are PHYSICAL chip counts (what the scheduler
+            # allocates), so validate the full mesh size, not dp alone
+            final_bs, _valid, _micro = compute_elastic_config(
+                {"elasticity": config.elasticity_dict}, _ver,
+                world_size=int(np.prod(list(self.mesh.shape.values()))))
+            if not config.elasticity_dict.get(
+                    "ignore_non_elastic_batch_info", False) and \
+                    config.train_batch_size != final_bs:
+                raise ValueError(
+                    f"train_batch_size={config.train_batch_size} conflicts "
+                    f"with the elastic batch size {final_bs}; set it to "
+                    f"{final_bs} or ignore_non_elastic_batch_info=true")
         from deepspeed_tpu.utils import groups as groups_lib
         groups_lib.set_mesh(self.mesh)
 
@@ -626,14 +647,28 @@ class DeepSpeedEngine:
                 self.global_steps + 1)
             batch = self._apply_curriculum(batch, difficulty)
         if self.progressive_layer_drop is not None:
-            # keyed on state.step (applied steps), matching the in-jit
-            # theta_schedule exactly even when fp16 overflow skips steps
-            self.progressive_layer_drop.update_state(int(self.state.step))
+            # keyed on applied steps, matching the in-jit theta_schedule
+            # even when fp16 overflow skips steps; computed host-side
+            # (global - skipped) to avoid syncing on state.step
+            self.progressive_layer_drop.update_state(
+                self.global_steps - self.skipped_steps)
         batch = self._shard_batch(batch)
+        t0 = time.perf_counter()
         if self.offload_enabled:
             metrics = self._offload_train_batch(batch)
         else:
             self.state, metrics = self._train_step(self.state, batch)
+        profiling_now = (self.config.flops_profiler.enabled
+                         and not self.offload_enabled
+                         and self.global_steps + 1 ==
+                         self.config.flops_profiler.profile_step)
+        if profiling_now:
+            # block only on the profiled step — every other step keeps
+            # async dispatch so the host can run ahead
+            jax.block_until_ready(metrics["loss"])
+        self._last_step_duration = time.perf_counter() - t0
+        if profiling_now:
+            self._run_flops_profile(batch)
         self.timers(TRAIN_BATCH_TIMER).stop()
         self.tput_timer.stop(global_step=True)
         if self.quantizer is not None:
@@ -646,6 +681,55 @@ class DeepSpeedEngine:
         if self.global_steps % self.config.steps_per_print == 0:
             self._report_progress(metrics)
         return metrics
+
+    def set_flops_per_batch(self, flops: float) -> None:
+        """Analytic per-batch flops override for the profiler. XLA's
+        cost analysis counts a lax.scan body once, so scan-over-layers
+        models (our GPT) undercount; pass e.g.
+        ``gpt.train_flops_per_token(cfg, S) * tokens_per_batch``."""
+        self._flops_per_batch = flops
+
+    def _run_flops_profile(self, batch: PyTree) -> None:
+        """One-step flops profile (ref: engine.py:1535-1540 triggers the
+        FlopsProfiler for flops_profiler.profile_step). Static XLA cost
+        analysis of the already-compiled train step + this step's
+        measured wall time → achieved TFLOPS / MFU."""
+        from deepspeed_tpu.profiling.flops_profiler import (
+            analyze_compiled, device_peak_flops)
+        try:
+            cost = analyze_compiled(self._train_step, self.state, batch)
+        except Exception as e:  # pragma: no cover - backend-dependent
+            log_dist(f"flops profile unavailable: {e}", ranks=[0])
+            return
+        override = getattr(self, "_flops_per_batch", None)
+        if override:
+            cost = dict(cost, flops=float(override))
+        dur = max(self._last_step_duration, 1e-9)
+        n_params = count_parameters(self.state.params)
+        achieved = cost["flops"] / dur
+        peak = device_peak_flops()
+        n_dev = max(1, len(jax.devices()))
+        lines = [
+            "", "-" * 64, "DeepSpeed-TPU Flops Profiler (train step)",
+            "-" * 64,
+            f"profile step:        {self.global_steps + 1}",
+            f"params:              {n_params / 1e6:.2f} M",
+            f"step flops:          {cost['flops'] / 1e12:.3f} TF",
+            f"HBM bytes accessed:  {cost['bytes_accessed'] / 1e9:.2f} GB",
+            f"step latency:        {dur * 1e3:.2f} ms",
+            f"achieved throughput: {achieved / 1e12:.2f} TFLOPS "
+            f"({achieved / n_dev / 1e12:.2f}/device)",
+            f"samples/sec:         {self.config.train_batch_size / dur:.1f}",
+        ]
+        if peak:
+            lines.append(
+                f"MFU:                 {achieved / (peak * n_dev) * 100:.1f}%")
+        lines.append("-" * 64)
+        log_dist("\n".join(lines), ranks=[0])
+        out = self.config.flops_profiler.output_file
+        if out:
+            with open(out, "w") as f:
+                f.write("\n".join(lines) + "\n")
 
     # batch-dict keys whose axis 1 is a sequence dimension; other leaves
     # (class labels, masks with sequence elsewhere, ...) are left alone

@@ -45,6 +45,15 @@ class SparseTensor:
         rows, _ = dense.shape
         max_rows = max_rows if max_rows is not None else rows
         row_mass = jnp.sum(jnp.abs(dense), axis=1)
+        if not isinstance(dense, jax.core.Tracer):
+            # concrete call: catch capacity overflow (silently dropping
+            # rows would corrupt the gradient); inside jit the caller
+            # must size max_rows to the worst case
+            n_nonzero = int(jnp.sum(row_mass > 0))
+            if n_nonzero > max_rows:
+                raise ValueError(
+                    f"{n_nonzero} nonzero rows exceed max_rows={max_rows}; "
+                    "raise the capacity or gradients would be dropped")
         # top-k by mass: static-shape stand-in for nonzero(); rows with
         # zero mass land at the tail and are masked out
         _, idx = jax.lax.top_k(row_mass, max_rows)

@@ -30,14 +30,6 @@ import jax
 import jax.numpy as jnp
 
 
-def split_tensor_along_last_dim(tensor: jnp.ndarray, partitions,
-                                contiguous_split_chunks: bool = False):
-    """Reference helper parity (ref: tiling.py:12): split the last dim
-    at the given boundary list."""
-    del contiguous_split_chunks
-    return jnp.split(tensor, partitions, axis=-1)
-
-
 def tiled_linear_init(rng: jax.Array,
                       in_features: int,
                       out_features: int,

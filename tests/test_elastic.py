@@ -1,0 +1,122 @@
+"""Elasticity tests (ref: tests/unit/test_elastic.py:270 — candidate
+batch math, invalid-world, config validation)."""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.elasticity import (
+    ElasticityConfig, ElasticityConfigError, ElasticityError,
+    ElasticityIncompatibleWorldSize, compute_elastic_config,
+    elasticity_enabled)
+from deepspeed_tpu.version import __version__
+from tests.simple_model import random_batch, simple_model_loss, simple_model_params
+
+BASE_CONFIG = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 10000,
+        "micro_batch_sizes": [8, 12, 16, 17],
+        "min_gpus": 32,
+        "max_gpus": 1500,
+        "min_time": 20,
+        "version": 0.1,
+    }
+}
+
+
+def test_basic_10k():
+    """Reference fixture: 10k cap, micro [8,12,16,17] → every valid chip
+    count divides the final batch by some micro batch
+    (ref: test_elastic.py test_basic_10k, expected value :41)."""
+    final_batch_size, valid_gpus = compute_elastic_config(
+        BASE_CONFIG, target_deepspeed_version=__version__)
+    assert final_batch_size == 9792  # exact reference-algorithm parity
+    for gpu_num in valid_gpus:
+        assert final_batch_size % gpu_num == 0
+        micros = final_batch_size // gpu_num
+        assert any(micros % mb == 0
+                   for mb in BASE_CONFIG["elasticity"]["micro_batch_sizes"])
+    assert all(32 <= g <= 1500 for g in valid_gpus)
+    assert final_batch_size <= 10000
+
+
+def test_candidate_world_sizes():
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 2000,
+                          "micro_batch_sizes": [2, 4, 6], "version": 0.1}}
+    final, valid = compute_elastic_config(cfg, __version__)
+    # 2000-cap/[2,4,6]: LCM-HCN heuristic lands on 1680 = 2 * 840
+    assert final == 1680
+    assert 1 in valid and 2 in valid and 4 in valid
+
+
+def test_invalid_world_size_rejected():
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 2000,
+                          "micro_batch_sizes": [2, 4, 6], "version": 0.1}}
+    final, valid = compute_elastic_config(cfg, __version__)
+    bad = max(valid) + 1
+    while bad in valid:
+        bad += 1
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(cfg, __version__, world_size=bad)
+
+
+def test_world_size_micro_batch():
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 2000,
+                          "micro_batch_sizes": [2, 4, 6], "version": 0.1}}
+    final, valid, micro = compute_elastic_config(cfg, __version__,
+                                                 world_size=4)
+    assert micro in (2, 4, 6)
+    assert (final // 4) % micro == 0
+
+
+def test_allowed_chip_counts_filter():
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 2000,
+                          "micro_batch_sizes": [2, 4, 6], "version": 0.1}}
+    _, valid = compute_elastic_config(
+        cfg, __version__, allowed_chip_counts={1, 4, 8, 16, 32, 64, 128})
+    assert valid and all(v in {1, 4, 8, 16, 32, 64, 128} for v in valid)
+
+
+def test_disabled_and_missing_raise():
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config({}, __version__)
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(
+            {"elasticity": {"enabled": False}}, __version__)
+    assert not elasticity_enabled({})
+
+
+def test_config_validation():
+    with pytest.raises(ElasticityConfigError):
+        ElasticityConfig({"enabled": True, "max_train_batch_size": 100})
+    with pytest.raises(ElasticityConfigError):
+        ElasticityConfig({"enabled": True, "max_train_batch_size": 100,
+                          "micro_batch_sizes": "4"})
+    with pytest.raises(ElasticityConfigError):
+        ElasticityConfig({"enabled": True, "max_train_batch_size": 100,
+                          "micro_batch_sizes": [0, 4]})
+    with pytest.raises(ElasticityError):
+        compute_elastic_config(
+            {"elasticity": {"enabled": True, "max_train_batch_size": 100,
+                            "micro_batch_sizes": [2], "version": 0.2}},
+            __version__)
+
+
+def test_engine_enforces_elastic_batch(devices):
+    """Engine init must reject a train_batch_size that conflicts with
+    the elastic batch (ref: engine check at runtime/engine.py:425)."""
+    params = simple_model_params(hidden_dim=16)
+    cfg = {
+        "train_batch_size": 16,  # conflicts with elastic 1848
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "elasticity": {"enabled": True, "max_train_batch_size": 2000,
+                       "micro_batch_sizes": [2, 4, 6], "version": 0.1},
+    }
+    with pytest.raises(ValueError, match="elastic batch size"):
+        deepspeed_tpu.initialize(model=simple_model_loss,
+                                 model_parameters=params, config=cfg)
+    cfg["elasticity"]["ignore_non_elastic_batch_info"] = True
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_model_loss, model_parameters=params, config=cfg)
+    engine.train_batch(random_batch(16, 16))

@@ -1,0 +1,123 @@
+"""FLOPS profiler tests (ref: tests/unit/test_flops_profiler.py —
+within_range check of measured flops vs analytic expectation)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import gpt
+from deepspeed_tpu.profiling.flops_profiler import (
+    FlopsProfiler, analyze_compiled, analyze_fn, device_peak_flops,
+    get_model_profile)
+from tests.simple_model import random_batch, simple_model_loss, simple_model_params
+
+TOLERANCE = 0.1
+
+
+def within_range(val, target, tolerance=TOLERANCE):
+    return abs(val - target) / max(target, 1e-9) <= tolerance
+
+
+def test_matmul_flops_exact():
+    m, k, n = 128, 256, 64
+    a = jnp.ones((m, k), jnp.float32)
+    b = jnp.ones((k, n), jnp.float32)
+    p = analyze_fn(lambda x, y: x @ y, a, b, runs=1)
+    assert within_range(p["flops"], 2 * m * k * n), p["flops"]
+    assert p["macs"] == p["flops"] / 2
+    assert p["duration_s"] > 0
+
+
+def test_gpt_forward_flops_scan_caveat():
+    """XLA cost analysis counts a lax.scan body ONCE (trip count is
+    opaque to it) — so for the L-layer scan-based GPT the raw count
+    lands between the 1-layer and L-layer analytic totals. Models using
+    scan-over-layers should supply analytic flops via
+    engine.set_flops_per_batch (see _run_flops_profile)."""
+    cfg = gpt.GPTConfig(vocab_size=128, n_layers=2, n_heads=2, d_model=64,
+                        max_seq_len=32, dropout=0.0)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    toks = jnp.zeros((2, 32), jnp.int32)
+    p = analyze_fn(lambda pr, t: gpt.forward(pr, t, cfg), params, toks, runs=1)
+    analytic_fwd_all_layers = gpt.train_flops_per_token(cfg, 32) / 3 * 2 * 32
+    analytic_one_layer = analytic_fwd_all_layers / cfg.n_layers
+    assert analytic_one_layer < p["flops"] < analytic_fwd_all_layers, \
+        (analytic_one_layer, p["flops"], analytic_fwd_all_layers)
+
+
+def test_profiler_class_api(rng):
+    params = simple_model_params(hidden_dim=32, nlayers=2)
+    prof = FlopsProfiler(simple_model_loss, params)
+    prof.start_profile()
+    batch = {k: jnp.asarray(v) for k, v in random_batch(8, 32).items()}
+    prof.profile(batch, None)
+    assert prof.get_total_flops() > 0
+    assert "FLOPS" in prof.get_total_flops(as_string=True)
+    assert prof.get_total_params() == sum(
+        x.size for x in jax.tree_util.tree_leaves(params))
+    prof.print_model_profile()  # must not raise
+    prof.end_profile()
+    assert prof.get_total_flops() == 0.0
+
+
+def test_profiler_submodules(tmp_path):
+    x = jnp.ones((8, 64), jnp.float32)
+    w1 = jnp.ones((64, 64), jnp.float32)
+    w2 = jnp.ones((64, 16), jnp.float32)
+    prof = FlopsProfiler(
+        lambda a: (a @ w1) @ w2,
+        submodules={
+            "fc1": (lambda a: a @ w1, (x,)),
+            "fc2": (lambda a: a @ w2, (jnp.ones((8, 64)),)),
+        })
+    prof.start_profile()
+    prof.profile(x)
+    assert within_range(prof._sub_profiles["fc1"]["flops"], 2 * 8 * 64 * 64)
+    out = tmp_path / "profile.txt"
+    prof.print_model_profile(output_file=str(out))
+    text = out.read_text()
+    assert "fc1" in text and "fc2" in text and "TFLOPS" in text
+
+
+def test_get_model_profile():
+    flops, macs, params = get_model_profile(
+        lambda w, x: x @ w, args=(jnp.ones((16, 8)), jnp.ones((4, 16))),
+        print_profile=False, as_string=False)
+    assert within_range(flops, 2 * 4 * 16 * 8)
+    assert params == 16 * 8
+
+
+def test_analyze_compiled_no_execution():
+    calls = []
+
+    def f(x):
+        calls.append(1)  # traced once; never re-executed by analysis
+        return x * 2 + 1
+
+    jf = jax.jit(f)
+    cost = analyze_compiled(jf, jnp.ones((128,)))
+    assert cost["flops"] >= 128  # mul + add may fuse; at least one pass
+    assert len(calls) == 1
+
+
+def test_device_peak_flops_lookup():
+    # CPU test env: unknown device → None (MFU omitted, no crash)
+    assert device_peak_flops() is None or device_peak_flops() > 0
+
+
+def test_engine_flops_profile_hook(devices, capsys):
+    params = simple_model_params(hidden_dim=32, nlayers=2)
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "steps_per_print": 1000,
+        "flops_profiler": {"enabled": True, "profile_step": 2},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_model_loss, model_parameters=params, config=cfg)
+    for i in range(3):
+        engine.train_batch(random_batch(8, 32, seed=i))
+    # profile printed via logger at step 2; just assert the analysis ran
+    assert engine._last_step_duration > 0
