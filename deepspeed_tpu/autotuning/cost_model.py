@@ -1,0 +1,68 @@
+"""Cost model for model-based tuning.
+
+Capability match for the reference's ``XGBoostCostModel``
+(ref: deepspeed/autotuning/tuner/cost_model.py:11). xgboost is not in
+the TPU image, so the default is a closed-form ridge regression over
+polynomial features — plenty for the small (tens of points) sample
+sizes the tuner collects. If xgboost is importable it is used instead,
+matching the reference exactly.
+"""
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+try:  # pragma: no cover - depends on image contents
+    import xgboost as _xgb
+except ImportError:
+    _xgb = None
+
+
+class RidgeCostModel:
+    """predict(metric | feature-vector) via ridge regression with
+    degree-2 interaction features."""
+
+    def __init__(self, alpha: float = 1e-2):
+        self.alpha = alpha
+        self._w: Optional[np.ndarray] = None
+
+    @staticmethod
+    def _expand(xs: np.ndarray) -> np.ndarray:
+        n, d = xs.shape
+        cols = [np.ones((n, 1)), xs]
+        for i in range(d):
+            for j in range(i, d):
+                cols.append((xs[:, i] * xs[:, j])[:, None])
+        return np.concatenate(cols, axis=1)
+
+    def fit(self, xs: Sequence[Sequence[float]], ys: Sequence[float]) -> None:
+        X = self._expand(np.asarray(xs, np.float64))
+        y = np.asarray(ys, np.float64)
+        A = X.T @ X + self.alpha * np.eye(X.shape[1])
+        self._w = np.linalg.solve(A, X.T @ y)
+
+    def predict(self, xs: Sequence[Sequence[float]]) -> np.ndarray:
+        if self._w is None:
+            return np.zeros(len(xs))
+        return self._expand(np.asarray(xs, np.float64)) @ self._w
+
+
+class XGBoostCostModel:  # pragma: no cover - only with xgboost present
+    """Reference-faithful wrapper (ref: cost_model.py:11)."""
+
+    def __init__(self, loss_type: str = "reg:squarederror", **kw):
+        if _xgb is None:
+            raise ImportError("xgboost not available; use RidgeCostModel")
+        self._model = _xgb.XGBRegressor(objective=loss_type, **kw)
+
+    def fit(self, xs, ys):
+        self._model.fit(np.asarray(xs), np.asarray(ys))
+
+    def predict(self, xs):
+        return self._model.predict(np.asarray(xs))
+
+
+def default_cost_model():
+    if _xgb is not None:  # pragma: no cover
+        return XGBoostCostModel()
+    return RidgeCostModel()

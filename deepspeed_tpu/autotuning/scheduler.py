@@ -1,0 +1,70 @@
+"""Experiment scheduler.
+
+Capability match for the reference's ``ResourceManager``
+(ref: deepspeed/autotuning/scheduler.py:35): owns the experiment queue,
+dispatches experiments, records results. The reference launches each
+experiment as a multi-node job over a hostfile; on a TPU host the
+experiment is an in-process engine build + timed steps, so the runner
+is a callable — the queue/records/result-path API stays.
+"""
+
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class Experiment:
+    def __init__(self, name: str, ds_config: Dict):
+        self.name = name
+        self.ds_config = ds_config
+        self.done = False
+        self.metric_val: Optional[float] = None
+        self.error: Optional[str] = None
+
+    def as_record(self) -> Dict[str, Any]:
+        return {"name": self.name, "ds_config": self.ds_config,
+                "metric_val": self.metric_val, "error": self.error}
+
+
+class ResourceManager:
+    """Runs experiments through ``runner(ds_config) -> float`` and keeps
+    records (ref: scheduler.py:35; `parse_results` :183)."""
+
+    def __init__(self, runner: Callable[[Dict], float],
+                 results_dir: Optional[str] = None):
+        self.runner = runner
+        self.results_dir = results_dir
+        self.experiment_queue: List[Experiment] = []
+        self.finished_experiments: List[Experiment] = []
+        if results_dir:
+            os.makedirs(results_dir, exist_ok=True)
+
+    def schedule_experiments(self, exps) -> None:
+        for e in exps:
+            self.experiment_queue.append(e)
+
+    def run(self) -> None:
+        while self.experiment_queue:
+            exp = self.experiment_queue.pop(0)
+            try:
+                exp.metric_val = float(self.runner(exp.ds_config))
+            except Exception as err:  # OOM/compile failure = experiment loss
+                exp.error = f"{type(err).__name__}: {err}"
+                exp.metric_val = None
+                logger.warning(f"experiment {exp.name} failed: {exp.error}")
+            exp.done = True
+            self.finished_experiments.append(exp)
+            if self.results_dir:
+                path = os.path.join(self.results_dir, f"{exp.name}.json")
+                with open(path, "w") as f:
+                    json.dump(exp.as_record(), f, indent=2)
+
+    def clear(self) -> None:
+        self.experiment_queue.clear()
+
+    def best(self) -> Optional[Experiment]:
+        done = [e for e in self.finished_experiments
+                if e.metric_val is not None]
+        return max(done, key=lambda e: e.metric_val) if done else None

@@ -1,0 +1,128 @@
+"""Tuner strategies: grid / random / model-based.
+
+Capability match for the reference's tuner package
+(ref: deepspeed/autotuning/tuner/base_tuner.py:11 BaseTuner,
+index_based_tuner.py:8,23 Random/GridSearchTuner,
+model_based_tuner.py:16 ModelBasedTuner).
+"""
+
+import random
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.autotuning.cost_model import default_cost_model
+from deepspeed_tpu.autotuning.scheduler import Experiment, ResourceManager
+from deepspeed_tpu.autotuning.utils import dict_to_feature, flatten
+from deepspeed_tpu.utils.logging import logger
+
+
+class BaseTuner:
+    def __init__(self, exps: List[Experiment], resource_manager: ResourceManager,
+                 metric: str = "throughput"):
+        self.all_exps = exps
+        self.rm = resource_manager
+        self.metric = metric
+        self.best_iter = 0
+        self.best_exp: Optional[Experiment] = None
+        self.best_metric_val: Optional[float] = None
+
+    def has_next(self) -> bool:
+        return len(self.all_exps) > 0
+
+    def next_batch(self, sample_size: int) -> List[Experiment]:
+        raise NotImplementedError
+
+    def update(self) -> None:
+        """Incorporate the newest results (model-based overrides)."""
+
+    def tune(self, sample_size: int = 1, n_trials: int = 1000,
+             early_stopping: Optional[int] = None) -> int:
+        """(ref: base_tuner.py:35) returns number of experiments run."""
+        i = 0
+        while i < n_trials and self.has_next():
+            sampled = self.next_batch(sample_size)
+            self.rm.schedule_experiments(sampled)
+            self.rm.run()
+            for exp in self.rm.finished_experiments[-len(sampled):]:
+                if exp.metric_val is not None and (
+                        self.best_metric_val is None
+                        or exp.metric_val > self.best_metric_val):
+                    self.best_exp = exp
+                    self.best_metric_val = exp.metric_val
+                    self.best_iter = i
+            i += len(sampled)
+            self.update()
+            if early_stopping and i >= self.best_iter + early_stopping:
+                logger.info(
+                    f"early stop: no improvement in {early_stopping} exps")
+                break
+        return i
+
+
+class GridSearchTuner(BaseTuner):
+    """In-order exhaustive sweep (ref: index_based_tuner.py:23)."""
+
+    def next_batch(self, sample_size: int = 1) -> List[Experiment]:
+        batch = self.all_exps[:sample_size]
+        self.all_exps = self.all_exps[sample_size:]
+        return batch
+
+
+class RandomTuner(BaseTuner):
+    """Random order without replacement (ref: index_based_tuner.py:8)."""
+
+    def __init__(self, exps, resource_manager, metric="throughput", seed=0):
+        super().__init__(list(exps), resource_manager, metric)
+        self._rng = random.Random(seed)
+
+    def next_batch(self, sample_size: int = 1) -> List[Experiment]:
+        sample_size = min(sample_size, len(self.all_exps))
+        batch = self._rng.sample(self.all_exps, sample_size)
+        for b in batch:
+            self.all_exps.remove(b)
+        return batch
+
+
+class ModelBasedTuner(BaseTuner):
+    """Cost-model guided search (ref: model_based_tuner.py:16): run a
+    random warmup, fit the model on (features -> metric), then greedily
+    pick the predicted-best remaining configs, refitting as results
+    arrive."""
+
+    def __init__(self, exps, resource_manager, metric="throughput",
+                 warmup: int = 3, seed: int = 0):
+        super().__init__(list(exps), resource_manager, metric)
+        self.warmup = warmup
+        self._rng = random.Random(seed)
+        self.cost_model = default_cost_model()
+        keys = set()
+        for e in self.all_exps:
+            keys.update(flatten(e.ds_config).keys())
+        self.feature_keys = sorted(keys)
+        self._trained = False
+
+    def _features(self, exp: Experiment) -> List[float]:
+        return dict_to_feature(flatten(exp.ds_config), self.feature_keys)
+
+    def next_batch(self, sample_size: int = 1) -> List[Experiment]:
+        sample_size = min(sample_size, len(self.all_exps))
+        n_done = len(self.rm.finished_experiments)
+        if n_done < self.warmup or not self._trained:
+            batch = self._rng.sample(self.all_exps, sample_size)
+        else:
+            preds = self.cost_model.predict(
+                [self._features(e) for e in self.all_exps])
+            order = sorted(range(len(self.all_exps)),
+                           key=lambda i: -preds[i])
+            batch = [self.all_exps[i] for i in order[:sample_size]]
+        for b in batch:
+            self.all_exps.remove(b)
+        return batch
+
+    def update(self) -> None:
+        done = [e for e in self.rm.finished_experiments
+                if e.metric_val is not None]
+        if len(done) >= max(2, self.warmup):
+            xs = [self._features(e) for e in done]
+            ys = [e.metric_val for e in done]
+            self.cost_model.fit(xs, ys)
+            self._trained = True

@@ -1,0 +1,71 @@
+"""Autotuner helpers (ref: deepspeed/autotuning/tuner/utils.py and
+autotuning/utils.py): tuning-space combinatorics and feature vectors."""
+
+import itertools
+from typing import Any, Dict, List
+
+
+def flatten(d: Dict, parent_key: str = "", sep: str = "_") -> Dict:
+    """Nested dict -> flat key dict (ref: tuner/utils.py:52)."""
+    items = []
+    for k, v in d.items():
+        new_key = parent_key + sep + k if parent_key else k
+        if isinstance(v, dict):
+            items.extend(flatten(v, new_key, sep=sep).items())
+        else:
+            items.append((new_key, v))
+    return dict(items)
+
+
+def gen_combinations(d: Dict) -> List[Dict]:
+    """Cartesian product over every list-valued key of a (nested)
+    tuning space (ref: tuner/utils.py:40)."""
+    keys, values = [], []
+    for k, v in d.items():
+        if isinstance(v, dict):
+            keys.append(k)
+            values.append(gen_combinations(v))
+        else:
+            keys.append(k)
+            values.append(v if isinstance(v, list) else [v])
+    out = []
+    for combo in itertools.product(*values):
+        out.append(dict(zip(keys, combo)))
+    return out
+
+
+def dict_to_feature(feature_dict: Dict, keys: List[str]) -> List[float]:
+    """Flat config -> numeric feature vector for the cost model
+    (ref: tuner/utils.py:63); non-numeric values hash to small ints."""
+    feat = []
+    for k in keys:
+        v = feature_dict.get(k, 0)
+        if isinstance(v, bool):
+            feat.append(float(v))
+        elif isinstance(v, (int, float)):
+            feat.append(float(v))
+        elif v is None:
+            feat.append(0.0)
+        else:
+            feat.append(float(abs(hash(str(v))) % 97))
+    return feat
+
+
+def deep_update(base: Dict, overrides: Dict) -> Dict:
+    """Return base with nested overrides applied (new dict)."""
+    out = dict(base)
+    for k, v in overrides.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = deep_update(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def canonical_name(exp_config: Dict) -> str:
+    """Stable short name for an experiment (ref: autotuning/utils.py
+    canonical_name): z<stage>_mbs<micro>_gas<gas>."""
+    z = (exp_config.get("zero_optimization") or {}).get("stage", 0)
+    mbs = exp_config.get("train_micro_batch_size_per_gpu", "auto")
+    gas = exp_config.get("gradient_accumulation_steps", 1)
+    return f"z{z}_mbs{mbs}_gas{gas}"

@@ -1,0 +1,61 @@
+"""CLI entry points (ref: bin/deepspeed, bin/ds_report, bin/ds_elastic).
+
+Usable as modules (no install step needed):
+    python -m deepspeed_tpu.launcher.runner train.py -- args...
+    python -m deepspeed_tpu.env_report
+    python -m deepspeed_tpu.cli elastic --config ds_config.json [-w WORLD]
+"""
+
+import argparse
+import json
+import sys
+
+
+def ds_elastic_main(argv=None):
+    """(ref: bin/ds_elastic) print elastic batch + valid chip counts."""
+    parser = argparse.ArgumentParser(prog="ds_elastic")
+    parser.add_argument("-c", "--config", required=True,
+                        help="DeepSpeed config json")
+    parser.add_argument("-w", "--world-size", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    from deepspeed_tpu.elasticity import compute_elastic_config
+    from deepspeed_tpu.version import __version__
+
+    with open(args.config) as f:
+        ds_config = json.load(f)
+    print(json.dumps(ds_config.get("elasticity", {}), indent=2))
+    if args.world_size > 0:
+        final, valid, micro = compute_elastic_config(
+            ds_config, __version__, world_size=args.world_size)
+        print(f"With world size {args.world_size}:")
+        print(f"  final global batch size .... {final}")
+        print(f"  valid chip counts .......... {valid}")
+        print(f"  micro batch per chip ....... {micro}")
+    else:
+        final, valid = compute_elastic_config(ds_config, __version__)
+        print(f"final global batch size .... {final}")
+        print(f"valid chip counts .......... {valid}")
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        print(__doc__)
+        return
+    cmd, rest = argv[0], argv[1:]
+    if cmd == "elastic":
+        ds_elastic_main(rest)
+    elif cmd == "report":
+        from deepspeed_tpu.env_report import main as report_main
+        report_main()
+    elif cmd == "launch":
+        from deepspeed_tpu.launcher.runner import main as runner_main
+        runner_main(rest)
+    else:
+        print(__doc__)
+        sys.exit(2)
+
+
+if __name__ == "__main__":
+    main()

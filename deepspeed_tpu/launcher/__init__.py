@@ -1,0 +1,7 @@
+from deepspeed_tpu.launcher.runner import (
+    fetch_hostfile, parse_inclusion_exclusion, parse_resource_filter,
+    encode_world_info, decode_world_info)
+
+__all__ = ["fetch_hostfile", "parse_inclusion_exclusion",
+           "parse_resource_filter", "encode_world_info",
+           "decode_world_info"]

@@ -1,0 +1,115 @@
+"""Per-host launcher.
+
+Capability match for the reference's per-node launcher
+(ref: deepspeed/launcher/launch.py:90 main, sigkill_handler :176). The
+reference spawns one subprocess per local GPU with RANK/LOCAL_RANK env;
+on TPU each host runs ONE process that owns all local chips
+(jax.distributed process-per-host), so this launcher resolves the
+host's process index from the world info, exports the coordinator env
+consumed by ``deepspeed_tpu.utils.distributed.init_distributed``, and
+executes the training script — killing the child tree on SIGINT/SIGTERM
+like the reference's sigkill handler.
+"""
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+from typing import List
+
+from deepspeed_tpu.launcher.runner import decode_world_info
+from deepspeed_tpu.utils.logging import logger
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--world_info", type=str, required=True)
+    parser.add_argument("--master_addr", type=str, required=True)
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--hostname", type=str, default="")
+    parser.add_argument("--procs_per_node", type=int, default=1,
+                        help="1 on TPU (process-per-host); >1 only for "
+                        "CPU-emulation testing")
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def resolve_node_rank(world_info: dict, hostname: str) -> int:
+    hosts = list(world_info.keys())
+    if hostname in hosts:
+        return hosts.index(hostname)
+    fqdn = socket.gethostname()
+    for cand in (fqdn, fqdn.split(".")[0]):
+        if cand in hosts:
+            return hosts.index(cand)
+    if len(hosts) == 1:
+        return 0
+    raise RuntimeError(f"host '{hostname or fqdn}' not in world info {hosts}")
+
+
+def build_child_env(base_env: dict, master_addr: str, master_port: int,
+                    num_processes: int, process_id: int,
+                    local_chips: List[int]) -> dict:
+    env = dict(base_env)
+    # consumed by utils/distributed.py init_distributed →
+    # jax.distributed.initialize
+    env["DSTPU_COORDINATOR"] = f"{master_addr}:{master_port}"
+    env["DSTPU_NUM_PROCESSES"] = str(num_processes)
+    env["DSTPU_PROCESS_ID"] = str(process_id)
+    # reference-compatible aliases so user scripts can read familiar keys
+    env["RANK"] = str(process_id)
+    env["WORLD_SIZE"] = str(num_processes)
+    env["LOCAL_RANK"] = "0"
+    env["MASTER_ADDR"] = master_addr
+    env["MASTER_PORT"] = str(master_port)
+    env["DSTPU_LOCAL_CHIPS"] = ",".join(str(c) for c in local_chips)
+    return env
+
+
+def main(args=None):
+    args = parse_args(args)
+    world_info = decode_world_info(args.world_info)
+    node_rank = resolve_node_rank(world_info, args.hostname)
+    num_nodes = len(world_info)
+    local_chips = list(world_info.values())[node_rank]
+    logger.info(f"node_rank={node_rank}/{num_nodes}, "
+                f"local chips={local_chips}")
+
+    procs = []
+    for local_proc in range(args.procs_per_node):
+        process_id = node_rank * args.procs_per_node + local_proc
+        env = build_child_env(
+            os.environ.copy(), args.master_addr, args.master_port,
+            num_processes=num_nodes * args.procs_per_node,
+            process_id=process_id, local_chips=local_chips)
+        cmd = [sys.executable, args.user_script] + list(args.user_args)
+        procs.append(subprocess.Popen(cmd, env=env))
+
+    def sigkill_handler(signum, frame):
+        # (ref: launch.py:176) take the whole tree down
+        for p in procs:
+            logger.info(f"killing subprocess {p.pid}")
+            try:
+                p.kill()
+            except Exception:
+                pass
+        sys.exit(1)
+
+    signal.signal(signal.SIGINT, sigkill_handler)
+    signal.signal(signal.SIGTERM, sigkill_handler)
+
+    exit_code = 0
+    for p in procs:
+        p.wait()
+        if p.returncode != 0:
+            exit_code = p.returncode
+    # propagate the first failing exit code (ref: launch.py:176,
+    # runner.py:458)
+    sys.exit(exit_code)
+
+
+if __name__ == "__main__":
+    main()

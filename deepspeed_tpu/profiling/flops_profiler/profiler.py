@@ -69,13 +69,10 @@ def analyze_fn(fn: Callable, *args,
     mfu, arithmetic_intensity}. ``fn`` may already be jitted (the
     lower/compile hits the jit cache)."""
     jfn = fn if hasattr(fn, "lower") else jax.jit(fn, static_argnums=static_argnums)
-    lowered = jfn.lower(*args, **kwargs)
-    compiled = lowered.compile()
-    cost = compiled.cost_analysis() or {}
-    if isinstance(cost, list):  # older jax returns [dict]
-        cost = cost[0] if cost else {}
-    flops = float(cost.get("flops", 0.0))
-    bytes_accessed = float(cost.get("bytes accessed", 0.0))
+    static = analyze_compiled(jfn, *args, **kwargs)
+    flops = static["flops"]
+    bytes_accessed = static["bytes_accessed"]
+    compiled = jfn.lower(*args, **kwargs).compile()  # jit-cache hit
     try:
         mem = compiled.memory_analysis()
         peak_bytes = int(getattr(mem, "temp_size_in_bytes", 0) +

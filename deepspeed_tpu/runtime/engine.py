@@ -130,11 +130,13 @@ class DeepSpeedEngine:
                 compute_elastic_config, ensure_immutable_elastic_config)
             from deepspeed_tpu.version import __version__ as _ver
             ensure_immutable_elastic_config(config.elasticity_dict)
-            # valid counts are PHYSICAL chip counts (what the scheduler
-            # allocates), so validate the full mesh size, not dp alone
+            # the batch identity is global = micro x gas x DP-replicas,
+            # so the validated world is the DP degree; under TP/PP the
+            # scheduler's chip count is dp x (mp x pp), and valid_gpus
+            # entries denote DP replicas
             final_bs, _valid, _micro = compute_elastic_config(
                 {"elasticity": config.elasticity_dict}, _ver,
-                world_size=int(np.prod(list(self.mesh.shape.values()))))
+                world_size=self.dp_world_size)
             if not config.elasticity_dict.get(
                     "ignore_non_elastic_batch_info", False) and \
                     config.train_batch_size != final_bs:
@@ -653,15 +655,19 @@ class DeepSpeedEngine:
             self.progressive_layer_drop.update_state(
                 self.global_steps - self.skipped_steps)
         batch = self._shard_batch(batch)
+        profiling_now = (self.config.flops_profiler.enabled
+                         and not self.offload_enabled
+                         and self.global_steps + 1 ==
+                         self.config.flops_profiler.profile_step)
+        if profiling_now:
+            # drain queued prior steps so the timed window is exactly
+            # this step (set profile_step >= 2 to exclude compile time)
+            jax.block_until_ready(self.state.params)
         t0 = time.perf_counter()
         if self.offload_enabled:
             metrics = self._offload_train_batch(batch)
         else:
             self.state, metrics = self._train_step(self.state, batch)
-        profiling_now = (self.config.flops_profiler.enabled
-                         and not self.offload_enabled
-                         and self.global_steps + 1 ==
-                         self.config.flops_profiler.profile_step)
         if profiling_now:
             # block only on the profiled step — every other step keeps
             # async dispatch so the host can run ahead

@@ -1,0 +1,189 @@
+"""Launcher, CLI, env-report tests (ref: tests/unit/test_runner.py-style
+hostfile/filter parsing, no processes spawned except one end-to-end
+single-host launch)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.launcher import (
+    decode_world_info, encode_world_info, fetch_hostfile,
+    parse_inclusion_exclusion, parse_resource_filter)
+from deepspeed_tpu.launcher.launch import build_child_env, resolve_node_rank
+from deepspeed_tpu.launcher.runner import OpenMPIRunner, PDSHRunner, parse_args
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------- hostfile
+
+def test_fetch_hostfile(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("worker-0 slots=4\nworker-1 slots=4\n\n# comment\n")
+    pool = fetch_hostfile(str(hf))
+    assert pool == {"worker-0": 4, "worker-1": 4}
+    assert list(pool.keys()) == ["worker-0", "worker-1"]  # ordered
+
+
+def test_fetch_hostfile_missing_returns_none(tmp_path):
+    assert fetch_hostfile(str(tmp_path / "nope")) is None
+
+
+def test_fetch_hostfile_duplicate_raises(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("w0 slots=2\nw0 slots=4\n")
+    with pytest.raises(ValueError, match="already defined"):
+        fetch_hostfile(str(hf))
+
+
+def test_fetch_hostfile_bad_format_raises(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("w0 slots\n")  # missing =N
+    with pytest.raises(ValueError):
+        fetch_hostfile(str(hf))
+
+
+# ------------------------------------------------------------ filters
+
+POOL = {"worker-0": 4, "worker-1": 4}
+
+
+def test_include_whole_node():
+    out = parse_inclusion_exclusion(POOL, "worker-0", "")
+    assert out == {"worker-0": [0, 1, 2, 3]}
+
+
+def test_include_slots():
+    out = parse_inclusion_exclusion(POOL, "worker-0@worker-1:0,2", "")
+    assert out == {"worker-0": [0, 1, 2, 3], "worker-1": [0, 2]}
+
+
+def test_exclude_slot():
+    out = parse_inclusion_exclusion(POOL, "", "worker-1:0")
+    assert out == {"worker-0": [0, 1, 2, 3], "worker-1": [1, 2, 3]}
+
+
+def test_exclude_whole_node():
+    out = parse_inclusion_exclusion(POOL, "", "worker-1")
+    assert out == {"worker-0": [0, 1, 2, 3]}
+
+
+def test_include_exclude_mutually_exclusive():
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        parse_resource_filter({"w": [0]}, include_str="w", exclude_str="w")
+
+
+def test_unknown_host_raises():
+    with pytest.raises(ValueError, match="not found"):
+        parse_inclusion_exclusion(POOL, "worker-9", "")
+    with pytest.raises(ValueError, match="No slot"):
+        parse_inclusion_exclusion(POOL, "worker-0:9", "")
+
+
+# --------------------------------------------------------- world info
+
+def test_world_info_roundtrip():
+    wi = {"worker-0": [0, 1], "worker-1": [2, 3]}
+    assert decode_world_info(encode_world_info(wi)) == wi
+
+
+def test_resolve_node_rank():
+    wi = {"a": [0], "b": [0], "c": [0]}
+    assert resolve_node_rank(wi, "b") == 1
+    assert resolve_node_rank({"solo": [0]}, "") == 0
+    with pytest.raises(RuntimeError):
+        resolve_node_rank(wi, "zzz")
+
+
+def test_build_child_env():
+    env = build_child_env({}, "10.0.0.1", 29500, num_processes=4,
+                          process_id=2, local_chips=[0, 1, 2, 3])
+    assert env["DSTPU_COORDINATOR"] == "10.0.0.1:29500"
+    assert env["DSTPU_NUM_PROCESSES"] == "4"
+    assert env["DSTPU_PROCESS_ID"] == "2"
+    assert env["RANK"] == "2" and env["WORLD_SIZE"] == "4"
+    assert env["MASTER_ADDR"] == "10.0.0.1"
+
+
+# ------------------------------------------------ multinode commands
+
+def _args(extra=None):
+    return parse_args(["--master_port", "29501"] + (extra or []) +
+                      ["train.py", "--foo", "bar"])
+
+
+def test_pdsh_cmd_shape():
+    args = _args()
+    r = PDSHRunner(args, encode_world_info({"w0": [0], "w1": [0]}))
+    r.add_export("XLA_FLAGS", "--xla_dummy")
+    cmd = r.get_cmd({}, {"w0": [0], "w1": [0]})
+    joined = " ".join(cmd)
+    assert cmd[0] == "pdsh"
+    assert "-w w0,w1" in joined
+    assert "deepspeed_tpu.launcher.launch" in joined
+    assert "--master_port 29501" in joined
+    assert "export XLA_FLAGS=--xla_dummy;" in joined
+    assert "train.py --foo bar" in joined
+
+
+def test_openmpi_cmd_shape():
+    args = _args()
+    r = OpenMPIRunner(args, encode_world_info({"w0": [0], "w1": [0]}))
+    cmd = r.get_cmd({}, {"w0": [0], "w1": [0]})
+    assert cmd[0] == "mpirun"
+    assert "-n" in cmd and cmd[cmd.index("-n") + 1] == "2"
+    assert "w0:1,w1:1" in cmd
+    assert "train.py" in cmd
+
+
+# ------------------------------------------------------- end to end
+
+def test_single_host_launch_end_to_end(tmp_path):
+    """runner → launch → child process with rendezvous env set
+    (ref: stack 3.5 in SURVEY.md)."""
+    script = tmp_path / "probe.py"
+    out = tmp_path / "env.json"
+    script.write_text(
+        "import json, os\n"
+        "keys = ['DSTPU_COORDINATOR', 'DSTPU_NUM_PROCESSES', "
+        "'DSTPU_PROCESS_ID', 'RANK', 'WORLD_SIZE']\n"
+        f"json.dump({{k: os.environ.get(k) for k in keys}}, "
+        f"open({str(out)!r}, 'w'))\n")
+    env = dict(os.environ, PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+         "--hostfile", "/nonexistent", "--master_port", "29777",
+         str(script)],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    probed = json.loads(out.read_text())
+    assert probed["DSTPU_COORDINATOR"] == "127.0.0.1:29777"
+    assert probed["RANK"] == "0" and probed["WORLD_SIZE"] == "1"
+
+
+def test_env_report_runs(tmp_path):
+    env = dict(os.environ, PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.env_report"],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr
+    assert "environment report" in proc.stdout
+    assert "devices:" in proc.stdout
+
+
+def test_ds_elastic_cli(tmp_path):
+    cfg = tmp_path / "ds.json"
+    cfg.write_text(json.dumps({
+        "elasticity": {"enabled": True, "max_train_batch_size": 2000,
+                       "micro_batch_sizes": [2, 4, 6], "version": 0.1}}))
+    env = dict(os.environ, PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.cli", "elastic",
+         "-c", str(cfg), "-w", "4"],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "1680" in proc.stdout
+    assert "micro batch per chip" in proc.stdout
