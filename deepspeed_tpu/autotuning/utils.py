@@ -52,11 +52,15 @@ def dict_to_feature(feature_dict: Dict, keys: List[str]) -> List[float]:
 
 
 def deep_update(base: Dict, overrides: Dict) -> Dict:
-    """Return base with nested overrides applied (new dict)."""
-    out = dict(base)
+    """Return base with nested overrides applied. Every nested dict is
+    copied (never aliased) so callers can mutate the result freely."""
+    out = {k: (deep_update(v, {}) if isinstance(v, dict) else v)
+           for k, v in base.items()}
     for k, v in overrides.items():
         if isinstance(v, dict) and isinstance(out.get(k), dict):
             out[k] = deep_update(out[k], v)
+        elif isinstance(v, dict):
+            out[k] = deep_update(v, {})
         else:
             out[k] = v
     return out

@@ -1,0 +1,6 @@
+from deepspeed_tpu.checkpoint import constants
+from deepspeed_tpu.runtime.state_dict_factory import (
+    MegatronSDLoader, SDLoaderBase, SDLoaderFactory)
+
+__all__ = ["constants", "MegatronSDLoader", "SDLoaderBase",
+           "SDLoaderFactory"]

@@ -38,6 +38,33 @@ def ds_elastic_main(argv=None):
         print(f"valid chip counts .......... {valid}")
 
 
+def zero_to_fp32_main(argv=None):
+    """(ref: deepspeed/utils/zero_to_fp32.py) consolidate a sharded
+    checkpoint into one fp32 .npz of full weights."""
+    parser = argparse.ArgumentParser(prog="zero_to_fp32")
+    parser.add_argument("checkpoint_dir",
+                        help="dir containing the 'latest' tag file")
+    parser.add_argument("output_file", help="output .npz path")
+    parser.add_argument("-t", "--tag", default=None)
+    args = parser.parse_args(argv)
+
+    from deepspeed_tpu.runtime.checkpointing import (
+        load_fp32_state_dict_from_zero_checkpoint)
+    from deepspeed_tpu.utils.tree import tree_path_str
+    import jax.tree_util as jtu
+    import numpy as np
+
+    params = load_fp32_state_dict_from_zero_checkpoint(
+        args.checkpoint_dir, tag=args.tag)
+    flat = {}
+    for path, leaf in jtu.tree_flatten_with_path(params)[0]:
+        flat[tree_path_str(path)] = np.asarray(leaf, np.float32)
+    np.savez(args.output_file, **flat)
+    total = sum(v.size for v in flat.values())
+    print(f"saved {len(flat)} tensors / {total / 1e6:.2f}M params "
+          f"to {args.output_file}")
+
+
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv:
@@ -46,6 +73,8 @@ def main(argv=None):
     cmd, rest = argv[0], argv[1:]
     if cmd == "elastic":
         ds_elastic_main(rest)
+    elif cmd == "zero_to_fp32":
+        zero_to_fp32_main(rest)
     elif cmd == "report":
         from deepspeed_tpu.env_report import main as report_main
         report_main()

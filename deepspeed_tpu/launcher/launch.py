@@ -104,7 +104,7 @@ def main(args=None):
     exit_code = 0
     for p in procs:
         p.wait()
-        if p.returncode != 0:
+        if p.returncode != 0 and exit_code == 0:
             exit_code = p.returncode
     # propagate the first failing exit code (ref: launch.py:176,
     # runner.py:458)

@@ -233,6 +233,7 @@ def parse_args(args=None):
 def main(args=None):
     args = parse_args(args)
     resource_pool = fetch_hostfile(args.hostfile)
+    from_hostfile = resource_pool is not None
 
     if not resource_pool:
         # single host: this machine, all local chips as one worker
@@ -247,7 +248,10 @@ def main(args=None):
         raise RuntimeError("no resources left after include/exclude filters")
     world_info = encode_world_info(active_resources)
 
-    multi_node = args.force_multi or len(active_resources) > 1
+    # any hostfile => remote dispatch, even for one host (the host may
+    # not be this machine); local exec only without a hostfile
+    multi_node = args.force_multi or from_hostfile or \
+        len(active_resources) > 1
     env = os.environ.copy()
 
     if not multi_node:

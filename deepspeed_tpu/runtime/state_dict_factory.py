@@ -1,0 +1,377 @@
+"""Checkpoint loader/resharder for Megatron-style TP checkpoints.
+
+Capability match for the reference's state-dict factory
+(ref: deepspeed/runtime/state_dict_factory.py:17 SDLoaderFactory,
+:195 MegatronSDLoader): load per-TP-rank checkpoint files and
+merge/split them to a *different* inference model-parallel degree,
+with layout-aware handling of fused query/key/value weights across the
+three historical Megatron QKV formats.
+
+TPU-native: tensors are manipulated as numpy (ready for jax
+device_put with TP shardings); torch is used only to deserialize the
+reference's .pt files (torch-cpu is in the image). Our own
+checkpoints never need this — orbax stores one logical array that any
+mesh reshape can reload — so this exists to migrate reference-world
+checkpoints in.
+"""
+
+import collections
+import copy
+import json
+import os
+from abc import ABC, abstractmethod
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.runtime.weight_quantizer import WeightQuantization
+from deepspeed_tpu.utils.logging import logger
+
+AUTO_MODULE_KEY = "auto"
+
+
+def _load_ckpt_file(path: str) -> Dict:
+    """Deserialize one shard file: .pt (torch) or .npz."""
+    if path.endswith(".npz"):
+        with np.load(path, allow_pickle=True) as z:
+            data = {k: z[k] for k in z.files}
+        if "__sd__" in data:  # pickled nested dict
+            return data["__sd__"].item()
+        return data
+    import torch
+    sd = torch.load(path, map_location="cpu", weights_only=False)
+
+    def to_np(x):
+        if isinstance(x, torch.Tensor):
+            return x.detach().to(torch.float32).numpy() \
+                if x.dtype in (torch.float16, torch.bfloat16) \
+                else x.detach().numpy()
+        if isinstance(x, dict):
+            return {k: to_np(v) for k, v in x.items()}
+        if isinstance(x, (list, tuple)):
+            return type(x)(to_np(v) for v in x)
+        return x
+    return to_np(sd)
+
+
+class SDLoaderFactory:
+    @staticmethod
+    def get_sd_loader_json(json_file):
+        """(ref: state_dict_factory.py:18) json with type/checkpoints/
+        version keys (path or dict)."""
+        data = json_file
+        if not isinstance(data, dict):
+            with open(json_file) as f:
+                data = json.load(f)
+        sd_type = data["type"]
+        ckpt_list = data["checkpoints"]
+        version = data.get("version")
+        return SDLoaderFactory.get_sd_loader(ckpt_list, sd_type, version)
+
+    @staticmethod
+    def get_sd_loader(ckpt_list, sd_type="Megatron", version=None):
+        if sd_type == "Megatron":
+            return MegatronSDLoader(ckpt_list, version)
+        raise ValueError(f"{sd_type} checkpoint type is not supported")
+
+
+class SDLoaderBase(ABC):
+    def __init__(self, ckpt_list: List[str], version):
+        self.module_key = None
+        self.ckpt_list = ckpt_list
+        self.check_ckpt_list()
+        self.version = version
+
+    def load(self, mp_world_size: int, mp_rank: int,
+             module_key: str = AUTO_MODULE_KEY,
+             is_pipe_parallel: bool = False,
+             quantize: bool = False, quantize_bits: int = 8,
+             quantize_groups: int = 64,
+             mlp_extra_grouping: bool = True
+             ) -> Tuple[str, Dict, Tuple[Optional[np.ndarray], int]]:
+        """(ref: state_dict_factory.py:41) direct / merge / split by
+        comparing checkpoint count with the target MP degree."""
+        self.module_key = module_key
+        num_ckpt = len(self.ckpt_list)
+        idx = mp_rank * num_ckpt // mp_world_size
+        if is_pipe_parallel and module_key is not None and \
+                mp_world_size != num_ckpt:
+            mp_world_size = num_ckpt
+            idx = 0
+        load_path = self.ckpt_list[idx]
+
+        merge_count = 1
+        if num_ckpt == mp_world_size:
+            assert os.path.exists(load_path), load_path
+            sd = _load_ckpt_file(load_path)
+            if quantize:
+                quantizer = WeightQuantization(
+                    mlp_extra_grouping=mlp_extra_grouping,
+                    mp_size=mp_world_size)
+                sd_module, all_scales = self.sd_quantize(
+                    quantizer, self.get_module(sd), quantize_bits,
+                    quantize_groups)
+                self.set_module(sd, sd_module)
+            else:
+                all_scales = None
+        elif num_ckpt > mp_world_size:
+            sd, all_scales, merge_count = self.merge_state_dict(
+                mp_world_size, mp_rank, quantize, quantize_bits,
+                quantize_groups, mlp_extra_grouping)
+        else:
+            sd, all_scales = self.split_state_dict(
+                mp_world_size, mp_rank, quantize, quantize_bits,
+                quantize_groups, mlp_extra_grouping)
+        return load_path, sd, (all_scales, merge_count)
+
+    def sd_quantize(self, quantizer, sd_module, quantize_bits, groups):
+        """Quantize the qkv/dense/mlp weights of a module sd
+        (ref: weight_quantizer.py sd_quantize_megatron)."""
+        keys = list(sd_module.keys())
+        import jax.numpy as jnp
+        for key in keys:
+            if any(t in key for t in ("attention.dense.weight",
+                                      "query_key_value.weight",
+                                      "mlp.dense_4h_to_h.weight",
+                                      "mlp.dense_h_to_4h.weight")):
+                [q] = quantizer.Quantize(
+                    [jnp.asarray(sd_module[key])], quantize_bits, groups,
+                    key=key)
+                sd_module[key] = np.asarray(q)
+        all_scales = np.asarray(quantizer.merge_scales()) \
+            if quantizer.qkv_scales else None
+        return sd_module, all_scales
+
+    def get_merge_state_dicts(self, mp_world_size, mp_rank):
+        num_ckpt = len(self.ckpt_list)
+        assert num_ckpt % mp_world_size == 0, \
+            "Invalid checkpoints and world size for sd merge"
+        num_to_merge = num_ckpt // mp_world_size
+        ckpt_list = self.ckpt_list[num_to_merge * mp_rank:
+                                   num_to_merge * (mp_rank + 1)]
+        logger.info(f"mp_rank: {mp_rank}, ckpt_list: {ckpt_list}")
+        return [_load_ckpt_file(c) for c in ckpt_list]
+
+    def get_split_state_dict(self, mp_world_size, mp_rank):
+        num_ckpt = len(self.ckpt_list)
+        assert mp_world_size % num_ckpt == 0, \
+            "Invalid checkpoints and world size for sd split"
+        num_to_split = mp_world_size // num_ckpt
+        ckpt_index = mp_rank // num_to_split
+        ckpt_offset = mp_rank % num_to_split
+        logger.info(f"mp_rank: {mp_rank}, ckpt: {ckpt_index}, "
+                    f"offset: {ckpt_offset}")
+        return _load_ckpt_file(self.ckpt_list[ckpt_index]), \
+            num_to_split, ckpt_offset
+
+    def _choose_module_key(self, sd):
+        """(ref: state_dict_factory.py:161)"""
+        if "module" in sd and "model" in sd:
+            raise RuntimeError(
+                "checkpoint has both 'model' and 'module' keys, not sure "
+                "how to proceed")
+        if "module" in sd:
+            return "module"
+        if "model" in sd:
+            return "model"
+        raise RuntimeError("checkpoint contains neither 'model' nor 'module'")
+
+    def get_module(self, sd):
+        if self.module_key is None:
+            return sd
+        if self.module_key == AUTO_MODULE_KEY:
+            return sd[self._choose_module_key(sd)]
+        return sd[self.module_key]
+
+    def set_module(self, sd, module):
+        if self.module_key is None:
+            sd = module
+        elif self.module_key == AUTO_MODULE_KEY:
+            sd[self._choose_module_key(sd)] = module
+        else:
+            sd[self.module_key] = module
+        return sd
+
+    def check_ckpt_list(self):
+        assert len(self.ckpt_list) > 0
+        # all files must exist (ref: :188 sanity check via first file)
+        for p in self.ckpt_list:
+            assert os.path.exists(p), f"checkpoint file {p} does not exist"
+
+    @abstractmethod
+    def merge_state_dict(self, mp_world_size, mp_rank, quantize,
+                         quantize_bits, groups, mlp_extra_grouping):
+        ...
+
+    @abstractmethod
+    def split_state_dict(self, mp_world_size, mp_rank, quantize,
+                         quantize_bits, groups, mlp_extra_grouping):
+        ...
+
+    @abstractmethod
+    def sanity_check(self, ckpt_file_name):
+        ...
+
+
+class MegatronSDLoader(SDLoaderBase):
+    """(ref: state_dict_factory.py:195) layout rules:
+    merge/split axis 0: word_embeddings, mlp.dense_h_to_4h.{weight,bias},
+    qkv (format-aware); axis 1: attention.dense.weight,
+    mlp.dense_4h_to_h.weight; replicated: everything else."""
+
+    def merge_query_key_value(self, param_list, ckpt_ver):
+        """Three historical QKV layouts (ref: :225): v0 [(3*np*hn), h]
+        needs interleaved regrouping; v1.0/v2.0 concatenate directly."""
+        if ckpt_ver == 0:
+            assert param_list[0].shape[0] % 3 == 0
+            size_qkv = param_list[0].shape[0] // 3
+            split_tensors = [
+                [p[i * size_qkv:(i + 1) * size_qkv] for i in range(3)]
+                for p in param_list
+            ]
+            tensors = []
+            for i in range(3):
+                tensors.append(np.concatenate(
+                    [t[i] for t in split_tensors], axis=0))
+            return np.concatenate(tensors, axis=0)
+        if ckpt_ver in (1.0, 2.0):
+            return np.concatenate(param_list, axis=0)
+        raise AssertionError(
+            f"checkpoint version: {ckpt_ver} is not supported")
+
+    def split_query_key_value(self, param, num_to_split, offset, ckpt_ver):
+        """(ref: :263)"""
+        if ckpt_ver == 0:
+            assert param.shape[0] % 3 == 0
+            size_qkv = param.shape[0] // 3
+            split_tensors = [param[i * size_qkv:(i + 1) * size_qkv]
+                             for i in range(3)]
+            assert split_tensors[0].shape[0] % num_to_split == 0
+            split_size = split_tensors[0].shape[0] // num_to_split
+            tensors = [t[offset * split_size:(offset + 1) * split_size]
+                       for t in split_tensors]
+            return np.concatenate(tensors, axis=0)
+        if ckpt_ver in (1.0, 2.0):
+            assert param.shape[0] % num_to_split == 0
+            size_qkv = param.shape[0] // num_to_split
+            return param[offset * size_qkv:(offset + 1) * size_qkv]
+        raise AssertionError(
+            f"checkpoint version: {ckpt_ver} is not supported")
+
+    def get_checkpoint_version(self, state_dict) -> float:
+        # ref: :414 — explicit self.version wins over the sd field
+        if self.version is not None:
+            return self.version
+        return state_dict.get("checkpoint_version", 0)
+
+    def merge_state_dict(self, mp_world_size, mp_rank, quantize=False,
+                         quantize_bits=8, groups=64,
+                         mlp_extra_grouping=True):
+        """(ref: :305)"""
+        self.sanity_check(self.ckpt_list[0])
+        sd_list = self.get_merge_state_dicts(mp_world_size, mp_rank)
+        ds_sd = copy.deepcopy(sd_list[0])
+        new_client_sd = collections.OrderedDict()
+        client_sd_list = [self.get_module(sd) for sd in sd_list]
+        keys = client_sd_list[0].keys()
+        ckpt_ver = self.get_checkpoint_version(ds_sd)
+        quantizer = WeightQuantization(
+            mlp_extra_grouping=mlp_extra_grouping,
+            mp_size=mp_world_size) if quantize else None
+
+        import jax.numpy as jnp
+        for key in keys:
+            value_list = [np.asarray(sd[key]) for sd in client_sd_list]
+            if "attention.dense.weight" in key or \
+                    "mlp.dense_4h_to_h.weight" in key:
+                if quantize:
+                    value_list = [np.asarray(v) for v in quantizer.Quantize(
+                        [jnp.asarray(v) for v in value_list],
+                        quantize_bits, groups, key=key)]
+                new_client_sd[key] = np.concatenate(value_list, axis=1)
+            elif "attention.query_key_value" in key:
+                if quantize and "weight" in key:
+                    value_list = [np.asarray(v) for v in quantizer.Quantize(
+                        [jnp.asarray(v) for v in value_list],
+                        quantize_bits, groups, key=key)]
+                new_client_sd[key] = self.merge_query_key_value(
+                    value_list, ckpt_ver)
+            elif "mlp.dense_h_to_4h" in key or "word_embeddings.weight" in key:
+                if quantize and "mlp.dense_h_to_4h.weight" in key:
+                    value_list = [np.asarray(v) for v in quantizer.Quantize(
+                        [jnp.asarray(v) for v in value_list],
+                        quantize_bits, groups, key=key)]
+                new_client_sd[key] = np.concatenate(value_list, axis=0)
+            else:
+                new_client_sd[key] = value_list[0]
+        all_scales = np.asarray(quantizer.merge_scales()) if quantize else None
+        ds_sd = self.set_module(ds_sd, new_client_sd)
+        return ds_sd, all_scales, len(client_sd_list)
+
+    def split_state_dict(self, mp_world_size, mp_rank, quantize=False,
+                         quantize_bits=8, groups=64,
+                         mlp_extra_grouping=True):
+        """(ref: :355)"""
+        self.sanity_check(self.ckpt_list[0])
+        sd, num_to_split, ckpt_offset = self.get_split_state_dict(
+            mp_world_size, mp_rank)
+        ds_sd = copy.deepcopy(sd)
+        new_client_sd = collections.OrderedDict()
+        client_sd = self.get_module(sd)
+        ckpt_ver = self.get_checkpoint_version(ds_sd)
+        quantizer = WeightQuantization(
+            mlp_extra_grouping=mlp_extra_grouping,
+            mp_size=mp_world_size) if quantize else None
+
+        import jax.numpy as jnp
+        for key in client_sd.keys():
+            value = np.asarray(client_sd[key])
+            if "attention.dense.weight" in key or \
+                    "mlp.dense_4h_to_h.weight" in key:
+                assert value.shape[1] % num_to_split == 0
+                split_size = value.shape[1] // num_to_split
+                if quantize:
+                    [q] = quantizer.Quantize([jnp.asarray(value)],
+                                             quantize_bits, groups, key=key)
+                    value = np.asarray(q)
+                new_client_sd[key] = value[
+                    :, ckpt_offset * split_size:(ckpt_offset + 1) * split_size]
+            elif "attention.query_key_value" in key:
+                if quantize and "weight" in key:
+                    [q] = quantizer.Quantize([jnp.asarray(value)],
+                                             quantize_bits, groups, key=key)
+                    value = np.asarray(q)
+                new_client_sd[key] = self.split_query_key_value(
+                    value, num_to_split, ckpt_offset, ckpt_ver)
+            elif "mlp.dense_h_to_4h" in key or \
+                    "word_embeddings.weight" in key:
+                assert value.shape[0] % num_to_split == 0
+                split_size = value.shape[0] // num_to_split
+                if quantize and "mlp.dense_h_to_4h.weight" in key:
+                    [q] = quantizer.Quantize([jnp.asarray(value)],
+                                             quantize_bits, groups, key=key)
+                    value = np.asarray(q)
+                new_client_sd[key] = value[
+                    ckpt_offset * split_size:(ckpt_offset + 1) * split_size]
+            else:
+                new_client_sd[key] = value
+        all_scales = np.asarray(quantizer.merge_scales_split(num_to_split)
+                                [ckpt_offset]) if quantize else None
+        ds_sd = self.set_module(ds_sd, new_client_sd)
+        return ds_sd, all_scales
+
+    def sanity_check(self, ckpt_file_name):
+        keys_to_check = [
+            "attention.dense.weight", "mlp.dense_4h_to_h.weight",
+            "attention.query_key_value", "mlp.dense_h_to_4h.weight",
+            "mlp.dense_h_to_4h.bias",
+        ]
+        sd = _load_ckpt_file(ckpt_file_name)
+        module = self.get_module(sd) if self.module_key else sd
+
+        def check_key_exist(partial_key, mod):
+            return any(partial_key in k for k in mod.keys())
+
+        for key in keys_to_check:
+            assert check_key_exist(partial_key=key, mod=module), \
+                f"key: {key} is not found in the checkpoint {ckpt_file_name}"

@@ -1,0 +1,201 @@
+"""TP checkpoint reshard loader tests (ref: the reference has no unit
+tests for state_dict_factory; semantics are verified here against
+round-trip identities: split∘merge == identity, merge(mp=1) rebuilds
+the full tensor)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.checkpoint import SDLoaderFactory, constants
+from deepspeed_tpu.runtime.state_dict_factory import MegatronSDLoader
+
+H = 16
+HEADS = 4
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _make_rank_sd(rng, mp, rank, ckpt_version=2.0):
+    """One Megatron-style TP shard: qkv [3h/mp, h], dense [h, h/mp],
+    h_to_4h [4h/mp, h], 4h_to_h [h, 4h/mp]."""
+    pref = "transformer.layers.0"
+    module = {
+        f"{pref}.attention.query_key_value.weight":
+            rng.standard_normal((3 * H // mp, H)).astype(np.float32),
+        f"{pref}.attention.query_key_value.bias":
+            rng.standard_normal((3 * H // mp,)).astype(np.float32),
+        f"{pref}.attention.dense.weight":
+            rng.standard_normal((H, H // mp)).astype(np.float32),
+        f"{pref}.attention.dense.bias":
+            rng.standard_normal((H,)).astype(np.float32),
+        f"{pref}.mlp.dense_h_to_4h.weight":
+            rng.standard_normal((4 * H // mp, H)).astype(np.float32),
+        f"{pref}.mlp.dense_h_to_4h.bias":
+            rng.standard_normal((4 * H // mp,)).astype(np.float32),
+        f"{pref}.mlp.dense_4h_to_h.weight":
+            rng.standard_normal((H, 4 * H // mp)).astype(np.float32),
+        f"{pref}.mlp.dense_4h_to_h.bias":
+            rng.standard_normal((H,)).astype(np.float32),
+        f"{pref}.input_layernorm.weight":
+            np.ones((H,), np.float32),
+        "word_embeddings.weight":
+            rng.standard_normal((32 // mp, H)).astype(np.float32),
+    }
+    return {"module": module, "checkpoint_version": ckpt_version}
+
+
+def _save_shards(tmp_path, mp, seed=0, ckpt_version=2.0, fmt="pt"):
+    rng = np.random.default_rng(seed)
+    paths = []
+    for r in range(mp):
+        sd = _make_rank_sd(rng, mp, r, ckpt_version)
+        p = str(tmp_path / f"mp_rank_{r:02d}_model_states.{fmt}")
+        if fmt == "pt":
+            import torch
+            torch.save({"module": {k: torch.from_numpy(v) for k, v in
+                                   sd["module"].items()},
+                        "checkpoint_version": ckpt_version}, p)
+        else:
+            np.savez(p, __sd__=np.asarray(sd, dtype=object))
+        paths.append(p)
+    return paths
+
+
+def test_direct_load(tmp_path):
+    paths = _save_shards(tmp_path, mp=2)
+    loader = SDLoaderFactory.get_sd_loader(paths, "Megatron", version=2.0)
+    load_path, sd, (scales, merge_count) = loader.load(
+        mp_world_size=2, mp_rank=1)
+    assert load_path == paths[1]
+    assert merge_count == 1 and scales is None
+    assert sd["module"][
+        "transformer.layers.0.attention.dense.weight"].shape == (H, H // 2)
+
+
+def test_merge_to_mp1(tmp_path):
+    paths = _save_shards(tmp_path, mp=2)
+    loader = SDLoaderFactory.get_sd_loader(paths, "Megatron", version=2.0)
+    _, sd, (_, merge_count) = loader.load(mp_world_size=1, mp_rank=0)
+    assert merge_count == 2
+    mod = sd["module"]
+    p = "transformer.layers.0"
+    assert mod[f"{p}.attention.query_key_value.weight"].shape == (3 * H, H)
+    assert mod[f"{p}.attention.dense.weight"].shape == (H, H)
+    assert mod[f"{p}.mlp.dense_h_to_4h.weight"].shape == (4 * H, H)
+    assert mod[f"{p}.mlp.dense_4h_to_h.weight"].shape == (H, 4 * H)
+    assert mod["word_embeddings.weight"].shape == (32, H)
+    # replicated tensors come from rank 0
+    np.testing.assert_allclose(mod[f"{p}.input_layernorm.weight"], 1.0)
+
+
+def test_split_then_merge_roundtrip(tmp_path):
+    """split(1→2) then merge(2→1) must reproduce the original weights."""
+    paths = _save_shards(tmp_path, mp=1)
+    loader = SDLoaderFactory.get_sd_loader(paths, "Megatron", version=2.0)
+    orig = loader.load(mp_world_size=1, mp_rank=0)[1]["module"]
+
+    import torch
+    halves = []
+    for r in range(2):
+        _, sd, _ = loader.load(mp_world_size=2, mp_rank=r)
+        p2 = str(tmp_path / f"split_{r}.pt")
+        torch.save({"module": {k: torch.from_numpy(np.asarray(v))
+                               for k, v in sd["module"].items()},
+                    "checkpoint_version": 2.0}, p2)
+        halves.append(p2)
+
+    loader2 = SDLoaderFactory.get_sd_loader(halves, "Megatron", version=2.0)
+    merged = loader2.load(mp_world_size=1, mp_rank=0)[1]["module"]
+    for k in orig:
+        np.testing.assert_allclose(merged[k], orig[k], err_msg=k)
+
+
+def test_qkv_version0_interleaved(tmp_path):
+    """v0 layout [(3*np*hn), h]: merge must interleave-regroup, so it
+    differs from plain concat but roundtrips with split."""
+    paths = _save_shards(tmp_path, mp=2, ckpt_version=0)
+    loader = SDLoaderFactory.get_sd_loader(paths, "Megatron", version=0)
+    _, merged_sd, _ = loader.load(mp_world_size=1, mp_rank=0)
+    key = "transformer.layers.0.attention.query_key_value.weight"
+    merged = merged_sd["module"][key]
+    assert merged.shape == (3 * H, H)
+    # roundtrip: splitting the merged tensor back to 2 ranks reproduces
+    # each rank's original shard
+    rank_shards = [
+        np.asarray(loader.load(mp_world_size=2, mp_rank=r)[1]["module"][key])
+        for r in range(2)]
+    m = MegatronSDLoader([paths[0]], version=0)
+    for r in range(2):
+        back = m.split_query_key_value(merged, 2, r, 0)
+        # split-of-merge equals the original rank shard
+        orig = _load_rank_qkv(paths[r])
+        np.testing.assert_allclose(back, orig)
+    del rank_shards
+
+
+def _load_rank_qkv(path):
+    import torch
+    sd = torch.load(path, map_location="cpu", weights_only=False)
+    return sd["module"][
+        "transformer.layers.0.attention.query_key_value.weight"].numpy()
+
+
+def test_quantized_load(tmp_path):
+    paths = _save_shards(tmp_path, mp=2)
+    loader = SDLoaderFactory.get_sd_loader(paths, "Megatron", version=2.0)
+    _, sd, (scales, _) = loader.load(mp_world_size=1, mp_rank=0,
+                                     quantize=True, quantize_bits=8,
+                                     quantize_groups=4)
+    mod = sd["module"]
+    key = "transformer.layers.0.attention.dense.weight"
+    assert mod[key].dtype == np.int8
+    assert scales is not None and scales.ndim == 3
+
+
+def test_loader_json_and_validation(tmp_path):
+    paths = _save_shards(tmp_path, mp=2)
+    cfg = {"type": "Megatron", "checkpoints": paths, "version": 2.0}
+    jpath = tmp_path / "ckpt.json"
+    jpath.write_text(json.dumps(cfg))
+    loader = SDLoaderFactory.get_sd_loader_json(str(jpath))
+    assert isinstance(loader, MegatronSDLoader)
+    with pytest.raises(ValueError):
+        SDLoaderFactory.get_sd_loader(paths, sd_type="HF")
+    with pytest.raises(AssertionError):
+        SDLoaderFactory.get_sd_loader(["/nonexistent.pt"], "Megatron")
+
+
+def test_checkpoint_constants():
+    assert constants.OPTIMIZER_STATE_DICT == "optimizer_state_dict"
+    assert constants.ZERO_STAGE == "zero_stage"
+    assert constants.DS_VERSION == "ds_version"
+
+
+def test_zero_to_fp32_cli(tmp_path, devices):
+    """Engine save → offline consolidation CLI → full fp32 npz
+    (ref: deepspeed/utils/zero_to_fp32.py workflow)."""
+    import deepspeed_tpu
+    from tests.simple_model import random_batch, simple_model_loss, \
+        simple_model_params
+    params = simple_model_params(hidden_dim=16)
+    cfg = {"train_batch_size": 8, "bf16": {"enabled": True},
+           "zero_optimization": {"stage": 3, "stage3_min_shard_size": 1},
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-3}}}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_model_loss, model_parameters=params, config=cfg)
+    engine.train_batch(random_batch(8, 16))
+    ckpt_dir = tmp_path / "ckpt"
+    engine.save_checkpoint(str(ckpt_dir))
+
+    out = tmp_path / "fp32.npz"
+    from deepspeed_tpu.cli import zero_to_fp32_main
+    zero_to_fp32_main([str(ckpt_dir), str(out)])
+    with np.load(str(out)) as z:
+        assert "layer_0.kernel" in z.files
+        assert z["layer_0.kernel"].shape == (16, 16)
+        assert z["layer_0.kernel"].dtype == np.float32
