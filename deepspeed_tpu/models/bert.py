@@ -1,0 +1,201 @@
+"""BERT model family — MLM (+NSP) pretraining, TPU-native.
+
+Capability match for the reference's BERT stack: the fused transformer
+layer it showcases (ref: deepspeed/ops/transformer/transformer.py:460,
+tutorial docs/_tutorials/bert-pretraining.md) and the full BERT parity
+models its kernel tests train (ref: tests/unit/modeling.py 1,597 LoC
+post-LN, modelingpreln.py pre-LN). Layers are stacked on a leading axis
+and run under ``lax.scan`` (one compiled block, L iterations — the XLA
+analog of the reference reusing one CUDA layer object per depth);
+blocks live under the ``"block"`` pytree key so MoQ/eigenvalue's
+stacked-layer machinery applies unchanged.
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.transformer.encoder_layer import (
+    DeepSpeedTransformerConfig, _layernorm, init_layer_params, layer_forward)
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    n_layers: int = 12
+    n_heads: int = 12
+    d_model: int = 768
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    dropout: float = 0.1
+    layer_norm_eps: float = 1e-12
+    pre_layer_norm: bool = True
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def layer_config(self) -> DeepSpeedTransformerConfig:
+        return DeepSpeedTransformerConfig(
+            hidden_size=self.d_model, heads=self.n_heads,
+            attn_dropout_ratio=self.dropout,
+            hidden_dropout_ratio=self.dropout,
+            num_hidden_layers=self.n_layers,
+            layer_norm_eps=self.layer_norm_eps,
+            pre_layer_norm=self.pre_layer_norm)
+
+
+PRESETS = {
+    "bert-base": dict(n_layers=12, n_heads=12, d_model=768),
+    "bert-large": dict(n_layers=24, n_heads=16, d_model=1024),
+    "bert-tiny": dict(n_layers=2, n_heads=2, d_model=128),
+}
+
+
+def preset(name: str, **overrides) -> BertConfig:
+    return BertConfig(**{**PRESETS[name], **overrides})
+
+
+def init_params(rng: jax.Array, cfg: BertConfig) -> Dict:
+    ks = jax.random.split(rng, 8)
+    s = 0.02
+    d = cfg.d_model
+
+    layer_keys = jax.random.split(ks[0], cfg.n_layers)
+    per_layer = [init_layer_params(k, cfg.layer_config) for k in layer_keys]
+    block = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_layer)
+
+    return {
+        "embeddings": {
+            "word": jax.random.normal(ks[1], (cfg.vocab_size, d)) * s,
+            "position": jax.random.normal(ks[2], (cfg.max_seq_len, d)) * s,
+            "token_type": jax.random.normal(ks[3], (cfg.type_vocab_size, d)) * s,
+            "ln": {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))},
+        },
+        "block": block,
+        "pooler": {"kernel": jax.random.normal(ks[4], (d, d)) * s,
+                   "bias": jnp.zeros((d,))},
+        "mlm": {  # transform + tied-embedding decoder bias
+            "kernel": jax.random.normal(ks[5], (d, d)) * s,
+            "bias": jnp.zeros((d,)),
+            "ln": {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))},
+            "decoder_bias": jnp.zeros((cfg.vocab_size,)),
+        },
+        "nsp": {"kernel": jax.random.normal(ks[6], (d, 2)) * s,
+                "bias": jnp.zeros((2,))},
+    }
+
+
+def encode(params: Dict, tokens: jnp.ndarray, cfg: BertConfig,
+           token_type_ids: Optional[jnp.ndarray] = None,
+           attention_mask: Optional[jnp.ndarray] = None,
+           rng: Optional[jax.Array] = None,
+           deterministic: bool = True) -> jnp.ndarray:
+    """tokens [B, S] -> hidden states [B, S, D] (compute dtype)."""
+    B, S = tokens.shape
+    dtype = cfg.dtype
+    emb = params["embeddings"]
+    x = emb["word"].astype(dtype)[tokens] + \
+        emb["position"].astype(dtype)[:S][None]
+    if token_type_ids is None:
+        token_type_ids = jnp.zeros_like(tokens)
+    x = x + emb["token_type"].astype(dtype)[token_type_ids]
+    x = _layernorm(x, emb["ln"]["scale"].astype(dtype),
+                   emb["ln"]["bias"].astype(dtype), cfg.layer_norm_eps)
+
+    lcfg = cfg.layer_config
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    def body(carry, layer):
+        h, r = carry
+        r, lr = jax.random.split(r)
+        y = layer_forward(layer, h, lcfg, attn_mask=attention_mask,
+                          rng=None if deterministic else lr,
+                          deterministic=deterministic)
+        return (y, r), None
+
+    (x, _), _ = jax.lax.scan(body, (x, rng), params["block"])
+    return x
+
+
+def forward(params: Dict, tokens: jnp.ndarray, cfg: BertConfig,
+            token_type_ids=None, attention_mask=None,
+            rng: Optional[jax.Array] = None,
+            deterministic: bool = True):
+    """Returns (mlm_logits [B,S,V], nsp_logits [B,2])."""
+    x = encode(params, tokens, cfg, token_type_ids, attention_mask,
+               rng, deterministic)
+    dtype = x.dtype
+    # MLM head: transform -> LN -> tied-embedding decode
+    h = x @ params["mlm"]["kernel"].astype(dtype) + \
+        params["mlm"]["bias"].astype(dtype)
+    h = jax.nn.gelu(h, approximate=True)
+    h = _layernorm(h, params["mlm"]["ln"]["scale"].astype(dtype),
+                   params["mlm"]["ln"]["bias"].astype(dtype),
+                   cfg.layer_norm_eps)
+    mlm_logits = h @ params["embeddings"]["word"].astype(dtype).T + \
+        params["mlm"]["decoder_bias"].astype(dtype)
+    # NSP head on pooled [CLS]
+    pooled = jnp.tanh(x[:, 0] @ params["pooler"]["kernel"].astype(dtype) +
+                      params["pooler"]["bias"].astype(dtype))
+    nsp_logits = pooled @ params["nsp"]["kernel"].astype(dtype) + \
+        params["nsp"]["bias"].astype(dtype)
+    return mlm_logits, nsp_logits
+
+
+def loss_fn(params: Dict, batch: Dict, rng: jax.Array, cfg: BertConfig,
+            deterministic: bool = False) -> jnp.ndarray:
+    """MLM (+optional NSP) loss. batch:
+    tokens [B,S]; mlm_labels [B,S] with -1 = not masked;
+    optional token_type_ids, attention_mask, nsp_labels [B]."""
+    mlm_logits, nsp_logits = forward(
+        params, batch["tokens"], cfg,
+        token_type_ids=batch.get("token_type_ids"),
+        attention_mask=batch.get("attention_mask"),
+        rng=rng, deterministic=deterministic)
+    labels = batch["mlm_labels"]
+    logp = jax.nn.log_softmax(mlm_logits.astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(
+        logp, jnp.maximum(labels, 0)[..., None], axis=-1).squeeze(-1)
+    mask = (labels >= 0).astype(jnp.float32)
+    loss = -(picked * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    if "nsp_labels" in batch:
+        nsp_logp = jax.nn.log_softmax(nsp_logits.astype(jnp.float32), -1)
+        loss = loss - jnp.mean(jnp.take_along_axis(
+            nsp_logp, batch["nsp_labels"][:, None], axis=-1))
+    return loss
+
+
+def make_loss_fn(cfg: BertConfig):
+    """Engine-contract loss: (params, batch, rng) -> loss."""
+    def _loss(params, batch, rng):
+        return loss_fn(params, batch, rng, cfg)
+    return _loss
+
+
+def bert_partition_rules(vocab_parallel: bool = False):
+    """TP rules: column-parallel qkv/mlp_in, row-parallel
+    attn_out/mlp_out — the Megatron recipe the reference delegates to
+    the client mpu (SURVEY.md §2.2 TP row). ``vocab_parallel`` also
+    row-shards the word embedding (requires vocab_size % tp == 0)."""
+    from deepspeed_tpu.parallel.sharding import PartitionRule
+    from jax.sharding import PartitionSpec as P
+    rules = [
+        PartitionRule(r"block/qkv/kernel", P(None, None, "model")),
+        PartitionRule(r"block/qkv/bias", P(None, "model")),
+        PartitionRule(r"block/attn_out/kernel", P(None, "model", None)),
+        PartitionRule(r"block/mlp_in/kernel", P(None, None, "model")),
+        PartitionRule(r"block/mlp_in/bias", P(None, "model")),
+        PartitionRule(r"block/mlp_out/kernel", P(None, "model", None)),
+    ]
+    if vocab_parallel:
+        rules.append(PartitionRule(r"embeddings/word", P("model", None)))
+    return rules
+
+
+def num_params(cfg: BertConfig) -> int:
+    d, L, V = cfg.d_model, cfg.n_layers, cfg.vocab_size
+    per_layer = 12 * d * d + 13 * d
+    emb = (V + cfg.max_seq_len + cfg.type_vocab_size) * d + 2 * d
+    heads = 2 * d * d + 6 * d + V + 2  # pooler + mlm transform/ln + nsp
+    return L * per_layer + emb + heads

@@ -1,0 +1,6 @@
+from deepspeed_tpu.ops.transformer.encoder_layer import (
+    DeepSpeedTransformerConfig, init_layer_params, layer_forward,
+    layer_forward_reference)
+
+__all__ = ["DeepSpeedTransformerConfig", "init_layer_params",
+           "layer_forward", "layer_forward_reference"]

@@ -1,0 +1,167 @@
+"""BERT family + fused encoder layer tests (ref:
+tests/unit/test_cuda_forward.py kernel-parity-vs-python-BERT pattern;
+tests/unit/modeling.py post-LN, modelingpreln.py pre-LN variants)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import bert
+from deepspeed_tpu.ops.transformer import (
+    DeepSpeedTransformerConfig, init_layer_params, layer_forward,
+    layer_forward_reference)
+
+TINY = dict(vocab_size=97, n_layers=2, n_heads=2, d_model=32,
+            max_seq_len=32, dropout=0.0)
+
+
+def _mlm_batch(rng, B=4, S=16, vocab=97, mask_frac=0.3):
+    toks = rng.integers(0, vocab, (B, S)).astype(np.int32)
+    labels = np.full((B, S), -1, np.int32)
+    mask = rng.random((B, S)) < mask_frac
+    labels[mask] = toks[mask]
+    inp = toks.copy()
+    inp[mask] = 0  # [MASK]
+    return {"tokens": inp, "mlm_labels": labels}
+
+
+# ------------------------------------------------------- encoder layer
+
+@pytest.mark.parametrize("pre_ln", [True, False])
+def test_layer_parity_vs_fp32_reference(rng, pre_ln):
+    """bf16 fused layer vs fp32 naive math within tolerance (ref:
+    test_cuda_forward.py tolerances: rtol in the 1e-2 range for fp16)."""
+    cfg = DeepSpeedTransformerConfig(hidden_size=64, heads=4,
+                                     pre_layer_norm=pre_ln,
+                                     attn_dropout_ratio=0.0,
+                                     hidden_dropout_ratio=0.0)
+    params = init_layer_params(jax.random.PRNGKey(0), cfg)
+    x = jnp.asarray(rng.standard_normal((2, 16, 64)), jnp.float32)
+    ref = layer_forward_reference(params, x, cfg)
+    p16 = jax.tree_util.tree_map(lambda a: a.astype(jnp.bfloat16), params)
+    out = layer_forward(p16, x.astype(jnp.bfloat16), cfg)
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref)))
+    assert err < 5e-2, err
+
+
+def test_layer_padding_mask(rng):
+    """Padding tokens must not influence unpadded positions."""
+    cfg = DeepSpeedTransformerConfig(hidden_size=32, heads=2,
+                                     attn_dropout_ratio=0.0,
+                                     hidden_dropout_ratio=0.0)
+    params = init_layer_params(jax.random.PRNGKey(0), cfg)
+    x = jnp.asarray(rng.standard_normal((1, 8, 32)), jnp.float32)
+    mask = jnp.asarray([[1, 1, 1, 1, 0, 0, 0, 0]], jnp.int32)
+    out1 = layer_forward(params, x, cfg, attn_mask=mask)
+    # changing padded content must not change valid positions
+    x2 = x.at[:, 4:].set(123.0)
+    out2 = layer_forward(params, x2, cfg, attn_mask=mask)
+    np.testing.assert_allclose(np.asarray(out1[:, :4]),
+                               np.asarray(out2[:, :4]), atol=1e-5)
+
+
+def test_layer_flash_path_matches_jnp(rng):
+    """Unmasked long-seq layer (flash-eligible) vs masked-with-all-ones
+    (jnp path) — same math, two kernels."""
+    cfg = DeepSpeedTransformerConfig(hidden_size=64, heads=2,
+                                     attn_dropout_ratio=0.0,
+                                     hidden_dropout_ratio=0.0)
+    params = init_layer_params(jax.random.PRNGKey(0), cfg)
+    x = jnp.asarray(rng.standard_normal((2, 128, 64)), jnp.float32)
+    flash_out = layer_forward(params, x, cfg)  # attn_mask None
+    ones = jnp.ones((2, 128), jnp.int32)
+    jnp_out = layer_forward(params, x, cfg, attn_mask=ones)
+    err = float(jnp.max(jnp.abs(flash_out - jnp_out)))
+    assert err < 2e-2, err
+
+
+# --------------------------------------------------------------- model
+
+def test_bert_forward_shapes(rng):
+    cfg = bert.BertConfig(**TINY)
+    params = bert.init_params(jax.random.PRNGKey(0), cfg)
+    toks = jnp.asarray(rng.integers(0, 97, (2, 16)), jnp.int32)
+    mlm, nsp = bert.forward(params, toks, cfg)
+    assert mlm.shape == (2, 16, 97)
+    assert nsp.shape == (2, 2)
+
+
+def test_bert_presets():
+    large = bert.preset("bert-large")
+    assert large.n_layers == 24 and large.d_model == 1024
+    base = bert.preset("bert-base", max_seq_len=128)
+    assert base.max_seq_len == 128
+    # analytic vs real param count
+    cfg = bert.BertConfig(**TINY)
+    params = bert.init_params(jax.random.PRNGKey(0), cfg)
+    real = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    assert real == bert.num_params(cfg)
+
+
+@pytest.mark.parametrize("pre_ln", [True, False])
+def test_bert_mlm_overfits(devices, pre_ln, rng):
+    """Tiny-model convergence, both residual placements (ref:
+    modeling.py vs modelingpreln.py coverage)."""
+    cfg = bert.BertConfig(**{**TINY, "pre_layer_norm": pre_ln})
+    params = bert.init_params(jax.random.PRNGKey(0), cfg)
+    ds_cfg = {"train_batch_size": 8, "bf16": {"enabled": True},
+              "optimizer": {"type": "adamw", "params": {"lr": 3e-3}},
+              "steps_per_print": 10000}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=bert.make_loss_fn(cfg), model_parameters=params, config=ds_cfg)
+    batch = _mlm_batch(rng, B=8, S=16)
+    losses = [float(engine.train_batch(batch)["loss"]) for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.6, losses
+
+
+def test_bert_nsp_loss(rng):
+    cfg = bert.BertConfig(**TINY)
+    params = bert.init_params(jax.random.PRNGKey(0), cfg)
+    batch = _mlm_batch(rng, B=4, S=16)
+    l_mlm = bert.loss_fn(params, batch, jax.random.PRNGKey(0), cfg,
+                         deterministic=True)
+    batch["nsp_labels"] = jnp.asarray([0, 1, 0, 1], jnp.int32)
+    l_both = bert.loss_fn(params, batch, jax.random.PRNGKey(0), cfg,
+                          deterministic=True)
+    assert float(l_both) > float(l_mlm)  # NSP term added
+
+
+def test_bert_attention_mask_end_to_end(rng):
+    cfg = bert.BertConfig(**TINY)
+    params = bert.init_params(jax.random.PRNGKey(0), cfg)
+    toks = jnp.asarray(rng.integers(0, 97, (2, 16)), jnp.int32)
+    mask = jnp.concatenate([jnp.ones((2, 8), jnp.int32),
+                            jnp.zeros((2, 8), jnp.int32)], axis=1)
+    mlm1, _ = bert.forward(params, toks, cfg, attention_mask=mask)
+    toks2 = toks.at[:, 8:].set(5)
+    mlm2, _ = bert.forward(params, toks2, cfg, attention_mask=mask)
+    np.testing.assert_allclose(np.asarray(mlm1[:, :8], np.float32),
+                               np.asarray(mlm2[:, :8], np.float32),
+                               atol=1e-2)
+
+
+def test_bert_tensor_parallel(devices, rng):
+    """TP=2 sharded BERT matches unsharded forward loss."""
+    cfg = bert.BertConfig(**TINY)
+    params = bert.init_params(jax.random.PRNGKey(0), cfg)
+    batch = _mlm_batch(rng, B=8, S=16)
+    ds_base = {"train_batch_size": 8,
+               "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+               "steps_per_print": 10000}
+    e1, _, _, _ = deepspeed_tpu.initialize(
+        model=bert.make_loss_fn(cfg),
+        model_parameters=jax.tree_util.tree_map(np.asarray, params),
+        config=dict(ds_base))
+    ds_tp = dict(ds_base, mesh={"tensor_parallel_size": 2})
+    e2, _, _, _ = deepspeed_tpu.initialize(
+        model=bert.make_loss_fn(cfg),
+        model_parameters=jax.tree_util.tree_map(np.asarray, params),
+        config=ds_tp, partition_rules=bert.bert_partition_rules())
+    # qkv kernel is actually sharded over the model axis
+    shard = e2.state.params["block"]["qkv"]["kernel"].sharding
+    assert "model" in str(shard.spec), shard.spec
+    m1 = e1.train_batch(batch)
+    m2 = e2.train_batch(batch)
+    assert abs(float(m1["loss"]) - float(m2["loss"])) < 1e-2
