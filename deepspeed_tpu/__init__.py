@@ -94,6 +94,12 @@ def init_inference(model=None, **kwargs):
     return InferenceEngine(model, **kwargs)
 
 
+# activation checkpointing API, importable as deepspeed_tpu.checkpointing
+# (ref: deepspeed.checkpointing re-export in deepspeed/__init__.py)
+from deepspeed_tpu.runtime.activation_checkpointing import (  # noqa: E402
+    checkpointing)
+
+
 def add_config_arguments(parser):
     """Add --deepspeed / --deepspeed_config CLI args
     (ref: deepspeed/__init__.py:153-204)."""

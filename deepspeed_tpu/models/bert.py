@@ -104,6 +104,8 @@ def encode(params: Dict, tokens: jnp.ndarray, cfg: BertConfig,
                    emb["ln"]["bias"].astype(dtype), cfg.layer_norm_eps)
 
     lcfg = cfg.layer_config
+    assert deterministic or rng is not None, \
+        "training mode (deterministic=False) needs an rng for dropout"
     rng = rng if rng is not None else jax.random.PRNGKey(0)
 
     def body(carry, layer):

@@ -1,0 +1,124 @@
+"""AIO performance sweep — NVMe tuning harness.
+
+Capability match for the reference's aio benchmark suite
+(ref: csrc/aio/py_test/aio_bench_perf_sweep.py:397 LoC + ds_aio_handle.py,
+parse_aio_stats.py): sweep (block_size x queue_depth x thread_count x
+read/write) over the C++ aio thread pool, report GB/s per combo and the
+best config to paste into the ``aio`` section of the ds_config. The
+reference shells out one subprocess per point; in-process is enough
+here since the pool is its own threads.
+
+CLI: ``python -m deepspeed_tpu.ops.aio.perf_sweep --nvme-dir /mnt/nvme``
+"""
+
+import argparse
+import itertools
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+DEFAULT_SWEEP = {
+    "block_size": [128 * 1024, 256 * 1024, 1024 * 1024],
+    "queue_depth": [4, 16, 32],
+    "thread_count": [1, 2, 4],
+    "op": ["read", "write"],
+}
+
+
+def _one_point(nvme_dir: str, io_bytes: int, block_size: int,
+               queue_depth: int, thread_count: int, op: str,
+               use_direct: bool = True) -> float:
+    """Returns achieved GB/s for one configuration. ``use_direct``
+    (O_DIRECT) bypasses the page cache so the numbers reflect the
+    device — without it a freshly-written file reads back from DRAM."""
+    from deepspeed_tpu.ops.aio import AlignedBuffer, AsyncIOHandle
+
+    handle = AsyncIOHandle(block_size=block_size, queue_depth=queue_depth,
+                           thread_count=thread_count, use_direct=use_direct)
+    buf = AlignedBuffer(io_bytes)
+    arr = buf.view(io_bytes // 4, np.float32)
+    path = os.path.join(nvme_dir, f"_aio_sweep_{os.getpid()}.bin")
+    try:
+        if op == "write":
+            arr[:] = 1.0
+            t0 = time.perf_counter()
+            handle.sync_pwrite(arr, path)
+            dt = time.perf_counter() - t0
+        else:
+            arr[:] = 1.0
+            handle.sync_pwrite(arr, path)
+            t0 = time.perf_counter()
+            handle.sync_pread(arr, path)
+            dt = time.perf_counter() - t0
+        return io_bytes / dt / 1e9
+    finally:
+        if os.path.exists(path):
+            os.unlink(path)
+        handle.close()
+        buf.free()
+
+
+def sweep(nvme_dir: str, io_mb: int = 64,
+          space: Optional[Dict[str, List]] = None,
+          use_direct: bool = True) -> List[Dict]:
+    """Run the full sweep; returns records grouped by op (reads first),
+    best-first within each group. ``use_direct=False`` only for
+    filesystems without O_DIRECT (tmpfs) — the numbers then measure the
+    page cache, not the device."""
+    space = {**DEFAULT_SWEEP, **(space or {})}
+    io_bytes = io_mb * 1024 * 1024
+    records = []
+    keys = list(space.keys())
+    for combo in itertools.product(*space.values()):
+        cfg = dict(zip(keys, combo))
+        try:
+            gbps = _one_point(nvme_dir, io_bytes, cfg["block_size"],
+                              cfg["queue_depth"], cfg["thread_count"],
+                              cfg["op"], use_direct=use_direct)
+            records.append({**cfg, "gbps": gbps})
+            logger.info(f"{cfg} -> {gbps:.2f} GB/s")
+        except Exception as e:
+            records.append({**cfg, "gbps": None, "error": str(e)})
+            logger.warning(f"{cfg} failed: {e}")
+    records.sort(key=lambda r: (r["op"] != "read", -(r["gbps"] or 0.0)))
+    return records
+
+
+def best_aio_config(records: List[Dict]) -> Dict:
+    """Best read point → the ``aio`` ds_config section
+    (ref: the sweep's optimal-config output)."""
+    for r in records:
+        if r.get("gbps") and r["op"] == "read":
+            return {"block_size": r["block_size"],
+                    "queue_depth": r["queue_depth"],
+                    "thread_count": r["thread_count"],
+                    "single_submit": False, "overlap_events": True}
+    return {}
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="aio_perf_sweep")
+    parser.add_argument("--nvme-dir", required=True,
+                        help="directory on the NVMe device to benchmark")
+    parser.add_argument("--io-mb", type=int, default=64)
+    parser.add_argument("--output", default=None,
+                        help="write records json here")
+    parser.add_argument("--no-direct", action="store_true",
+                        help="skip O_DIRECT (tmpfs etc; measures cache)")
+    args = parser.parse_args(argv)
+    records = sweep(args.nvme_dir, io_mb=args.io_mb,
+                    use_direct=not args.no_direct)
+    print(json.dumps({"best_aio_config": best_aio_config(records),
+                      "records": records[:10]}, indent=2))
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(records, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

@@ -79,11 +79,12 @@ def _dropout(x, rate, rng):
     return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
 
 
-def _attention_core(q, k, v, attn_mask, cfg, dropout_rng, deterministic):
+def _attention_core(q, k, v, attn_mask, cfg, dropout_rng, deterministic,
+                    allow_flash=True):
     """[B,S,H,D] attention; flash kernel when unmasked + deterministic,
     masked jnp softmax otherwise."""
     B, S, H, D = q.shape
-    use_flash = (attn_mask is None
+    use_flash = (allow_flash and attn_mask is None
                  and (deterministic or cfg.attn_dropout_ratio == 0.0)
                  and S >= 128 and D % 8 == 0)
     if use_flash:
@@ -108,7 +109,8 @@ def layer_forward(params: Dict, x: jnp.ndarray,
                   cfg: DeepSpeedTransformerConfig,
                   attn_mask: Optional[jnp.ndarray] = None,
                   rng: Optional[jax.Array] = None,
-                  deterministic: bool = True) -> jnp.ndarray:
+                  deterministic: bool = True,
+                  allow_flash: bool = True) -> jnp.ndarray:
     """One encoder block. x: [B, S, H]; attn_mask: [B, S] (1=token).
 
     Pre-LN:  x + Attn(LN(x));  x + MLP(LN(x))
@@ -131,7 +133,8 @@ def layer_forward(params: Dict, x: jnp.ndarray,
         k = k.reshape(B, S, H, D)
         v = v.reshape(B, S, H, D)
         ctx = _attention_core(q, k, v, attn_mask, cfg, r_probs,
-                              deterministic).reshape(B, S, h)
+                              deterministic,
+                              allow_flash=allow_flash).reshape(B, S, h)
         out = ctx @ params["attn_out"]["kernel"].astype(inp.dtype) + \
             params["attn_out"]["bias"].astype(inp.dtype)
         if not deterministic and cfg.hidden_dropout_ratio > 0:
@@ -165,7 +168,10 @@ def layer_forward(params: Dict, x: jnp.ndarray,
 
 def layer_forward_reference(params, x, cfg, attn_mask=None):
     """Naive fp32 reference of the same math, for kernel-parity tests
-    (analog of tests/unit/modeling.py vs the fused CUDA layer)."""
+    (analog of tests/unit/modeling.py vs the fused CUDA layer). Forces
+    the jnp softmax path so it stays an independent oracle for the
+    flash kernel."""
     p32 = jax.tree_util.tree_map(lambda a: a.astype(jnp.float32), params)
     return layer_forward(p32, x.astype(jnp.float32), cfg,
-                         attn_mask=attn_mask, deterministic=True)
+                         attn_mask=attn_mask, deterministic=True,
+                         allow_flash=False)

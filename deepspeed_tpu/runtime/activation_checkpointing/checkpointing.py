@@ -1,0 +1,151 @@
+"""Activation checkpointing (rematerialization) API.
+
+Capability match for the reference's activation checkpointing module
+(ref: deepspeed/runtime/activation_checkpointing/checkpointing.py —
+``configure`` :708, ``checkpoint`` :693, ``CheckpointFunction`` :405,
+``is_configured`` :738). The reference re-implements torch's checkpoint
+with partitioned/contiguous/CPU-offloaded activation storage and manual
+RNG bookkeeping; under XLA all of that collapses into ``jax.checkpoint``
+with a *policy*:
+
+* default                      → save nothing, recompute all
+  (``nothing_saveable`` — max memory saving, the reference default)
+* ``partition_activations``    → saved residuals keep their sharded
+  layout automatically under pjit (XLA never gathers a value just to
+  save it), so this is a no-op we accept for API parity
+* ``cpu_checkpointing``        → offload saved residuals to pinned host
+  memory (``save_and_offload_only_these_names`` over values tagged with
+  :func:`checkpoint_name`)
+* ``number_checkpoints``       → informational (the scan-over-layers
+  models remat per layer, the same N-segment behavior)
+* RNG state                    → jax PRNG keys are values, not global
+  state; replay is exact by construction (the reference's
+  CudaRNGStatesTracker :189 dissolves)
+
+``checkpoint(fn, *args)`` and the ``CheckpointFunction`` alias mirror
+the reference call sites, so porting a model is mechanical.
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+
+from deepspeed_tpu.utils.logging import log_dist
+
+# re-export: models tag offloadable activations with
+# jax.ad_checkpoint.checkpoint_name(x, "name")
+from jax.ad_checkpoint import checkpoint_name  # noqa: F401
+
+_config = None
+
+
+class _ActCkptState:
+    def __init__(self, partition_activations=False, number_checkpoints=None,
+                 contiguous_checkpointing=False, checkpoint_in_cpu=False,
+                 synchronize=False, profile=False,
+                 offload_names=("act",)):
+        self.partition_activations = partition_activations
+        self.number_checkpoints = number_checkpoints
+        self.contiguous_checkpointing = contiguous_checkpointing
+        self.checkpoint_in_cpu = checkpoint_in_cpu
+        self.synchronize = synchronize
+        self.profile = profile
+        self.offload_names = tuple(offload_names)
+
+    def policy(self):
+        if self.checkpoint_in_cpu:
+            return jax.checkpoint_policies.save_and_offload_only_these_names(
+                names_which_can_be_saved=[],
+                names_which_can_be_offloaded=list(self.offload_names),
+                offload_src="device", offload_dst="pinned_host")
+        return jax.checkpoint_policies.nothing_saveable
+
+
+def configure(mpu_=None,
+              deepspeed_config=None,
+              partition_activations: Optional[bool] = None,
+              contiguous_checkpointing: Optional[bool] = None,
+              checkpoint_in_cpu: Optional[bool] = None,
+              synchronize: Optional[bool] = None,
+              profile: Optional[bool] = None,
+              num_checkpoints: Optional[int] = None,
+              offload_names=("act",)) -> None:
+    """(ref: checkpointing.py:708) explicit args override the
+    ``activation_checkpointing`` section of ``deepspeed_config`` (a
+    DeepSpeedConfig or dict)."""
+    global _config
+    del mpu_  # mesh axes replace the mpu (API parity)
+    base = _ActCkptState(offload_names=offload_names)
+    if deepspeed_config is not None:
+        ac = deepspeed_config
+        if hasattr(ac, "activation_checkpointing"):
+            ac = ac.activation_checkpointing
+        elif isinstance(ac, dict):
+            from deepspeed_tpu.runtime.config import (
+                ActivationCheckpointingConfig)
+            ac = ActivationCheckpointingConfig.from_dict(
+                ac.get("activation_checkpointing"))
+        base.partition_activations = ac.partition_activations
+        base.number_checkpoints = ac.number_checkpoints
+        base.contiguous_checkpointing = ac.contiguous_memory_optimization
+        base.checkpoint_in_cpu = ac.cpu_checkpointing
+        base.synchronize = ac.synchronize_checkpoint_boundary
+        base.profile = ac.profile
+    for name, val in (("partition_activations", partition_activations),
+                      ("contiguous_checkpointing", contiguous_checkpointing),
+                      ("checkpoint_in_cpu", checkpoint_in_cpu),
+                      ("synchronize", synchronize),
+                      ("profile", profile),
+                      ("number_checkpoints", num_checkpoints)):
+        if val is not None:
+            setattr(base, name, val)
+    _config = base
+    log_dist(
+        f"activation checkpointing configured: cpu_offload="
+        f"{base.checkpoint_in_cpu}, partition={base.partition_activations}",
+        ranks=[0])
+
+
+def is_configured() -> bool:
+    """(ref: checkpointing.py:738)"""
+    return _config is not None
+
+
+def reset() -> None:
+    """(ref: checkpointing.py:745 reset of buffers) clears the global
+    config; jax frees remat buffers automatically."""
+    global _config
+    _config = None
+
+
+def current_policy():
+    return (_config or _ActCkptState()).policy()
+
+
+def checkpoint(function: Callable, *args) -> Any:
+    """Recompute-in-backward apply (ref: checkpointing.py:693
+    ``checkpoint(function, *args)``)."""
+    return jax.checkpoint(function, policy=current_policy())(*args)
+
+
+def checkpoint_wrapper(function: Callable) -> Callable:
+    """Decorator form for scan bodies / blocks. The policy is read at
+    CALL time, so configure() after wrapping still applies."""
+    def wrapped(*args, **kwargs):
+        return jax.checkpoint(
+            function, policy=current_policy())(*args, **kwargs)
+    return wrapped
+
+
+# reference-name alias: torch autograd.Function dissolves into the
+# functional transform
+CheckpointFunction = checkpoint
+
+
+def model_parallel_cuda_manual_seed(seed: int):  # pragma: no cover
+    """API parity shim (ref: checkpointing.py:282): jax PRNG keys are
+    explicit values; fold the TP axis index into the key instead."""
+    raise RuntimeError(
+        "jax PRNG keys are explicit — use "
+        "jax.random.fold_in(key, axis_index) inside shard_map rather "
+        "than global per-device RNG state.")

@@ -211,6 +211,14 @@ class DeepSpeedEngine:
             scale_state=scale_state,
             rng=rng)
 
+        # --- metrics monitor (ref: engine.py:470-517 tensorboard) -----
+        if config.tensorboard.enabled:
+            from deepspeed_tpu.utils.monitor import Monitor
+            self.monitor = Monitor.from_config(config.tensorboard)
+        else:
+            from deepspeed_tpu.utils.monitor import NoopMonitor
+            self.monitor = NoopMonitor()
+
         # --- timers ---------------------------------------------------
         self.wall_clock_breakdown = config.wall_clock_breakdown
         self.timers = SynchronizedWallClockTimer() if self.wall_clock_breakdown \
@@ -684,6 +692,17 @@ class DeepSpeedEngine:
         self.global_samples += self.config.train_batch_size
         if bool(metrics["overflow"]):
             self.skipped_steps += 1
+        if self.monitor.enabled:
+            # scalar names mirror the reference's tensorboard tags
+            # (ref: engine.py:1656-1666, :1889-1917)
+            self.monitor.write_scalars([
+                ("Train/Samples/train_loss", float(metrics["loss"]),
+                 self.global_samples),
+                ("Train/Samples/lr", float(metrics["lr"]),
+                 self.global_samples),
+                ("Train/Samples/loss_scale", float(metrics["loss_scale"]),
+                 self.global_samples),
+            ])
         if self.global_steps % self.config.steps_per_print == 0:
             self._report_progress(metrics)
         return metrics
@@ -796,6 +815,10 @@ class DeepSpeedEngine:
             block_eigenvalue=self.block_eigenvalue)
         if switched:
             self._train_step = self._build_train_step(self._donate_state)
+
+    def destroy(self) -> None:
+        """Flush and release engine-owned sinks (monitor/TB writer)."""
+        self.monitor.close()
 
     # familiarity wrappers --------------------------------------------
     def __call__(self, batch):

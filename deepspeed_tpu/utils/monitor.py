@@ -1,0 +1,126 @@
+"""Training metrics monitor — TensorBoard scalars analog.
+
+Capability match for the reference's engine-owned SummaryWriter
+(ref: deepspeed/runtime/engine.py:470-517 _get_tensorboard_summary_writer,
+loss/lr/loss-scale scalars :1656-1666, :1889-1917). Writes through
+every available backend:
+
+* TensorBoard event files when a writer implementation is importable
+  (torch.utils.tensorboard or tensorboardX — optional in this image),
+* always a CSV + JSONL mirror (self-contained, greppable, and what
+  bench tooling parses), matching the reference's later csv_monitor.
+
+Rank-0-only, like the reference.
+"""
+
+import atexit
+import csv
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def _try_tensorboard_writer(log_dir: str):
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+        return SummaryWriter(log_dir=log_dir)
+    except Exception:
+        pass
+    try:
+        from tensorboardX import SummaryWriter
+        return SummaryWriter(log_dir=log_dir)
+    except Exception:
+        return None
+
+
+class Monitor:
+    """scalar sink: ``write_scalars([(tag, value, step), ...])``."""
+
+    def __init__(self, output_path: str = "runs",
+                 job_name: str = "deepspeed_tpu",
+                 enabled: bool = True, rank: Optional[int] = None):
+        if rank is None:
+            try:
+                import jax
+                rank = jax.process_index()
+            except Exception:
+                rank = 0
+        self.enabled = enabled and rank == 0
+        self.log_dir = os.path.join(os.path.expanduser(output_path), job_name)
+        self._tb = None
+        self._csv_path = None
+        self._jsonl_path = None
+        self._csv_known_tags: List[str] = []
+        if self.enabled:
+            os.makedirs(self.log_dir, exist_ok=True)
+            self._tb = _try_tensorboard_writer(self.log_dir)
+            if self._tb is None:
+                logger.info("no tensorboard writer available; "
+                            "scalars go to csv/jsonl only")
+            self._csv_path = os.path.join(self.log_dir, "scalars.csv")
+            self._jsonl_path = os.path.join(self.log_dir, "scalars.jsonl")
+            # resume: adopt the existing header so appends don't inject
+            # a second header row mid-file
+            if os.path.exists(self._csv_path):
+                with open(self._csv_path) as f:
+                    first = f.readline().strip()
+                if first.startswith("step,"):
+                    self._csv_known_tags = first.split(",")[1:]
+            # TB writers buffer; make sure the tail is flushed on exit
+            atexit.register(self.close)
+
+    @classmethod
+    def from_config(cls, tb_config) -> "Monitor":
+        """tb_config: TensorboardConfig (runtime/config.py)."""
+        return cls(output_path=tb_config.output_path or "runs",
+                   job_name=tb_config.job_name,
+                   enabled=tb_config.enabled)
+
+    def write_scalars(self,
+                      scalars: List[Tuple[str, float, int]]) -> None:
+        if not self.enabled or not scalars:
+            return
+        if self._tb is not None:
+            for tag, value, step in scalars:
+                self._tb.add_scalar(tag, float(value), int(step))
+        with open(self._jsonl_path, "a") as f:
+            for tag, value, step in scalars:
+                f.write(json.dumps({"tag": tag, "value": float(value),
+                                    "step": int(step)}) + "\n")
+        self._write_csv_row(scalars)
+
+    def _write_csv_row(self, scalars) -> None:
+        tags = [t for t, _, _ in scalars]
+        step = scalars[0][2]
+        new_header = tags != self._csv_known_tags or \
+            not os.path.exists(self._csv_path)
+        with open(self._csv_path, "a", newline="") as f:
+            w = csv.writer(f)
+            if new_header:
+                w.writerow(["step"] + tags)
+                self._csv_known_tags = list(tags)
+            w.writerow([step] + [float(v) for _, v, _ in scalars])
+
+    def flush(self) -> None:
+        if self._tb is not None:
+            self._tb.flush()
+
+    def close(self) -> None:
+        if self._tb is not None:
+            self._tb.close()
+            self._tb = None
+
+
+class NoopMonitor:
+    enabled = False
+
+    def write_scalars(self, scalars) -> None:
+        pass
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass

@@ -1,0 +1,181 @@
+"""Activation-checkpointing API, metrics monitor, aio perf sweep
+(ref: tests/unit/test_activation_checkpointing.py:290 — checkpoint()
+must reproduce the non-checkpointed forward/grads exactly)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu import checkpointing
+from deepspeed_tpu.utils.monitor import Monitor, NoopMonitor
+from tests.simple_model import random_batch, simple_model_loss, simple_model_params
+
+
+@pytest.fixture(autouse=True)
+def _reset_ckpt_config():
+    yield
+    checkpointing.reset()
+
+
+# ------------------------------------------------ activation checkpointing
+
+def test_configure_and_is_configured():
+    assert not checkpointing.is_configured()
+    checkpointing.configure(partition_activations=True, num_checkpoints=4)
+    assert checkpointing.is_configured()
+    checkpointing.reset()
+    assert not checkpointing.is_configured()
+
+
+def test_configure_from_ds_config_dict():
+    checkpointing.configure(deepspeed_config={
+        "activation_checkpointing": {"cpu_checkpointing": True,
+                                     "number_checkpoints": 2}})
+    assert checkpointing._config.checkpoint_in_cpu
+    assert checkpointing._config.number_checkpoints == 2
+
+
+def test_checkpoint_matches_plain_forward_and_grads(rng):
+    """checkpoint(fn) must be bit-identical in value and gradient
+    (ref: test_activation_checkpointing.py _test_activation_checkpoint)."""
+    w1 = jnp.asarray(rng.standard_normal((16, 32)), jnp.float32)
+    w2 = jnp.asarray(rng.standard_normal((32, 8)), jnp.float32)
+    x = jnp.asarray(rng.standard_normal((4, 16)), jnp.float32)
+
+    def block(x, w1, w2):
+        return jnp.tanh(jnp.tanh(x @ w1) @ w2)
+
+    def loss_plain(w1, w2):
+        return jnp.sum(block(x, w1, w2) ** 2)
+
+    def loss_ckpt(w1, w2):
+        return jnp.sum(checkpointing.checkpoint(block, x, w1, w2) ** 2)
+
+    checkpointing.configure()  # default: nothing_saveable
+    np.testing.assert_allclose(np.asarray(loss_plain(w1, w2)),
+                               np.asarray(loss_ckpt(w1, w2)))
+    g_plain = jax.grad(loss_plain)(w1, w2)
+    g_ckpt = jax.grad(loss_ckpt)(w1, w2)
+    np.testing.assert_allclose(np.asarray(g_plain), np.asarray(g_ckpt),
+                               rtol=1e-6)
+
+
+def test_checkpoint_wrapper_under_jit(rng):
+    checkpointing.configure()
+    w = jnp.asarray(rng.standard_normal((8, 8)), jnp.float32)
+
+    @jax.jit
+    def f(w):
+        blk = checkpointing.checkpoint_wrapper(lambda a: jnp.sin(a @ a.T))
+        return jnp.sum(blk(w))
+
+    assert np.isfinite(float(f(w)))
+
+
+def test_cpu_offload_policy_with_named_activation(rng):
+    """cpu_checkpointing: values tagged checkpoint_name are offloaded to
+    pinned host, grads still exact."""
+    checkpointing.configure(checkpoint_in_cpu=True, offload_names=("act",))
+
+    w = jnp.asarray(rng.standard_normal((16, 16)), jnp.float32)
+    x = jnp.asarray(rng.standard_normal((4, 16)), jnp.float32)
+
+    def block(x, w):
+        h = checkpointing.checkpoint_name(jnp.tanh(x @ w), "act")
+        return jnp.sum((h @ w) ** 2)
+
+    def loss(w):
+        return checkpointing.checkpoint(block, x, w)
+
+    g = jax.grad(loss)(w)
+    g_ref = jax.grad(lambda w: jnp.sum((jnp.tanh(x @ w) @ w) ** 2))(w)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), rtol=1e-5)
+
+
+def test_manual_seed_shim_raises():
+    with pytest.raises(RuntimeError, match="fold_in"):
+        checkpointing.model_parallel_cuda_manual_seed(0)
+
+
+# --------------------------------------------------------------- monitor
+
+def test_monitor_writes_csv_jsonl(tmp_path):
+    mon = Monitor(output_path=str(tmp_path), job_name="job", rank=0)
+    mon.write_scalars([("Train/loss", 1.5, 10), ("Train/lr", 0.1, 10)])
+    mon.write_scalars([("Train/loss", 1.2, 20), ("Train/lr", 0.1, 20)])
+    mon.close()
+    jsonl = (tmp_path / "job" / "scalars.jsonl").read_text().splitlines()
+    assert len(jsonl) == 4
+    assert json.loads(jsonl[0]) == {"tag": "Train/loss", "value": 1.5,
+                                    "step": 10}
+    csv_lines = (tmp_path / "job" / "scalars.csv").read_text().splitlines()
+    assert csv_lines[0] == "step,Train/loss,Train/lr"
+    assert len(csv_lines) == 3  # header + 2 rows
+
+
+def test_monitor_resume_no_duplicate_header(tmp_path):
+    """A restarted job appending to the same scalars.csv must not inject
+    a second header row mid-file."""
+    m1 = Monitor(output_path=str(tmp_path), job_name="job", rank=0)
+    m1.write_scalars([("loss", 1.0, 1)])
+    m1.close()
+    m2 = Monitor(output_path=str(tmp_path), job_name="job", rank=0)
+    m2.write_scalars([("loss", 0.5, 2)])
+    m2.close()
+    lines = (tmp_path / "job" / "scalars.csv").read_text().splitlines()
+    assert lines[0] == "step,loss"
+    assert sum(1 for ln in lines if ln.startswith("step,")) == 1
+    assert len(lines) == 3
+
+
+def test_monitor_nonzero_rank_disabled(tmp_path):
+    mon = Monitor(output_path=str(tmp_path), job_name="job", rank=1)
+    mon.write_scalars([("x", 1.0, 0)])
+    assert not (tmp_path / "job").exists() or \
+        not os.listdir(tmp_path / "job")
+
+
+def test_engine_monitor_integration(tmp_path, devices):
+    params = simple_model_params(hidden_dim=16)
+    cfg = {"train_batch_size": 8,
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+           "steps_per_print": 1000,
+           "tensorboard": {"enabled": True,
+                           "output_path": str(tmp_path / "runs"),
+                           "job_name": "t"}}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_model_loss, model_parameters=params, config=cfg)
+    for i in range(3):
+        engine.train_batch(random_batch(8, 16, seed=i))
+    jsonl = (tmp_path / "runs" / "t" / "scalars.jsonl").read_text()
+    assert jsonl.count("Train/Samples/train_loss") == 3
+    assert "Train/Samples/lr" in jsonl
+
+
+def test_noop_monitor():
+    m = NoopMonitor()
+    m.write_scalars([("a", 1, 1)])
+    m.flush()
+    m.close()
+
+
+# ---------------------------------------------------------- aio sweep
+
+def test_aio_perf_sweep(tmp_path):
+    from deepspeed_tpu.ops.aio.perf_sweep import best_aio_config, sweep
+    # tmpfs has no O_DIRECT; real runs keep use_direct=True
+    records = sweep(str(tmp_path), io_mb=1, use_direct=False,
+                    space={"block_size": [128 * 1024],
+                           "queue_depth": [4], "thread_count": [1, 2],
+                           "op": ["read", "write"]})
+    assert len(records) == 4
+    ok = [r for r in records if r["gbps"]]
+    assert ok, records  # tmpfs: all should succeed
+    best = best_aio_config(records)
+    assert best["block_size"] == 128 * 1024
+    assert "queue_depth" in best
