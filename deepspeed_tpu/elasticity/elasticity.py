@@ -249,6 +249,14 @@ def compute_elastic_config(ds_config: Dict, target_deepspeed_version: str,
             if final_batch_size // world_size % mb == 0:
                 micro = mb
                 break
+        if micro is None:
+            # surfacing it here beats a silent None propagating into the
+            # batch-triple reconciliation (ref: elasticity.py:378 asserts
+            # micro_batch is not None)
+            raise ElasticityError(
+                f"no micro batch from {elastic_config.micro_batches} divides "
+                f"per-chip batch {final_batch_size // world_size} at world "
+                f"size {world_size}")
         return final_batch_size, valid_gpus, micro
 
     return final_batch_size, valid_gpus

@@ -50,23 +50,54 @@ def _split_heads(t, B, S, H, Dh):
     return t.reshape(B, S, H, Dh)
 
 
+def _mlp(h, p):
+    h = h @ p["mlp_in"]["kernel"].astype(h.dtype) + p["mlp_in"]["bias"].astype(h.dtype)
+    h = jax.nn.gelu(h, approximate=True)
+    return h @ p["mlp_out"]["kernel"].astype(h.dtype) + \
+        p["mlp_out"]["bias"].astype(h.dtype)
+
+
 def _block_prefill(x, p, cfg: GPTConfig):
-    """Forward one block over the full prompt, returning (y, k, v)."""
+    """Forward one block over the full prompt, returning (y, k, v).
+
+    The cached k/v are post-rotary so decode never re-rotates history."""
     B, S, D = x.shape
     H, Dh = cfg.n_heads, cfg.head_dim
     h = _layernorm(x, p["ln1"]["scale"], p["ln1"]["bias"])
     qkv = h @ p["qkv"]["kernel"].astype(h.dtype) + p["qkv"]["bias"].astype(h.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q, k, v = (_split_heads(t, B, S, H, Dh) for t in (q, k, v))
+    if cfg.rotary_dim:
+        from deepspeed_tpu.ops.attention.rotary import apply_rotary
+        q, k = apply_rotary(q, k, jnp.arange(S), cfg.rotary_dim)
     attn = gpt_lib._attention(q, k, v, cfg).reshape(B, S, D)
     attn = attn @ p["attn_out"]["kernel"].astype(attn.dtype) + \
         p["attn_out"]["bias"].astype(attn.dtype)
+    if cfg.parallel_residual:
+        return x + attn + _ffn(h, p, cfg), k, v
     x = x + attn
     h = _layernorm(x, p["ln2"]["scale"], p["ln2"]["bias"])
-    h = h @ p["mlp_in"]["kernel"].astype(h.dtype) + p["mlp_in"]["bias"].astype(h.dtype)
-    h = jax.nn.gelu(h, approximate=True)
-    h = h @ p["mlp_out"]["kernel"].astype(h.dtype) + p["mlp_out"]["bias"].astype(h.dtype)
-    return x + h, k, v
+    return x + _ffn(h, p, cfg), k, v
+
+
+def _ffn(h, p, cfg):
+    """Dense MLP or MoE FFN for one block (ref MoE inference path:
+    ops/transformer/inference/moe_inference.py). MoE runs the same GShard
+    top-k dispatch as training, in eval mode (no jitter, aux discarded)."""
+    if "moe" not in p:
+        return _mlp(h, p)
+    from deepspeed_tpu.moe.experts import ffn_expert_fn
+    from deepspeed_tpu.moe.sharded_moe import TopKGate, moe_layer_apply
+    gate = TopKGate(k=getattr(cfg, "moe_k", 1),
+                    capacity_factor=getattr(cfg, "eval_capacity_factor",
+                                            getattr(cfg, "capacity_factor",
+                                                    1.25)),
+                    min_capacity=getattr(cfg, "min_capacity", 4),
+                    noisy_gate_policy=None)
+    y, _aux, _counts = moe_layer_apply(
+        gate, p["moe"]["gate"], p["moe"]["experts"], ffn_expert_fn,
+        h, jax.random.PRNGKey(0), train=False)
+    return y
 
 
 def _block_decode(x, k_cache, v_cache, pos, p, cfg: GPTConfig):
@@ -80,6 +111,12 @@ def _block_decode(x, k_cache, v_cache, pos, p, cfg: GPTConfig):
     h = _layernorm(x, p["ln1"]["scale"], p["ln1"]["bias"])
     qkv = h @ p["qkv"]["kernel"].astype(h.dtype) + p["qkv"]["bias"].astype(h.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
+    if cfg.rotary_dim:
+        from deepspeed_tpu.ops.attention.rotary import apply_rotary
+        q, k = apply_rotary(q.reshape(B, 1, H, Dh), k.reshape(B, 1, H, Dh),
+                            pos[None], cfg.rotary_dim)
+        q = q.reshape(B, 1, H, Dh)
+        k = k.reshape(B, 1, H, Dh)
     q = q.reshape(B, H, Dh)
     k_cache = jax.lax.dynamic_update_slice_in_dim(
         k_cache, k.reshape(B, 1, H, Dh), pos, axis=1)
@@ -87,20 +124,19 @@ def _block_decode(x, k_cache, v_cache, pos, p, cfg: GPTConfig):
         v_cache, v.reshape(B, 1, H, Dh), pos, axis=1)
 
     scores = jnp.einsum("bhd,bshd->bhs", q, k_cache).astype(jnp.float32)
-    scores *= 1.0 / np.sqrt(Dh)
+    scores *= cfg.attn_scale if cfg.attn_scale is not None \
+        else 1.0 / np.sqrt(Dh)
     idx = jax.lax.broadcasted_iota(jnp.int32, (1, 1, S_max), 2)
     scores = jnp.where(idx <= pos, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
     attn = jnp.einsum("bhs,bshd->bhd", probs, v_cache).reshape(B, 1, D)
     attn = attn @ p["attn_out"]["kernel"].astype(attn.dtype) + \
         p["attn_out"]["bias"].astype(attn.dtype)
+    if cfg.parallel_residual:
+        return x + attn + _ffn(h, p, cfg), k_cache, v_cache
     x = x + attn
-
     h = _layernorm(x, p["ln2"]["scale"], p["ln2"]["bias"])
-    h = h @ p["mlp_in"]["kernel"].astype(h.dtype) + p["mlp_in"]["bias"].astype(h.dtype)
-    h = jax.nn.gelu(h, approximate=True)
-    h = h @ p["mlp_out"]["kernel"].astype(h.dtype) + p["mlp_out"]["bias"].astype(h.dtype)
-    return x + h, k_cache, v_cache
+    return x + _ffn(h, p, cfg), k_cache, v_cache
 
 
 class InferenceEngine:
@@ -143,37 +179,59 @@ class InferenceEngine:
                 mesh_lib.MeshSpec(data=n // mp_size, model=mp_size))
         self.mesh = mesh
 
+        from deepspeed_tpu.models.bert import BertConfig as _BertConfig
+        self.is_encoder = isinstance(config, _BertConfig)
+        if self.is_encoder and config.dtype != dtype:
+            # bert.encode casts by cfg.dtype; keep it in the engine dtype
+            import dataclasses
+            self.cfg = config = dataclasses.replace(config, dtype=dtype)
+
         # dtype conversion (ref: engine.py:335 _convert_to_dtype) + TP placement
         params = jax.tree_util.tree_map(
             lambda x: jnp.asarray(x, dtype) if jnp.issubdtype(
                 jnp.asarray(x).dtype, jnp.floating) else jnp.asarray(x),
             params)
-        rules = gpt_lib.gpt_partition_rules() if mp_size > 1 else []
+        if mp_size > 1:
+            from deepspeed_tpu.models.bert import bert_partition_rules
+            rules = bert_partition_rules() if self.is_encoder \
+                else gpt_lib.gpt_partition_rules()
+        else:
+            rules = []
         pspecs = sharding_lib.param_specs(params, mesh, zero_stage=0,
                                           rules=rules)
         self.params = jax.device_put(
             params, sharding_lib.to_named(pspecs, mesh))
 
-        self._prefill = jax.jit(self._prefill_fn)
-        self._decode = jax.jit(self._decode_fn, donate_argnums=(1,))
-        self._forward = jax.jit(self._forward_fn)
+        if self.is_encoder:
+            self._forward = jax.jit(self._encoder_forward_fn)
+            self._prefill = self._decode = None
+        else:
+            self._prefill = jax.jit(self._prefill_fn)
+            self._decode = jax.jit(self._decode_fn, donate_argnums=(1,))
+            self._forward = jax.jit(self._forward_fn)
         log_dist(f"inference engine: {config.n_layers}L/{config.d_model}d "
-                 f"mp={mp_size} dtype={jnp.dtype(dtype).name}", ranks=[0])
+                 f"mp={mp_size} dtype={jnp.dtype(dtype).name} "
+                 f"{'encoder' if self.is_encoder else 'decoder'}",
+                 ranks=[0])
 
     # ------------------------------------------------------------------
     # params are threaded explicitly (never via self) so jit treats the
     # weights as arguments, not baked-in constants
     def _embed(self, params, tokens):
         S = tokens.shape[1]
-        wte = params["wte"]["embedding"]
-        wpe = params["wpe"]["embedding"]
-        return wte[tokens] + wpe[:S][None]
+        x = params["wte"]["embedding"][tokens]
+        if self.cfg.use_wpe:
+            x = x + params["wpe"]["embedding"][:S][None]
+        return x
 
     def _logits(self, params, x):
         x = _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
         if self.cfg.tie_embeddings:
             return x @ params["wte"]["embedding"].T
-        return x @ params["lm_head"]["kernel"]
+        logits = x @ params["lm_head"]["kernel"]
+        if "bias" in params["lm_head"]:
+            logits = logits + params["lm_head"]["bias"]
+        return logits
 
     def _prefill_fn(self, params, tokens):
         """Run the prompt, build the cache, return last-position logits."""
@@ -197,9 +255,10 @@ class InferenceEngine:
     def _decode_fn(self, params, cache, token, pos):
         """One token step. token: [B, 1]; pos: scalar int."""
         cfg = self.cfg
-        wte = params["wte"]["embedding"]
-        wpe = params["wpe"]["embedding"]
-        x = wte[token] + jax.lax.dynamic_slice_in_dim(wpe, pos, 1)[None]
+        x = params["wte"]["embedding"][token]
+        if cfg.use_wpe:
+            x = x + jax.lax.dynamic_slice_in_dim(
+                params["wpe"]["embedding"], pos, 1)[None]
 
         def body(x, layer):
             layer_p, kc, vc = layer
@@ -217,6 +276,24 @@ class InferenceEngine:
             lambda c, l: (_block_prefill(c, l, self.cfg)[0], None),
             x, params["block"])
         return self._logits(params, x)
+
+    def _encoder_forward_fn(self, params, tokens):
+        """BERT-family path: encoder hidden states, or MLM logits when the
+        converted checkpoint ships the prediction head
+        (ref: HFBertLayerPolicy application, replace_module.py:123)."""
+        from deepspeed_tpu.models import bert as bert_lib
+        x = bert_lib.encode(params, tokens, self.cfg, deterministic=True)
+        if "mlm" not in params:
+            return x
+        dtype = x.dtype
+        h = x @ params["mlm"]["kernel"].astype(dtype) + \
+            params["mlm"]["bias"].astype(dtype)
+        h = jax.nn.gelu(h, approximate=True)
+        h = bert_lib._layernorm(h, params["mlm"]["ln"]["scale"].astype(dtype),
+                                params["mlm"]["ln"]["bias"].astype(dtype),
+                                self.cfg.layer_norm_eps)
+        return h @ params["embeddings"]["word"].astype(dtype).T + \
+            params["mlm"]["decoder_bias"].astype(dtype)
 
     # ------------------------------------------------------------------
     def forward(self, tokens) -> jnp.ndarray:
@@ -237,6 +314,10 @@ class InferenceEngine:
                  seed: int = 0) -> np.ndarray:
         """Greedy (temperature=0) or sampled generation."""
         import time
+        if self.is_encoder:
+            raise NotImplementedError(
+                "generate() needs a causal decoder; BERT-family models "
+                "support forward() only")
         tokens = jnp.asarray(tokens, jnp.int32)
         B, S = tokens.shape
         assert S + max_new_tokens <= self.max_seq_len

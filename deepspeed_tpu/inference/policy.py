@@ -89,14 +89,243 @@ class HFGPT2Policy:
         return cfg, params
 
 
-@register_policy("gpt_tuple")
-class NativePolicy:
-    """Our own (GPTConfig, params) tuples."""
+@register_policy("hf_gpt_neo")
+class HFGPTNeoPolicy:
+    """HuggingFace GPT-Neo -> fused GPT layout
+    (ref: HFGPTNEOLayerPolicy, replace_policy.py:112). GPT-Neo uses
+    separate unbiased q/k/v projections and UNSCALED attention."""
 
     @staticmethod
     def matches(model) -> bool:
-        return (isinstance(model, tuple) and len(model) == 2 and
-                isinstance(model[0], GPTConfig))
+        return type(model).__name__ in ("GPTNeoForCausalLM", "GPTNeoModel")
+
+    @staticmethod
+    def convert(model) -> Tuple[GPTConfig, Dict]:
+        import jax.numpy as jnp
+        hf_cfg = model.config
+        if any(t == "local" for t in getattr(hf_cfg, "attention_layers", [])):
+            logger.warning(
+                "GPT-Neo local (windowed) attention layers are converted as "
+                "global attention; outputs will differ on those layers")
+        cfg = GPTConfig(
+            vocab_size=hf_cfg.vocab_size,
+            n_layers=hf_cfg.num_layers,
+            n_heads=hf_cfg.num_heads,
+            d_model=hf_cfg.hidden_size,
+            max_seq_len=hf_cfg.max_position_embeddings,
+            tie_embeddings=True,
+            attn_scale=1.0)   # GPT-Neo does not scale attention logits
+        sd = {k: v.detach().cpu().numpy() for k, v in model.state_dict().items()}
+        pre = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+        d = cfg.d_model
+
+        def lin(fmt):
+            """nn.Linear [out, in] -> [in, out], stacked over layers."""
+            return np.stack([sd[pre + fmt.format(i)].T
+                             for i in range(cfg.n_layers)])
+
+        def vec(fmt):
+            return np.stack([sd[pre + fmt.format(i)]
+                             for i in range(cfg.n_layers)])
+
+        qkv = np.concatenate(
+            [lin("h.{}.attn.attention.q_proj.weight"),
+             lin("h.{}.attn.attention.k_proj.weight"),
+             lin("h.{}.attn.attention.v_proj.weight")], axis=-1)
+        params = {
+            "wte": {"embedding": jnp.asarray(sd[pre + "wte.weight"])},
+            "wpe": {"embedding": jnp.asarray(sd[pre + "wpe.weight"])},
+            "block": {
+                "ln1": {"scale": jnp.asarray(vec("h.{}.ln_1.weight")),
+                        "bias": jnp.asarray(vec("h.{}.ln_1.bias"))},
+                "qkv": {"kernel": jnp.asarray(qkv),
+                        "bias": jnp.zeros((cfg.n_layers, 3 * d), jnp.float32)},
+                "attn_out": {
+                    "kernel": jnp.asarray(
+                        lin("h.{}.attn.attention.out_proj.weight")),
+                    "bias": jnp.asarray(
+                        vec("h.{}.attn.attention.out_proj.bias"))},
+                "ln2": {"scale": jnp.asarray(vec("h.{}.ln_2.weight")),
+                        "bias": jnp.asarray(vec("h.{}.ln_2.bias"))},
+                "mlp_in": {"kernel": jnp.asarray(lin("h.{}.mlp.c_fc.weight")),
+                           "bias": jnp.asarray(vec("h.{}.mlp.c_fc.bias"))},
+                "mlp_out": {"kernel": jnp.asarray(lin("h.{}.mlp.c_proj.weight")),
+                            "bias": jnp.asarray(vec("h.{}.mlp.c_proj.bias"))},
+            },
+            "ln_f": {"scale": jnp.asarray(sd[pre + "ln_f.weight"]),
+                     "bias": jnp.asarray(sd[pre + "ln_f.bias"])},
+        }
+        logger.info(f"injected HF GPT-Neo: {cfg.n_layers}L/{cfg.d_model}d")
+        return cfg, params
+
+
+@register_policy("hf_gptj")
+class HFGPTJPolicy:
+    """HuggingFace GPT-J -> fused GPT layout
+    (ref: HFGPTJLayerPolicy, replace_policy.py:157). GPT-J: rotary
+    positions, parallel attn/MLP residual, no learned positions, untied
+    biased lm_head."""
+
+    @staticmethod
+    def matches(model) -> bool:
+        return type(model).__name__ in ("GPTJForCausalLM", "GPTJModel")
+
+    @staticmethod
+    def convert(model) -> Tuple[GPTConfig, Dict]:
+        import jax.numpy as jnp
+        hf_cfg = model.config
+        cfg = GPTConfig(
+            vocab_size=hf_cfg.vocab_size,
+            n_layers=hf_cfg.n_layer,
+            n_heads=hf_cfg.n_head,
+            d_model=hf_cfg.n_embd,
+            max_seq_len=hf_cfg.n_positions,
+            tie_embeddings=False,
+            rotary_dim=hf_cfg.rotary_dim,
+            parallel_residual=True,
+            use_wpe=False)
+        sd = {k: v.detach().cpu().numpy() for k, v in model.state_dict().items()}
+        pre = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+        d = cfg.d_model
+        L = cfg.n_layers
+
+        def lin(fmt):
+            return np.stack([sd[pre + fmt.format(i)].T for i in range(L)])
+
+        def vec(fmt):
+            return np.stack([sd[pre + fmt.format(i)] for i in range(L)])
+
+        qkv = np.concatenate([lin("h.{}.attn.q_proj.weight"),
+                              lin("h.{}.attn.k_proj.weight"),
+                              lin("h.{}.attn.v_proj.weight")], axis=-1)
+        params = {
+            "wte": {"embedding": jnp.asarray(sd[pre + "wte.weight"])},
+            "block": {
+                # ln_1 feeds both branches; ln2 is unused under
+                # parallel_residual but kept as identity for layout parity
+                "ln1": {"scale": jnp.asarray(vec("h.{}.ln_1.weight")),
+                        "bias": jnp.asarray(vec("h.{}.ln_1.bias"))},
+                "qkv": {"kernel": jnp.asarray(qkv),
+                        "bias": jnp.zeros((L, 3 * d), jnp.float32)},
+                "attn_out": {"kernel": jnp.asarray(lin("h.{}.attn.out_proj.weight")),
+                             "bias": jnp.zeros((L, d), jnp.float32)},
+                "ln2": {"scale": jnp.ones((L, d), jnp.float32),
+                        "bias": jnp.zeros((L, d), jnp.float32)},
+                "mlp_in": {"kernel": jnp.asarray(lin("h.{}.mlp.fc_in.weight")),
+                           "bias": jnp.asarray(vec("h.{}.mlp.fc_in.bias"))},
+                "mlp_out": {"kernel": jnp.asarray(lin("h.{}.mlp.fc_out.weight")),
+                            "bias": jnp.asarray(vec("h.{}.mlp.fc_out.bias"))},
+            },
+            "ln_f": {"scale": jnp.asarray(sd[pre + "ln_f.weight"]),
+                     "bias": jnp.asarray(sd[pre + "ln_f.bias"])},
+            "lm_head": {"kernel": jnp.asarray(sd["lm_head.weight"].T),
+                        "bias": jnp.asarray(sd["lm_head.bias"])},
+        }
+        logger.info(f"injected HF GPT-J: {cfg.n_layers}L/{cfg.d_model}d "
+                    f"rotary_dim={cfg.rotary_dim}")
+        return cfg, params
+
+
+@register_policy("hf_bert")
+class HFBertPolicy:
+    """HuggingFace BERT -> fused encoder layout (models/bert.py)
+    (ref: HFBertLayerPolicy, replace_policy.py:49). Post-LN:
+    ln1 = attention.output.LayerNorm, ln2 = output.LayerNorm."""
+
+    @staticmethod
+    def matches(model) -> bool:
+        return type(model).__name__ in ("BertModel", "BertForMaskedLM",
+                                        "BertForPreTraining")
+
+    @staticmethod
+    def convert(model):
+        import jax.numpy as jnp
+        from deepspeed_tpu.models.bert import BertConfig
+        hf_cfg = model.config
+        cfg = BertConfig(
+            vocab_size=hf_cfg.vocab_size,
+            n_layers=hf_cfg.num_hidden_layers,
+            n_heads=hf_cfg.num_attention_heads,
+            d_model=hf_cfg.hidden_size,
+            max_seq_len=hf_cfg.max_position_embeddings,
+            type_vocab_size=hf_cfg.type_vocab_size,
+            layer_norm_eps=hf_cfg.layer_norm_eps,
+            pre_layer_norm=False)
+        sd = {k: v.detach().cpu().numpy() for k, v in model.state_dict().items()}
+        pre = "bert." if any(k.startswith("bert.") for k in sd) else ""
+        L, d = cfg.n_layers, cfg.d_model
+        enc = pre + "encoder.layer.{}."
+
+        def lin(fmt):
+            return np.stack([sd[(enc + fmt).format(i)].T for i in range(L)])
+
+        def vec(fmt):
+            return np.stack([sd[(enc + fmt).format(i)] for i in range(L)])
+
+        qkv_k = np.concatenate([lin("attention.self.query.weight"),
+                                lin("attention.self.key.weight"),
+                                lin("attention.self.value.weight")], axis=-1)
+        qkv_b = np.concatenate([vec("attention.self.query.bias"),
+                                vec("attention.self.key.bias"),
+                                vec("attention.self.value.bias")], axis=-1)
+        emb = pre + "embeddings."
+        params = {
+            "embeddings": {
+                "word": jnp.asarray(sd[emb + "word_embeddings.weight"]),
+                "position": jnp.asarray(sd[emb + "position_embeddings.weight"]),
+                "token_type": jnp.asarray(
+                    sd[emb + "token_type_embeddings.weight"]),
+                "ln": {"scale": jnp.asarray(sd[emb + "LayerNorm.weight"]),
+                       "bias": jnp.asarray(sd[emb + "LayerNorm.bias"])},
+            },
+            "block": {
+                "qkv": {"kernel": jnp.asarray(qkv_k),
+                        "bias": jnp.asarray(qkv_b)},
+                "attn_out": {
+                    "kernel": jnp.asarray(lin("attention.output.dense.weight")),
+                    "bias": jnp.asarray(vec("attention.output.dense.bias"))},
+                "ln1": {"scale": jnp.asarray(
+                            vec("attention.output.LayerNorm.weight")),
+                        "bias": jnp.asarray(
+                            vec("attention.output.LayerNorm.bias"))},
+                "mlp_in": {"kernel": jnp.asarray(lin("intermediate.dense.weight")),
+                           "bias": jnp.asarray(vec("intermediate.dense.bias"))},
+                "mlp_out": {"kernel": jnp.asarray(lin("output.dense.weight")),
+                            "bias": jnp.asarray(vec("output.dense.bias"))},
+                "ln2": {"scale": jnp.asarray(vec("output.LayerNorm.weight")),
+                        "bias": jnp.asarray(vec("output.LayerNorm.bias"))},
+            },
+        }
+        # optional heads
+        if pre + "pooler.dense.weight" in sd:
+            params["pooler"] = {
+                "kernel": jnp.asarray(sd[pre + "pooler.dense.weight"].T),
+                "bias": jnp.asarray(sd[pre + "pooler.dense.bias"])}
+        if "cls.predictions.transform.dense.weight" in sd:
+            params["mlm"] = {
+                "kernel": jnp.asarray(
+                    sd["cls.predictions.transform.dense.weight"].T),
+                "bias": jnp.asarray(sd["cls.predictions.transform.dense.bias"]),
+                "ln": {"scale": jnp.asarray(
+                           sd["cls.predictions.transform.LayerNorm.weight"]),
+                       "bias": jnp.asarray(
+                           sd["cls.predictions.transform.LayerNorm.bias"])},
+                "decoder_bias": jnp.asarray(sd["cls.predictions.bias"]),
+            }
+        logger.info(f"injected HF BERT: {cfg.n_layers}L/{cfg.d_model}d post-LN")
+        return cfg, params
+
+
+@register_policy("gpt_tuple")
+class NativePolicy:
+    """Our own (config, params) tuples — GPT (incl. MoE-GPT) or BERT."""
+
+    @staticmethod
+    def matches(model) -> bool:
+        if not (isinstance(model, tuple) and len(model) == 2):
+            return False
+        from deepspeed_tpu.models.bert import BertConfig
+        return isinstance(model[0], (GPTConfig, BertConfig))
 
     @staticmethod
     def convert(model):

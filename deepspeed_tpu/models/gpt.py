@@ -26,6 +26,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.parallel.sharding import PartitionRule
@@ -42,6 +43,10 @@ class GPTConfig:
     dropout: float = 0.0
     dtype: Any = jnp.bfloat16
     remat: bool = True                # activation checkpointing per layer
+    # 'full': recompute everything (nothing_saveable — min memory);
+    # 'selective': save matmul/attention outputs, recompute layernorm/gelu/
+    # elementwise only (~25% less recompute for ~8*d bytes/token/layer)
+    remat_policy: str = "selective"
     use_flash_attention: bool = True
     flash_block_q: int = 512
     flash_block_kv: int = 512
@@ -50,6 +55,14 @@ class GPTConfig:
     # mesh axis and run ring attention over ICI (set mesh too)
     sequence_parallel: bool = False
     mesh: Any = None
+    # --- architecture variants for foreign-checkpoint injection --------
+    # (ref: module_inject/replace_policy.py — GPT-Neo :112 uses unscaled
+    #  attention; GPT-J :157 uses rotary + parallel attn/MLP residual and
+    #  no learned positions)
+    attn_scale: Optional[float] = None     # None -> 1/sqrt(head_dim)
+    rotary_dim: Optional[int] = None       # GPT-J rotary channels (0/None=off)
+    parallel_residual: bool = False        # x + attn(h) + mlp(h), h=ln1(x)
+    use_wpe: bool = True                   # learned absolute positions
 
     @property
     def head_dim(self) -> int:
@@ -123,6 +136,25 @@ def init_params(rng: jax.Array, cfg: GPTConfig) -> Dict:
 # forward
 # ---------------------------------------------------------------------------
 
+def remat_policy(name: str):
+    """Checkpoint policy for the per-layer remat (analog of the reference's
+    activation-checkpointing variants, ref:
+    runtime/activation_checkpointing/checkpointing.py).
+
+    'selective' saves the tagged matmul/attention outputs (qkv, attn, mlp_pre
+    plus the flash kernel's out/lse residuals) so the backward pass only
+    recomputes layernorms, gelu and elementwise ops — the standard
+    save-dots/recompute-elementwise trade. 'full' recomputes the whole layer.
+    """
+    if name == "selective":
+        return jax.checkpoint_policies.save_only_these_names(
+            "qkv", "attn", "mlp_pre", "flash_out", "flash_lse")
+    if name == "full":
+        return jax.checkpoint_policies.nothing_saveable
+    raise ValueError(f"unknown remat_policy {name!r} "
+                     "(expected 'selective' or 'full')")
+
+
 def _layernorm(x, scale, bias, eps=1e-5):
     x32 = x.astype(jnp.float32)
     mu = jnp.mean(x32, axis=-1, keepdims=True)
@@ -149,16 +181,17 @@ def _flash_eligible(cfg: GPTConfig, seq_len: int) -> bool:
 
 def _attention(q, k, v, cfg: GPTConfig):
     """Causal multi-head attention. q,k,v: [B, S, H, Dh]."""
+    scale = cfg.attn_scale  # None -> kernels default to 1/sqrt(Dh)
     if cfg.sequence_parallel and cfg.mesh is not None:
         from deepspeed_tpu.ops.attention.ring import ring_attention
-        return ring_attention(q, k, v, cfg.mesh, causal=True)
+        return ring_attention(q, k, v, cfg.mesh, causal=True, scale=scale)
     if _flash_eligible(cfg, q.shape[1]):
         from deepspeed_tpu.ops.attention.flash import flash_attention
-        return flash_attention(q, k, v, causal=True,
+        return flash_attention(q, k, v, causal=True, scale=scale,
                                block_q=cfg.flash_block_q,
                                block_kv=cfg.flash_block_kv)
     from deepspeed_tpu.ops.attention.flash import mha_reference
-    return mha_reference(q, k, v, causal=True)
+    return mha_reference(q, k, v, causal=True, scale=scale)
 
 
 def _block(x, layer_params, cfg: GPTConfig, dropout_rng=None,
@@ -175,24 +208,39 @@ def _block(x, layer_params, cfg: GPTConfig, dropout_rng=None,
 
     h = _layernorm(x, p["ln1"]["scale"], p["ln1"]["bias"])
     qkv = h @ p["qkv"]["kernel"].astype(h.dtype) + p["qkv"]["bias"].astype(h.dtype)
+    qkv = checkpoint_name(qkv, "qkv")
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(B, S, H, Dh)
     k = k.reshape(B, S, H, Dh)
     v = v.reshape(B, S, H, Dh)
+    if cfg.rotary_dim:
+        from deepspeed_tpu.ops.attention.rotary import apply_rotary
+        q, k = apply_rotary(q, k, jnp.arange(S), cfg.rotary_dim)
     attn = _attention(q, k, v, cfg).reshape(B, S, D)
+    attn = checkpoint_name(attn, "attn")
     attn = attn @ p["attn_out"]["kernel"].astype(attn.dtype) + \
         p["attn_out"]["bias"].astype(attn.dtype)
     if not deterministic and cfg.dropout > 0:
         attn = _dropout(attn, cfg.dropout, dr_attn)
-    x = x + attn
 
-    h = _layernorm(x, p["ln2"]["scale"], p["ln2"]["bias"])
-    h = h @ p["mlp_in"]["kernel"].astype(h.dtype) + p["mlp_in"]["bias"].astype(h.dtype)
-    h = jax.nn.gelu(h, approximate=True)
-    h = h @ p["mlp_out"]["kernel"].astype(h.dtype) + p["mlp_out"]["bias"].astype(h.dtype)
+    # GPT-J style parallel residual: MLP reads the SAME ln1 output and
+    # both branches add to x (ref: HFGPTJLayerPolicy, replace_policy.py:157)
+    mlp_src = h if cfg.parallel_residual else None
+    if not cfg.parallel_residual:
+        x = x + attn
+        mlp_src = _layernorm(x, p["ln2"]["scale"], p["ln2"]["bias"])
+
+    m = mlp_src @ p["mlp_in"]["kernel"].astype(mlp_src.dtype) + \
+        p["mlp_in"]["bias"].astype(mlp_src.dtype)
+    m = checkpoint_name(m, "mlp_pre")
+    m = jax.nn.gelu(m, approximate=True)
+    m = m @ p["mlp_out"]["kernel"].astype(m.dtype) + \
+        p["mlp_out"]["bias"].astype(m.dtype)
     if not deterministic and cfg.dropout > 0:
-        h = _dropout(h, cfg.dropout, dr_mlp)
-    return x + h
+        m = _dropout(m, cfg.dropout, dr_mlp)
+    if cfg.parallel_residual:
+        return x + attn + m
+    return x + m
 
 
 def _dropout(x, rate, rng):
@@ -213,8 +261,9 @@ def forward(params: Dict, tokens: jnp.ndarray, cfg: GPTConfig,
     B, S = tokens.shape
     dtype = cfg.dtype
     wte = params["wte"]["embedding"].astype(dtype)
-    wpe = params["wpe"]["embedding"].astype(dtype)
-    x = wte[tokens] + wpe[:S][None]
+    x = wte[tokens]
+    if cfg.use_wpe:
+        x = x + params["wpe"]["embedding"].astype(dtype)[:S][None]
 
     block = params["block"]
     L = cfg.n_layers
@@ -233,7 +282,7 @@ def forward(params: Dict, tokens: jnp.ndarray, cfg: GPTConfig,
         return (y, r), None
 
     if cfg.remat:
-        body = jax.checkpoint(body, policy=jax.checkpoint_policies.nothing_saveable)
+        body = jax.checkpoint(body, policy=remat_policy(cfg.remat_policy))
 
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     (x, _), _ = jax.lax.scan(body, (x, rng), (block, jnp.arange(L)))
@@ -242,7 +291,10 @@ def forward(params: Dict, tokens: jnp.ndarray, cfg: GPTConfig,
     if cfg.tie_embeddings:
         logits = x @ wte.T
     else:
-        logits = x @ params["lm_head"]["kernel"].astype(dtype)
+        head = params["lm_head"]
+        logits = x @ head["kernel"].astype(dtype)
+        if "bias" in head:   # e.g. GPT-J ships an lm_head bias
+            logits = logits + head["bias"].astype(dtype)
     return logits
 
 
@@ -321,7 +373,7 @@ def gpt_pipeline_partition_rules(tp: bool = False) -> list:
 
 
 def make_pipeline_loss_fn(cfg: GPTConfig, mesh, num_stages: int,
-                          num_micro: int):
+                          num_micro: int, schedule: str = "gpipe"):
     """Engine-contract loss running the transformer stack as a shard_map
     pipeline over the 'pipe' mesh axis (1 stage = n_layers/pp layers).
     Embedding + LM head run replicated over pipe (tied-weight grads are
@@ -373,7 +425,8 @@ def make_pipeline_loss_fn(cfg: GPTConfig, mesh, num_stages: int,
 
     return make_pipelined_loss_fn(
         embed_fn, stage_fn, head_loss_fn, split_params,
-        num_stages, num_micro, mesh, specs, remat_stage=cfg.remat)
+        num_stages, num_micro, mesh, specs, remat_stage=cfg.remat,
+        schedule=schedule)
 
 
 def num_params(cfg: GPTConfig) -> int:

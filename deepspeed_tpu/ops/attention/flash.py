@@ -24,6 +24,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -334,6 +335,11 @@ def _flash(q, k, v, causal, scale, block_q, block_kv):
 
 def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_kv):
     o, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_kv)
+    # named so a selective remat policy can keep the residuals — without
+    # these, jax.checkpoint re-runs the whole forward kernel in the backward
+    # pass just to regenerate o/lse
+    o = checkpoint_name(o, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return o, (q, k, v, o, lse)
 
 
@@ -349,13 +355,17 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     block_q: int = 512, block_kv: int = 512) -> jnp.ndarray:
     """Flash attention over [B, S, H, D] tensors.
 
-    Pads the head dim to a 128-lane multiple for the MXU; falls back is the
-    caller's job (models catch exceptions and use the jnp path).
+    Head dims that are sublane-aligned (multiple of 8) run unpadded: Mosaic
+    masks the lane remainder, so QK^T streams only D real contraction lanes
+    through the MXU and HBM moves only real bytes. Padding D=64 up to 128
+    (the previous behavior) doubled both the attention matmul cycles and the
+    q/k/v/o HBM traffic. Odd head dims still pad to the next sublane
+    multiple. Fallback is the caller's job (models gate via _flash_eligible).
     """
     B, S, H, D = q.shape
     if scale is None:
         scale = 1.0 / np.sqrt(D)
-    Dp = _ceil_to(D, LANES)
+    Dp = D if D % 8 == 0 else _ceil_to(D, 8)
     if Dp != D:
         pad = [(0, 0), (0, 0), (0, 0), (0, Dp - D)]
         q = jnp.pad(q, pad)

@@ -161,7 +161,11 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         params=restored["params"],
         opt_state=opt_state,
         scale_state=scale_state,
-        rng=restored["rng"])
+        rng=restored["rng"],
+        # compressed-comm error residuals restart at zero after resume
+        # (same as the reference's worker_error, re-allocated at init)
+        comm_error=(engine._init_comm_error(restored["params"])
+                    if getattr(engine, "compressed_comm", False) else None))
 
     client_state: Dict[str, Any] = {}
     meta_path = os.path.join(path, META_FILE)

@@ -53,16 +53,21 @@ class TrainState:
     optimizer state, loss-scale state and step counter.
     """
 
-    def __init__(self, step, params, opt_state, scale_state, rng):
+    def __init__(self, step, params, opt_state, scale_state, rng,
+                 comm_error=None):
         self.step = step
         self.params = params
         self.opt_state = opt_state
         self.scale_state = scale_state
         self.rng = rng
+        # per-DP-rank error-feedback residual for compressed gradient
+        # reduction (comm_backend_name="dcn_compressed"; ref: the worker
+        # error tensors of NcclBackend.compressed_allreduce, nccl.py:52)
+        self.comm_error = comm_error
 
     def tree_flatten(self):
         return ((self.step, self.params, self.opt_state, self.scale_state,
-                 self.rng), None)
+                 self.rng, self.comm_error), None)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -203,13 +208,24 @@ class DeepSpeedEngine:
             hysteresis=config.fp16.hysteresis) if self.fp16_enabled \
             else ls.init_state(static_scale=1.0)
 
+        # --- compressed DP gradient reduction (dcn_compressed) --------
+        # the engine-level analog of the reference's compressed allreduce
+        # backend (ref: runtime/comm/nccl.py:52): grads cross the wire as
+        # packed 1-bit signs + scales with per-rank error feedback
+        self.compressed_comm = config.comm_backend_name == "dcn_compressed"
+        comm_error = None
+        if self.compressed_comm:
+            self._validate_compressed_comm()
+            comm_error = self._init_comm_error(params)
+
         rng = jax.random.PRNGKey(config.seed)
         self.state = TrainState(
             step=jnp.zeros([], jnp.int32),
             params=params,
             opt_state=opt_state,
             scale_state=scale_state,
-            rng=rng)
+            rng=rng,
+            comm_error=comm_error)
 
         # --- metrics monitor (ref: engine.py:470-517 tensorboard) -----
         if config.tensorboard.enabled:
@@ -218,6 +234,13 @@ class DeepSpeedEngine:
         else:
             from deepspeed_tpu.utils.monitor import NoopMonitor
             self.monitor = NoopMonitor()
+        self._monitor_buffer = []
+        if config.tensorboard.enabled:
+            # scalars are buffered between steps_per_print boundaries (a
+            # per-step float() would sync the device); make sure a process
+            # that never calls destroy() still lands its tail
+            import atexit
+            atexit.register(self._flush_monitor_buffer)
 
         # --- timers ---------------------------------------------------
         self.wall_clock_breakdown = config.wall_clock_breakdown
@@ -379,6 +402,46 @@ class DeepSpeedEngine:
             param_dtype=self.compute_dtype)
 
     # ------------------------------------------------------------------
+    # compressed DP gradient reduction (comm_backend_name="dcn_compressed")
+    # ------------------------------------------------------------------
+    def _validate_compressed_comm(self) -> None:
+        """Compressed reduction covers plain data parallelism — the same
+        scope as the reference's 1-bit backends (DP allreduce compression;
+        incompatible with ZeRO stages >= 2, ref: onebit docs + stage checks
+        in runtime/fp16/onebit/adam.py)."""
+        if self.config.zero.stage > 1:
+            raise ValueError(
+                "comm_backend_name='dcn_compressed' requires zero stage <= 1 "
+                "(gradients must be whole per rank to error-compress)")
+        for axis in ("fsdp", "model", "pipe", "sequence"):
+            if mesh_lib.axis_size(self.mesh, axis) > 1:
+                raise ValueError(
+                    f"dcn_compressed supports pure data parallelism; mesh "
+                    f"axis '{axis}' has size > 1")
+        if self.offload_enabled:
+            raise ValueError("dcn_compressed and offload_optimizer are "
+                             "mutually exclusive")
+
+    def _init_comm_error(self, params: PyTree) -> PyTree:
+        """Per-DP-rank error-feedback residuals: leaf shape [dp, *param];
+        leading dim sharded over 'data' so each rank holds exactly one
+        param-sized fp32 residual (ref: the worker_error buffers of
+        nccl.py compressed_allreduce)."""
+        dp = self.dp_world_size
+        err_sh = NamedSharding(self.mesh, P("data"))
+
+        def make(p):
+            return jax.device_put(
+                jnp.zeros((dp,) + tuple(p.shape), jnp.float32), err_sh)
+
+        return jax.tree_util.tree_map(make, params)
+
+    def _comm_error_shardings(self) -> PyTree:
+        err_sh = NamedSharding(self.mesh, P("data"))
+        return jax.tree_util.tree_map(lambda _: err_sh,
+                                      self.state.comm_error)
+
+    # ------------------------------------------------------------------
     # compiled step construction
     # ------------------------------------------------------------------
     def _build_train_step(self, donate_state: bool):
@@ -396,7 +459,8 @@ class DeepSpeedEngine:
         # MoQ: fake-quantize the compute-dtype copy inside the step; the
         # fp32 masters stay full precision (ref: engine.py:1789-1800
         # quantizes optimizer.bit16_groups, not the fp32 masters)
-        quant_fn = self.quantizer.make_transform() \
+        quant_fn = self.quantizer.make_transform(
+            step_at_build=self.global_steps - self.skipped_steps) \
             if (self.quantizer is not None and self.quantizer.active) else None
         pld_cfg = cfg.pld if cfg.pld.enabled else None
 
@@ -404,7 +468,7 @@ class DeepSpeedEngine:
             cparams = _cast_tree(params, compute_dtype)
             if quant_fn is not None:
                 rng, qr = jax.random.split(rng)
-                cparams = quant_fn(cparams, qr)
+                cparams = quant_fn(cparams, qr, step)
             # cast float inputs too (ref: engine.py:951 half()/bfloat16() cast
             # of module AND inputs) so activations genuinely run on the MXU in
             # the reduced precision
@@ -428,41 +492,111 @@ class DeepSpeedEngine:
 
         grad_fn = jax.grad(micro_loss, has_aux=True)
 
-        def step_fn(state: TrainState, batch: PyTree):
-            rng, step_rng = jax.random.split(state.rng)
+        compressed = self.compressed_comm
+        mesh = self.mesh
 
-            # ---- gradient accumulation over microbatches (lax.scan) ----
+        def accum_grads(params, batch, step_rng, scale_state, step):
+            """Gradient accumulation over microbatches (lax.scan).
+            Under jit the batch's data sharding makes XLA emit the DP
+            reduction; inside shard_map (compressed path) it yields the
+            rank-local gradients."""
             def micro_body(carry, micro):
                 grads_acc, loss_acc, r = carry
                 r, mr = jax.random.split(r)
-                g, (loss, _aux) = grad_fn(state.params, micro, mr,
-                                          state.scale_state, state.step)
+                g, (loss, _aux) = grad_fn(params, micro, mr,
+                                          scale_state, step)
                 if prescale and predivide != 1.0:
                     g = jax.tree_util.tree_map(lambda x: x / predivide, g)
                 grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, g)
                 return (grads_acc, loss_acc + loss.astype(jnp.float32), r), None
 
             zeros = jax.tree_util.tree_map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+                lambda p: jnp.zeros(p.shape, jnp.float32), params)
             if gas > 1:
                 micro_batches = jax.tree_util.tree_map(
-                    lambda x: x.reshape((gas, x.shape[0] // gas) + x.shape[1:]), batch)
+                    lambda x: x.reshape((gas, x.shape[0] // gas) + x.shape[1:]),
+                    batch)
                 (grads, loss_sum, _), _ = jax.lax.scan(
-                    lambda c, m: micro_body(c, m),
-                    (zeros, jnp.zeros([], jnp.float32), step_rng), micro_batches)
+                    micro_body,
+                    (zeros, jnp.zeros([], jnp.float32), step_rng),
+                    micro_batches)
             else:
                 (grads, loss_sum, _), _ = micro_body(
                     (zeros, jnp.zeros([], jnp.float32), step_rng), batch)
-
             mean_loss = loss_sum / gas
             grads = jax.tree_util.tree_map(lambda g: g / gas, grads)
+            return grads, mean_loss
 
-            # ---- unscale + overflow check (fp16) ----
-            if fp16:
-                grads = ls.unscale_grads(grads, state.scale_state)
-                overflow = ls.has_overflow(grads)
+        def compressed_grads(params, batch, step_rng, scale_state, step,
+                             comm_error):
+            """Per-rank grads + 1-bit error-feedback allreduce over 'data'
+            inside shard_map — the wire carries packed uint8 signs + one
+            f32 scale per leaf (ref: nccl.py:52 compressed_allreduce)."""
+            from deepspeed_tpu.parallel.compressed import (
+                compressed_allreduce_local)
+            err_leaves, err_treedef = jax.tree_util.tree_flatten(comm_error)
+
+            def local_fn(params, batch, comm_error_leaves):
+                # decorrelate per-rank dropout/rng
+                local_rng = jax.random.fold_in(
+                    step_rng, jax.lax.axis_index("data"))
+                local_grads, local_loss = accum_grads(
+                    params, batch, local_rng, scale_state, step)
+                if fp16:
+                    local_grads = ls.unscale_grads(local_grads, scale_state)
+                    # overflow must be caught BEFORE compression — an inf
+                    # gradient would poison the error residual (inf - inf)
+                    # for every later step (ref checks overflow pre-compress)
+                    ovf = jax.lax.pmax(
+                        ls.has_overflow(local_grads).astype(jnp.float32),
+                        "data") > 0
+                else:
+                    ovf = jnp.asarray(False)
+                g_leaves = jax.tree_util.tree_leaves(local_grads)
+                outs, new_errs = [], []
+                for g, e in zip(g_leaves, comm_error_leaves):
+                    g = jnp.where(ovf, jnp.zeros_like(g), g)
+                    avg, ne = compressed_allreduce_local(
+                        g, e[0], axis="data")
+                    outs.append(avg)
+                    new_errs.append(jnp.where(ovf, e[0], ne)[None])
+                loss = jax.lax.pmean(local_loss, "data")
+                return tuple(outs), tuple(new_errs), loss, ovf
+
+            gspecs = tuple(P() for _ in err_leaves)
+            espec = tuple(P("data") for _ in err_leaves)
+            pspec = jax.tree_util.tree_map(lambda _: P(), params)
+            bspec = jax.tree_util.tree_map(lambda _: P("data"), batch)
+            out = jax.shard_map(
+                local_fn, mesh=mesh,
+                in_specs=(pspec, bspec, espec),
+                out_specs=(gspecs, espec, P(), P()),
+                axis_names={"data"}, check_vma=False)(
+                    params, batch, tuple(err_leaves))
+            g_flat, e_flat, mean_loss, ovf = out
+            grads = jax.tree_util.tree_unflatten(err_treedef, list(g_flat))
+            new_error = jax.tree_util.tree_unflatten(err_treedef,
+                                                     list(e_flat))
+            return grads, mean_loss, new_error, ovf
+
+        def step_fn(state: TrainState, batch: PyTree):
+            rng, step_rng = jax.random.split(state.rng)
+
+            if compressed:
+                grads, mean_loss, new_comm_error, overflow = compressed_grads(
+                    state.params, batch, step_rng, state.scale_state,
+                    state.step, state.comm_error)
             else:
-                overflow = jnp.asarray(False)
+                grads, mean_loss = accum_grads(
+                    state.params, batch, step_rng, state.scale_state,
+                    state.step)
+                new_comm_error = state.comm_error
+                # ---- unscale + overflow check (fp16) ----
+                if fp16:
+                    grads = ls.unscale_grads(grads, state.scale_state)
+                    overflow = ls.has_overflow(grads)
+                else:
+                    overflow = jnp.asarray(False)
 
             gnorm = global_norm(grads)
             if clip > 0.0:
@@ -495,7 +629,8 @@ class DeepSpeedEngine:
                 params=new_params,
                 opt_state=new_opt_state,
                 scale_state=new_scale,
-                rng=rng)
+                rng=rng,
+                comm_error=new_comm_error)
             metrics = {
                 "loss": mean_loss,
                 "grad_norm": gnorm,
@@ -511,7 +646,9 @@ class DeepSpeedEngine:
             opt_state=self.opt_shardings,
             scale_state=jax.tree_util.tree_map(
                 lambda _: NamedSharding(self.mesh, P()), self.state.scale_state),
-            rng=NamedSharding(self.mesh, P()))
+            rng=NamedSharding(self.mesh, P()),
+            comm_error=(self._comm_error_shardings()
+                        if self.compressed_comm else None))
         metrics_sh = NamedSharding(self.mesh, P())
 
         self._state_shardings = state_shardings
@@ -690,22 +827,41 @@ class DeepSpeedEngine:
         self.global_steps += 1
         self.micro_steps += self.config.gradient_accumulation_steps
         self.global_samples += self.config.train_batch_size
-        if bool(metrics["overflow"]):
+        # Overflow (and therefore step-skipping) only exists under fp16 loss
+        # scaling; in bf16/fp32 the in-jit flag is constant False. Reading it
+        # host-side would force a device sync every step — on a remote-dispatch
+        # TPU runtime that is a full RPC roundtrip that serializes the
+        # pipeline (the reference pays the same sync in its per-step
+        # check_overflow allreduce, stage_1_and_2.py:1640; we only pay it when
+        # the feature is actually on).
+        if self.fp16_enabled and bool(metrics["overflow"]):
             self.skipped_steps += 1
         if self.monitor.enabled:
             # scalar names mirror the reference's tensorboard tags
-            # (ref: engine.py:1656-1666, :1889-1917)
-            self.monitor.write_scalars([
-                ("Train/Samples/train_loss", float(metrics["loss"]),
-                 self.global_samples),
-                ("Train/Samples/lr", float(metrics["lr"]),
-                 self.global_samples),
-                ("Train/Samples/loss_scale", float(metrics["loss_scale"]),
-                 self.global_samples),
-            ])
+            # (ref: engine.py:1656-1666, :1889-1917). Buffer the device
+            # scalars and convert only at flush boundaries — float() every
+            # step would block on the device and defeat async dispatch.
+            self._monitor_buffer.append(
+                (self.global_samples, metrics["loss"], metrics["lr"],
+                 metrics["loss_scale"]))
+            if (self.global_steps % self.config.steps_per_print == 0
+                    or len(self._monitor_buffer) >= 64):
+                self._flush_monitor_buffer()
         if self.global_steps % self.config.steps_per_print == 0:
             self._report_progress(metrics)
         return metrics
+
+    def _flush_monitor_buffer(self):
+        buffered, self._monitor_buffer = self._monitor_buffer, []
+        events = []
+        for samples, loss, lr, scale in buffered:
+            events.extend([
+                ("Train/Samples/train_loss", float(loss), samples),
+                ("Train/Samples/lr", float(lr), samples),
+                ("Train/Samples/loss_scale", float(scale), samples),
+            ])
+        if events:
+            self.monitor.write_scalars(events)
 
     def set_flops_per_batch(self, flops: float) -> None:
         """Analytic per-batch flops override for the profiler. XLA's
@@ -805,7 +961,20 @@ class DeepSpeedEngine:
             # must fit in the same HBM the gas-split train step fits in
             micro_bs = self.config.train_micro_batch_size_per_gpu * \
                 self.dp_world_size
-            micro = jax.tree_util.tree_map(lambda x: x[:micro_bs], batch)
+
+            def slice_leaf(x):
+                # only array leaves with a leading batch axis can be
+                # micro-sliced; scalars/rank-0 leaves (and non-addressable
+                # multi-host shards, which cannot be indexed host-side)
+                # pass through unchanged
+                if not hasattr(x, "ndim") or x.ndim < 1 or \
+                        x.shape[0] < micro_bs:
+                    return x
+                if isinstance(x, jax.Array) and not x.is_fully_addressable:
+                    return x
+                return x[:micro_bs]
+
+            micro = jax.tree_util.tree_map(slice_leaf, batch)
             self.block_eigenvalue = self.eigenvalue.compute_eigenvalue(
                 self._eigenvalue_loss, self.state.params, micro,
                 self.state.rng)
@@ -818,6 +987,7 @@ class DeepSpeedEngine:
 
     def destroy(self) -> None:
         """Flush and release engine-owned sinks (monitor/TB writer)."""
+        self._flush_monitor_buffer()
         self.monitor.close()
 
     # familiarity wrappers --------------------------------------------

@@ -103,6 +103,166 @@ def pipeline_loss(stage_fn: Callable,
     return jax.lax.psum(local, axis)
 
 
+# ---------------------------------------------------------------------------
+# memory-bounded 1F1B execution
+# ---------------------------------------------------------------------------
+
+def _one_f_one_b_program(stage_fn: Callable,
+                         head_loss_fn: Callable,
+                         num_stages: int,
+                         axis: str,
+                         stage_params: PyTree,
+                         other_params: PyTree,
+                         x_micro: jnp.ndarray,
+                         target_micro: PyTree):
+    """1F1B pipelined forward+backward as ONE scan, inside shard_map.
+
+    Memory-bounded analog of the reference's TrainSchedule
+    (ref: deepspeed/runtime/pipe/schedule.py:189): each tick every stage
+    runs one forward (microbatch f = t - s) and one backward
+    (microbatch b = t - (2P - 2 - s)), so a stage holds at most
+    2*(P-1-s) in-flight microbatch *inputs* — O(stages), not
+    O(microbatches). Backward recomputes the stage forward from the saved
+    input (activation checkpointing at stage granularity, like the
+    reference's PipelineModule activation_checkpoint_interval).
+
+    Returns (mean loss, dstage_params, dother_params, dx_micro) — gradients
+    computed manually (the caller wraps this in a custom_vjp; autodiff never
+    sees the scan, so no O(ticks) residuals are retained).
+    """
+    M = x_micro.shape[0]
+    P_ = num_stages
+    s = jax.lax.axis_index(axis)
+    is_first = s == 0
+    is_last = s == P_ - 1
+    num_ticks = M + 2 * P_ - 2
+    K = max(2 * P_ - 1, 1)              # input ring-buffer slots
+
+    fwd_perm = [(i, (i + 1) % P_) for i in range(P_)]
+    bwd_perm = [(i, (i - 1) % P_) for i in range(P_)]
+
+    f32 = jnp.float32
+    zeros_like_tree = lambda t: jax.tree_util.tree_map(
+        lambda x: jnp.zeros(x.shape, f32), t)
+
+    def head_for(t):
+        """loss + vjp closure of the head for forward-microbatch slot t."""
+        tgt = jax.tree_util.tree_map(
+            lambda z: z[jnp.clip(t, 0, M - 1)], target_micro)
+        return lambda op, y: head_loss_fn(op, y, tgt)
+
+    def tick(carry, t):
+        (fwd_in, bwd_in, buf, dstage, dother, dx_acc, loss_acc) = carry
+        f = t - s                        # forward microbatch id
+        b = t - (2 * P_ - 2 - s)         # backward microbatch id
+        f_valid = (f >= 0) & (f < M)
+        b_valid = (b >= 0) & (b < M)
+
+        # ---- forward ----
+        inp = jnp.where(is_first, x_micro[jnp.clip(f, 0, M - 1)], fwd_in)
+        buf = jnp.where(f_valid,
+                        jax.lax.dynamic_update_index_in_dim(
+                            buf, inp, jnp.clip(f, 0, M - 1) % K, 0),
+                        buf)
+        out = stage_fn(stage_params, inp)
+
+        # ---- last-stage head: loss + dy for the just-finished microbatch
+        loss_m, head_vjp = jax.vjp(head_for(f), other_params, out)
+        dother_m, dy_head = head_vjp(jnp.ones((), loss_m.dtype))
+        mask_last = (is_last & f_valid).astype(f32)
+        loss_acc = loss_acc + loss_m.astype(f32) * mask_last
+        dother = jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(f32) * mask_last, dother, dother_m)
+
+        # ---- backward (recompute from the saved stage input) ----
+        # at the last stage b == f, and the input is the one stored this tick
+        x_saved = jnp.where(is_last, inp, buf[jnp.clip(b, 0, M - 1) % K])
+        cot_in = jnp.where(is_last, dy_head.astype(bwd_in.dtype), bwd_in)
+        _, stage_vjp = jax.vjp(stage_fn, stage_params, x_saved)
+        dstage_m, dx_m = stage_vjp(cot_in)
+        mask_b = b_valid.astype(f32)
+        dstage = jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(f32) * mask_b, dstage, dstage_m)
+        # grads w.r.t. the pipeline input (stage 0's dx -> embedding)
+        mask_first_b = (is_first & b_valid).astype(dx_m.dtype)
+        dx_acc = jax.lax.dynamic_update_index_in_dim(
+            dx_acc,
+            dx_acc[jnp.clip(b, 0, M - 1)] + dx_m * mask_first_b,
+            jnp.clip(b, 0, M - 1), 0)
+
+        # ---- neighbor exchange ----
+        fwd_out = jax.lax.ppermute(out, axis, fwd_perm)
+        bwd_out = jax.lax.ppermute(dx_m, axis, bwd_perm)
+        return (fwd_out, bwd_out, buf, dstage, dother, dx_acc, loss_acc), None
+
+    x0 = jnp.zeros_like(x_micro[0])
+    carry0 = (x0, jnp.zeros_like(x0),
+              jnp.zeros((K,) + x0.shape, x0.dtype),
+              zeros_like_tree(stage_params),
+              zeros_like_tree(other_params),
+              jnp.zeros_like(x_micro),
+              jnp.zeros((), f32))
+    (_, _, _, dstage, dother, dx_micro, loss_sum), _ = jax.lax.scan(
+        tick, carry0, jnp.arange(num_ticks))
+
+    # per-microbatch mean -> batch mean; scale grads accordingly
+    inv_m = 1.0 / M
+    loss = jax.lax.psum(loss_sum * inv_m, axis)
+    dother = jax.tree_util.tree_map(
+        lambda g: jax.lax.psum(g * inv_m, axis), dother)
+    dx_micro = jax.lax.psum(dx_micro * inv_m, axis)
+    dstage = jax.tree_util.tree_map(lambda g: g * inv_m, dstage)
+    return loss, dstage, dother, dx_micro
+
+
+def make_1f1b_loss_fn(stage_fn: Callable,
+                      head_loss_fn: Callable,
+                      num_stages: int,
+                      mesh: Mesh,
+                      stage_params_specs: PyTree,
+                      *,
+                      axis: str = "pipe") -> Callable:
+    """(stage_params, other_params, x_micro, target_micro) -> scalar loss,
+    differentiable, executing the memory-bounded 1F1B schedule. Gradients
+    are produced by the same single scan (custom_vjp; the forward pass
+    runs fwd+bwd eagerly and stashes the grads as residuals — train-only,
+    eval paths should use the plain pipeline)."""
+
+    def run(stage_params, other_params, x_micro, target_micro):
+        prog = partial(_one_f_one_b_program, stage_fn, head_loss_fn,
+                       num_stages, axis)
+        return jax.shard_map(
+            prog, mesh=mesh,
+            in_specs=(stage_params_specs, P(), P(), P()),
+            out_specs=(P(), stage_params_specs, P(), P()),
+            axis_names={axis}, check_vma=False)(
+                stage_params, other_params, x_micro, target_micro)
+
+    @jax.custom_vjp
+    def loss_1f1b(stage_params, other_params, x_micro, target_micro):
+        loss, _, _, _ = run(stage_params, other_params, x_micro,
+                            target_micro)
+        return loss
+
+    def fwd(stage_params, other_params, x_micro, target_micro):
+        loss, dstage, dother, dx = run(stage_params, other_params, x_micro,
+                                       target_micro)
+        return loss, (dstage, dother, dx, target_micro)
+
+    def bwd(res, g):
+        dstage, dother, dx, target_micro = res
+        scale = lambda t: jax.tree_util.tree_map(lambda v: v * g, t)
+        dtarget = jax.tree_util.tree_map(
+            lambda z: (jnp.zeros(z.shape, jax.dtypes.float0)
+                       if not jnp.issubdtype(z.dtype, jnp.floating)
+                       else jnp.zeros_like(z)),
+            target_micro)
+        return scale(dstage), scale(dother), dx * g, dtarget
+
+    loss_1f1b.defvjp(fwd, bwd)
+    return loss_1f1b
+
+
 def make_pipelined_loss_fn(embed_fn: Callable,
                            stage_fn: Callable,
                            head_loss_fn: Callable,
@@ -113,6 +273,7 @@ def make_pipelined_loss_fn(embed_fn: Callable,
                            stage_params_specs: PyTree,
                            *,
                            remat_stage: bool = True,
+                           schedule: str = "gpipe",
                            axis: str = "pipe") -> Callable:
     """Build an engine-compatible loss fn (params, batch, rng) -> loss.
 
@@ -123,10 +284,20 @@ def make_pipelined_loss_fn(embed_fn: Callable,
       on that dim by the caller's partition rules.
     - stage_params_specs: PartitionSpec pytree for the stacked params
       (leading 'pipe' axis); other axes stay auto.
+    - schedule: 'gpipe' (fill-drain via scan+autodiff; activation memory
+      O(microbatches)) or '1f1b' (memory-bounded, ref TrainSchedule
+      pipe/schedule.py:189; activation memory O(stages)).
     """
-    if remat_stage:
+    if remat_stage and schedule != "1f1b":
+        # 1f1b checkpoints at stage granularity by construction
         stage_fn = jax.checkpoint(stage_fn,
                                   policy=jax.checkpoint_policies.nothing_saveable)
+
+    if schedule == "1f1b":
+        loss_1f1b = make_1f1b_loss_fn(stage_fn, head_loss_fn, num_stages,
+                                      mesh, stage_params_specs, axis=axis)
+    elif schedule != "gpipe":
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
 
     def loss_fn(params, batch, rng):
         del rng
@@ -138,6 +309,10 @@ def make_pipelined_loss_fn(embed_fn: Callable,
         x_micro = x.reshape((num_micro, mb) + x.shape[1:])
         target_micro = jax.tree_util.tree_map(
             lambda t: t.reshape((num_micro, mb) + t.shape[1:]), targets)
+
+        if schedule == "1f1b":
+            return loss_1f1b(stage_params, other_params, x_micro,
+                             target_micro)
 
         inner = partial(pipeline_loss, stage_fn, head_loss_fn,
                         num_stages=num_stages, axis=axis)

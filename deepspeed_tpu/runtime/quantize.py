@@ -187,31 +187,50 @@ class Quantizer:
 
     # -- in-jit transform (the engine's compute-copy path) -------------
 
-    def make_transform(self) -> Callable:
+    def make_transform(self, step_at_build: Optional[int] = None) -> Callable:
         """Freeze the current bit-widths into a pure function
-        ``f(params, rng) -> params`` applied to the compute-dtype copy
-        inside the jitted train step. Straight-through gradients; fp32
+        ``f(params, rng, step=None) -> params`` applied to the compute-dtype
+        copy inside the jitted train step. Straight-through gradients; fp32
         masters untouched. The engine rebuilds (recompiles) whenever
-        :meth:`advance` reports a switch."""
+        :meth:`advance` reports a switch.
+
+        Bit-widths are trace-time constants (they change only at the rare
+        precision switches, which recompile anyway). The fp16 mixing ratio
+        decays *every* step (ref: quantize.py:44 update_fp16_ratio applied
+        each compute_quantization call), so it is reconstructed in-jit from
+        the traced ``step``: ratio(step) = max(0, ratio_at_build -
+        change_ratio * (step - step_at_build)). Pass ``step_at_build`` =
+        the engine's applied-step count at (re)build time."""
         bits = tuple(self.q_start_bits)
         groups = self.q_groups
         symmetric = self.q_type == "symmetric"
         stochastic = self.q_rounding == "stochastic"
         layer_num = self.layer_num
         prefix = self.stacked_prefix
-        ratio = self.quantize_real_ratio if self.q_mixed_fp16 else 0.0
+        ratio0 = self.quantize_real_ratio if self.q_mixed_fp16 else 0.0
+        change = self.q_change_ratio if self.q_mixed_fp16 else 0.0
+        step0 = step_at_build
         near_target = self.q_start_bits[0] >= (self.q_target_bits - 1)
 
-        def fq(x, b, key):
+        def fq(x, b, key, ratio):
             q = qops.quantize_dequantize(
                 x, groups=groups, bits=b, symmetric=symmetric,
                 stochastic=stochastic, rng=key)
-            if ratio > 0.0 and near_target:
-                q = x * ratio + (1.0 - ratio) * q
+            if ratio is not None and near_target:
+                r = ratio.astype(x.dtype)
+                q = x * r + (1.0 - r) * q
             return x + jax.lax.stop_gradient(q - x)
 
-        def transform(params, rng):
+        def transform(params, rng, step=None):
             keys = [rng]
+            if ratio0 > 0.0 and step is not None and step0 is not None:
+                ratio = jnp.maximum(
+                    0.0, ratio0 - change *
+                    (step.astype(jnp.float32) - float(step0)))
+            elif ratio0 > 0.0:
+                ratio = jnp.asarray(ratio0, jnp.float32)
+            else:
+                ratio = None
 
             def visit(path, leaf):
                 if leaf.ndim <= 1:
@@ -221,11 +240,12 @@ class Quantizer:
                 if (layer_num > 0 and prefix in name and leaf.ndim >= 3
                         and leaf.shape[0] == layer_num):
                     slices = [
-                        fq(leaf[i], bits[i], jax.random.fold_in(sub, i))
+                        fq(leaf[i], bits[i], jax.random.fold_in(sub, i),
+                           ratio)
                         for i in range(layer_num)
                     ]
                     return jnp.stack(slices)
-                return fq(leaf, bits[0], sub)
+                return fq(leaf, bits[0], sub, ratio)
 
             return jax.tree_util.tree_map_with_path(visit, params)
 

@@ -89,6 +89,21 @@ class WeightQuantization:
         return jnp.concatenate(all_scales)
 
     def merge_scales_split(self, split_count: int) -> List[jnp.ndarray]:
-        """Per-TP-rank scale split (ref: weight_quantizer.py:84)."""
-        merged = self.merge_scales()
-        return list(jnp.split(merged, split_count, axis=-1))
+        """Per-TP-rank scale split (ref: weight_quantizer.py:84).
+
+        Each category's *real* scale row is split into split_count chunks
+        first, and only then padded to the per-rank common width — splitting
+        the padded merge instead would hand non-zero ranks the padding zeros
+        whenever category widths differ (always with mlp_extra_grouping).
+        """
+        per_rank: List[List[jnp.ndarray]] = [[] for _ in range(split_count)]
+        for dense_scale, qkv_scale, m4hh_scale, mh4h_scale in zip(
+                self.dense_scales, self.qkv_scales,
+                self.mlp4hh_scales, self.mlph4h_scales):
+            cat_chunks = [jnp.split(s, split_count, axis=-1)
+                          for s in (qkv_scale, dense_scale,
+                                    mh4h_scale, m4hh_scale)]
+            for rank in range(split_count):
+                per_rank[rank].append(self.merge_layer_scales(
+                    [chunks[rank] for chunks in cat_chunks]))
+        return [jnp.concatenate(rows) for rows in per_rank]

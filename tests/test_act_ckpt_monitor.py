@@ -152,6 +152,9 @@ def test_engine_monitor_integration(tmp_path, devices):
         model=simple_model_loss, model_parameters=params, config=cfg)
     for i in range(3):
         engine.train_batch(random_batch(8, 16, seed=i))
+    # scalars are buffered (no per-step device sync) and flushed on
+    # steps_per_print boundaries and close
+    engine.destroy()
     jsonl = (tmp_path / "runs" / "t" / "scalars.jsonl").read_text()
     assert jsonl.count("Train/Samples/train_loss") == 3
     assert "Train/Samples/lr" in jsonl

@@ -83,3 +83,120 @@ def test_pipeline_engine_trains(devices):
     # block params must actually be sharded over pipe
     qkv = engine.state.params["block"]["qkv"]["kernel"]
     assert qkv.sharding.shard_shape(qkv.shape)[0] == cfg.n_layers // 4
+
+
+# ------------------------------------------------------------------
+# memory-bounded 1F1B schedule (ref: pipe/schedule.py:189 TrainSchedule)
+# ------------------------------------------------------------------
+
+def test_1f1b_loss_and_grads_match_dense(devices):
+    """The 1F1B program (manual fwd+bwd scan) reproduces dense loss and
+    gradients, including the tied-embedding path."""
+    cfg = tiny_cfg(n_layers=4)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = np.random.default_rng(0).integers(0, 128, (8, 17)).astype(np.int32)
+    batch = {"tokens": jnp.asarray(tokens)}
+
+    ref_l = float(gpt.loss_fn(params, dict(batch), jax.random.PRNGKey(0),
+                              cfg, deterministic=True))
+    g_ref = jax.grad(lambda p: gpt.loss_fn(p, dict(batch),
+                                           jax.random.PRNGKey(0), cfg,
+                                           deterministic=True))(params)
+
+    mesh = make_mesh(MeshSpec(pipe=4, data=-1))
+    loss_fn = gpt.make_pipeline_loss_fn(cfg, mesh, num_stages=4,
+                                        num_micro=4, schedule="1f1b")
+    with jax.set_mesh(mesh):
+        l = float(jax.jit(loss_fn)(params, batch, jax.random.PRNGKey(0)))
+        g = jax.jit(jax.grad(
+            lambda p: loss_fn(p, batch, jax.random.PRNGKey(0))))(params)
+    np.testing.assert_allclose(ref_l, l, rtol=1e-5)
+    flat_pl = dict(jax.tree_util.tree_leaves_with_path(g))
+    for path, leaf in jax.tree_util.tree_leaves_with_path(g_ref):
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(flat_pl[path]),
+            rtol=2e-4, atol=2e-5, err_msg=jax.tree_util.keystr(path))
+
+
+def test_1f1b_activation_memory_bounded(devices):
+    """Compiled peak temp memory: the per-microbatch marginal cost of the
+    1F1B program stays far below fill-drain GPipe's (whose live window is
+    O(M) vs O(stages))."""
+    def temp_bytes(schedule, M):
+        cfg = tiny_cfg(n_layers=4, d_model=64, remat=True)
+        params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+        tokens = np.random.default_rng(0).integers(
+            0, 128, (M * 2, 17)).astype(np.int32)
+        batch = {"tokens": jnp.asarray(tokens)}
+        mesh = make_mesh(MeshSpec(pipe=4, data=-1))
+        loss_fn = gpt.make_pipeline_loss_fn(cfg, mesh, num_stages=4,
+                                            num_micro=M, schedule=schedule)
+        with jax.set_mesh(mesh):
+            comp = jax.jit(jax.grad(
+                lambda p: loss_fn(p, batch, jax.random.PRNGKey(0)))
+            ).lower(params).compile()
+        return comp.memory_analysis().temp_size_in_bytes
+
+    marginal_gpipe = temp_bytes("gpipe", 16) - temp_bytes("gpipe", 4)
+    marginal_1f1b = temp_bytes("1f1b", 16) - temp_bytes("1f1b", 4)
+    # 1f1b's growth is only the batch-proportional input/dx buffers;
+    # gpipe additionally stacks every microbatch's live activations
+    assert marginal_1f1b < 0.4 * marginal_gpipe, (
+        marginal_1f1b, marginal_gpipe)
+
+
+def test_1f1b_engine_trains(devices):
+    """Engine integration with the 1F1B schedule: pp=4 x dp=2."""
+    cfg = tiny_cfg(n_layers=4)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    mesh = make_mesh(MeshSpec(pipe=4, data=-1))
+    loss_fn = gpt.make_pipeline_loss_fn(cfg, mesh, num_stages=4,
+                                        num_micro=4, schedule="1f1b")
+    ds = {
+        "train_batch_size": 8,
+        "zero_optimization": {"stage": 1},
+        "optimizer": {"type": "adamw", "params": {"lr": 3e-3}},
+        "steps_per_print": 1000,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=loss_fn, model_parameters=params, config=ds, mesh=mesh,
+        partition_rules=gpt.gpt_pipeline_partition_rules())
+    data = np.random.default_rng(0).integers(0, 128, (8, 33)).astype(np.int32)
+    losses = [float(engine.train_batch({"tokens": data})["loss"])
+              for _ in range(12)]
+    assert losses[-1] < losses[0] - 0.5, losses
+
+
+def test_3d_parallel_engine(devices):
+    """3D composition pipe=2 x model=2 x data=2 through the engine
+    (ref: PipeModelDataParallelTopology, runtime/pipe/topology.py:246) —
+    parity vs the dense loss and convergence."""
+    cfg = tiny_cfg(n_layers=4)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    mesh = make_mesh(MeshSpec(pipe=2, data=2, model=2))
+    loss_fn = gpt.make_pipeline_loss_fn(cfg, mesh, num_stages=2, num_micro=2)
+    ds = {
+        "train_batch_size": 8,
+        "zero_optimization": {"stage": 1},
+        "optimizer": {"type": "adamw", "params": {"lr": 3e-3}},
+        "steps_per_print": 1000,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=loss_fn, model_parameters=params, config=ds, mesh=mesh,
+        partition_rules=gpt.gpt_pipeline_partition_rules(tp=True))
+
+    # parity of the first loss vs dense single-device compute
+    data = np.random.default_rng(0).integers(0, 128, (8, 33)).astype(np.int32)
+    ref = float(gpt.loss_fn(params, {"tokens": jnp.asarray(data)},
+                            jax.random.PRNGKey(0), cfg, deterministic=True))
+    losses = [float(engine.train_batch({"tokens": data})["loss"])
+              for _ in range(10)]
+    np.testing.assert_allclose(losses[0], ref, rtol=1e-4)
+    assert losses[-1] < losses[0] - 0.4, losses
+
+    # all three axes genuinely active: stage dim over pipe, qkv out-dim
+    # over model
+    qkv = engine.state.params["block"]["qkv"]["kernel"]
+    shard = qkv.sharding.shard_shape(qkv.shape)
+    assert shard[0] == cfg.n_layers // 2       # pipe
+    assert shard[2] == qkv.shape[2] // 2       # model (TP)

@@ -177,6 +177,35 @@ def test_weight_quantization_merge(rng):
     assert merged.shape[0] == 1 and merged.shape[1] == 4  # 1 layer, 4 slots
 
 
+def test_weight_quantization_split_ranks_get_real_scales(rng):
+    """With mlp_extra_grouping the mlp categories have 2x the groups of
+    qkv/dense; every TP rank must still receive its own real (non-padding)
+    scale chunk for every category (ref: weight_quantizer.py:84)."""
+    wq = WeightQuantization(mlp_extra_grouping=True, mp_size=1)
+    h = 16
+    wq.Quantize([jnp.asarray(rng.standard_normal((h, 3 * h)), jnp.float32)],
+                8, 2, key="attn.qkv.weight")
+    wq.Quantize([jnp.asarray(rng.standard_normal((h, h)), jnp.float32)],
+                8, 2, key="attn.out.weight")
+    wq.Quantize([jnp.asarray(rng.standard_normal((h, 4 * h)), jnp.float32)],
+                8, 2, key="mlp.dense_h_to_4h.weight")
+    wq.Quantize([jnp.asarray(rng.standard_normal((4 * h, h)), jnp.float32)],
+                8, 2, key="mlp.dense_4h_to_h.weight")
+    split = wq.merge_scales_split(2)
+    assert len(split) == 2
+    # category rows: 0=qkv (2 groups), 1=dense (2), 2=mlp h4h (4), 3=mlp 4hh (4)
+    qkv_full = np.asarray(wq.qkv_scales[0]).reshape(-1)
+    m4hh_full = np.asarray(wq.mlp4hh_scales[0]).reshape(-1)
+    for rank in range(2):
+        rank_scales = np.asarray(split[rank])[0]  # [4, width]
+        # mlp rows are the widest -> fully real, and must be the rank's
+        # own chunk of the category scales, not padding zeros
+        np.testing.assert_allclose(rank_scales[3], m4hh_full[2 * rank:2 * rank + 2])
+        # qkv row: first chunk real, remainder zero-pad
+        np.testing.assert_allclose(rank_scales[0][:1], qkv_full[rank:rank + 1])
+        assert np.all(rank_scales[0][1:] == 0)
+
+
 def test_weight_quantization_accuracy(rng):
     wq = WeightQuantization()
     w = jnp.asarray(rng.standard_normal((32, 32)), jnp.float32)
