@@ -63,6 +63,30 @@ def initialize(args=None,
     assert model is not None, "deepspeed_tpu.initialize requires a loss function"
     assert model_parameters is not None, "model_parameters (param pytree) required"
 
+    # LayeredModel -> parameter-streaming engine (the analog of the
+    # reference's PipelineModule dispatch at deepspeed/__init__.py:118-142;
+    # here the layered form enables the ZeRO-Infinity param tier,
+    # ref: runtime/zero/partitioned_param_swapper.py). Single-chip by
+    # design: the whole point is capacity beyond one chip's HBM.
+    from deepspeed_tpu.runtime.zero.param_offload import (
+        InfinityParamEngine, LayeredModel)
+    if isinstance(model, LayeredModel):
+        from deepspeed_tpu.runtime.lr_schedules import get_lr_schedule
+        ds_config = DeepSpeedConfig(config, world_size=1)
+        base_lr = (ds_config.optimizer.params or {}).get("lr", 1e-3)
+        sched = lr_scheduler if callable(lr_scheduler) else get_lr_schedule(
+            ds_config.scheduler.type, ds_config.scheduler.params,
+            base_lr=base_lr)
+        engine = InfinityParamEngine(model, model_parameters, ds_config,
+                                     lr_schedule=sched)
+        dataloader = None
+        if training_data is not None:
+            from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+            dataloader = DeepSpeedDataLoader(
+                training_data, batch_size=ds_config.train_batch_size,
+                collate_fn=collate_fn)
+        return engine, None, dataloader, sched
+
     config_dict = config if isinstance(config, dict) else None
     world_size = _infer_world_size(mesh, config_dict)
     ds_config = DeepSpeedConfig(config, world_size=world_size)

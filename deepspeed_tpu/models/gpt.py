@@ -429,6 +429,99 @@ def make_pipeline_loss_fn(cfg: GPTConfig, mesh, num_stages: int,
         schedule=schedule)
 
 
+# ---------------------------------------------------------------------------
+# ZeRO-Infinity parameter streaming integration
+# ---------------------------------------------------------------------------
+
+def layered_model(cfg: GPTConfig):
+    """LayeredModel contract for the parameter-streaming engine
+    (runtime/zero/param_offload.py) — trains GPTs larger than device HBM
+    (ref capability: 13B params on one 32GB GPU, docs/_pages/features.md:116;
+    ref machinery: runtime/swap_tensor/partitioned_param_swapper.py:37)."""
+    from deepspeed_tpu.runtime.zero.param_offload import LayeredModel
+
+    def split_params(params):
+        other = {k: v for k, v in params.items() if k != "block"}
+        return params["block"], other
+
+    def embed_fn(other, batch):
+        tokens = batch["tokens"]
+        targets = batch.get("targets")
+        if targets is None:
+            targets = tokens[:, 1:]
+            tokens = tokens[:, :-1]
+        S = tokens.shape[1]
+        x = other["wte"]["embedding"].astype(cfg.dtype)[tokens]
+        if cfg.use_wpe:
+            x = x + other["wpe"]["embedding"].astype(cfg.dtype)[:S][None]
+        return x, targets
+
+    def layer_fn(lp, x):
+        return _block(x, lp, cfg, deterministic=True)
+
+    def head_fn(other, y, targets):
+        y = _layernorm(y, other["ln_f"]["scale"], other["ln_f"]["bias"])
+        logits = (y @ other["wte"]["embedding"].astype(cfg.dtype).T
+                  if cfg.tie_embeddings
+                  else y @ other["lm_head"]["kernel"].astype(cfg.dtype))
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
+        return -ll.mean()
+
+    return LayeredModel(split_params=split_params, embed_fn=embed_fn,
+                        layer_fn=layer_fn, head_fn=head_fn,
+                        n_layers=cfg.n_layers,
+                        layer_remat_policy=(remat_policy(cfg.remat_policy)
+                                            if cfg.remat else None))
+
+
+def host_param_factory(seed: int, cfg: GPTConfig):
+    """Host-RAM parameter factory for models too large to materialize as
+    one stacked tree: factory(i) -> layer i's fp32 numpy pytree (unstacked),
+    factory("other") -> embeddings/final-norm tree. Feeds
+    InfinityParamEngine without ever holding more than one layer twice."""
+    d, ff = cfg.d_model, cfg.ffn_dim
+
+    def factory(which):
+        if which == "other":
+            r = np.random.default_rng(seed)
+            other = {
+                "wte": {"embedding": (r.standard_normal(
+                    (cfg.vocab_size, d), np.float32) * 0.02)},
+                "wpe": {"embedding": (r.standard_normal(
+                    (cfg.max_seq_len, d), np.float32) * 0.02)},
+                "ln_f": {"scale": np.ones((d,), np.float32),
+                         "bias": np.zeros((d,), np.float32)},
+            }
+            if not cfg.tie_embeddings:
+                other["lm_head"] = {"kernel": (r.standard_normal(
+                    (d, cfg.vocab_size), np.float32) * 0.02)}
+            return other
+        i = int(which)
+        r = np.random.default_rng(seed + 1 + i)
+        resid = 0.02 / np.sqrt(2.0 * cfg.n_layers)
+        return {
+            "ln1": {"scale": np.ones((d,), np.float32),
+                    "bias": np.zeros((d,), np.float32)},
+            "qkv": {"kernel": (r.standard_normal((d, 3 * d), np.float32)
+                               * 0.02),
+                    "bias": np.zeros((3 * d,), np.float32)},
+            "attn_out": {"kernel": (r.standard_normal((d, d), np.float32)
+                                    * resid),
+                         "bias": np.zeros((d,), np.float32)},
+            "ln2": {"scale": np.ones((d,), np.float32),
+                    "bias": np.zeros((d,), np.float32)},
+            "mlp_in": {"kernel": (r.standard_normal((d, ff), np.float32)
+                                  * 0.02),
+                       "bias": np.zeros((ff,), np.float32)},
+            "mlp_out": {"kernel": (r.standard_normal((ff, d), np.float32)
+                                   * resid),
+                        "bias": np.zeros((d,), np.float32)},
+        }
+
+    return factory
+
+
 def num_params(cfg: GPTConfig) -> int:
     d, L, ff, V = cfg.d_model, cfg.n_layers, cfg.ffn_dim, cfg.vocab_size
     per_layer = 3 * d * d + 3 * d + d * d + d + 2 * d * ff + ff + d + 4 * d

@@ -80,6 +80,10 @@ class OffloadConfig:
     pipeline_read: bool = False
     pipeline_write: bool = False
     max_in_cpu: int = 1_000_000_000
+    # layers per streamed block for the ZeRO-Infinity param tier
+    # (runtime/zero/param_offload.py); 0 = auto-size (<=8 groups,
+    # capped block bytes)
+    stream_group_layers: int = 0
 
     @property
     def enabled(self) -> bool:
@@ -98,6 +102,8 @@ class OffloadConfig:
             pipeline_read=get_scalar_param(d, C.OFFLOAD_PIPELINE_READ, False),
             pipeline_write=get_scalar_param(d, C.OFFLOAD_PIPELINE_WRITE, False),
             max_in_cpu=int(get_scalar_param(d, C.OFFLOAD_MAX_IN_CPU, 1_000_000_000)),
+            stream_group_layers=int(get_scalar_param(
+                d, "stream_group_layers", 0)),
         )
 
 

