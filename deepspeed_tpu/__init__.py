@@ -71,6 +71,12 @@ def initialize(args=None,
     from deepspeed_tpu.runtime.zero.param_offload import (
         InfinityParamEngine, LayeredModel)
     if isinstance(model, LayeredModel):
+        if optimizer is not None or mesh is not None or partition_rules:
+            raise ValueError(
+                "LayeredModel (param-streaming) engine owns its host "
+                "optimizer and runs single-chip — optimizer/mesh/"
+                "partition_rules are not supported; configure the "
+                "optimizer via the JSON config instead")
         from deepspeed_tpu.runtime.lr_schedules import get_lr_schedule
         ds_config = DeepSpeedConfig(config, world_size=1)
         base_lr = (ds_config.optimizer.params or {}).get("lr", 1e-3)

@@ -136,23 +136,33 @@ def init_params(rng: jax.Array, cfg: GPTConfig) -> Dict:
 # forward
 # ---------------------------------------------------------------------------
 
-def remat_policy(name: str):
+def remat_policy(name: str, flash: bool = False):
     """Checkpoint policy for the per-layer remat (analog of the reference's
     activation-checkpointing variants, ref:
     runtime/activation_checkpointing/checkpointing.py).
 
-    'selective' saves the tagged matmul/attention outputs (qkv, attn, mlp_pre
-    plus the flash kernel's out/lse residuals) so the backward pass only
-    recomputes layernorms, gelu and elementwise ops — the standard
-    save-dots/recompute-elementwise trade. 'full' recomputes the whole layer.
+    'selective' saves the tagged matmul/attention outputs so the backward
+    pass only recomputes layernorms, gelu and elementwise ops — the
+    standard save-dots/recompute-elementwise trade. When the flash kernel
+    is active its packed out residual ("flash_out") IS the attention
+    output, so the "attn" tag is dropped to avoid saving the same bytes
+    twice. 'flash_only' keeps just the flash residuals (~d bytes/token
+    per layer) and recomputes the cheap matmuls — the memory-lean setting
+    that fits 1.5B-class training on a 16GB chip. 'full' recomputes
+    everything.
     """
     if name == "selective":
+        names = ["qkv", "mlp_pre", "flash_out", "flash_lse"]
+        if not flash:
+            names.append("attn")
+        return jax.checkpoint_policies.save_only_these_names(*names)
+    if name == "flash_only":
         return jax.checkpoint_policies.save_only_these_names(
-            "qkv", "attn", "mlp_pre", "flash_out", "flash_lse")
+            "flash_out", "flash_lse")
     if name == "full":
         return jax.checkpoint_policies.nothing_saveable
     raise ValueError(f"unknown remat_policy {name!r} "
-                     "(expected 'selective' or 'full')")
+                     "(expected 'selective', 'flash_only' or 'full')")
 
 
 def _layernorm(x, scale, bias, eps=1e-5):
@@ -282,7 +292,9 @@ def forward(params: Dict, tokens: jnp.ndarray, cfg: GPTConfig,
         return (y, r), None
 
     if cfg.remat:
-        body = jax.checkpoint(body, policy=remat_policy(cfg.remat_policy))
+        body = jax.checkpoint(
+            body, policy=remat_policy(cfg.remat_policy,
+                                       flash=cfg.use_flash_attention))
 
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     (x, _), _ = jax.lax.scan(body, (x, rng), (block, jnp.arange(L)))
@@ -471,7 +483,8 @@ def layered_model(cfg: GPTConfig):
     return LayeredModel(split_params=split_params, embed_fn=embed_fn,
                         layer_fn=layer_fn, head_fn=head_fn,
                         n_layers=cfg.n_layers,
-                        layer_remat_policy=(remat_policy(cfg.remat_policy)
+                        layer_remat_policy=(remat_policy(cfg.remat_policy,
+                                                         flash=cfg.use_flash_attention)
                                             if cfg.remat else None))
 
 
@@ -531,8 +544,15 @@ def num_params(cfg: GPTConfig) -> int:
     return n
 
 
-def train_flops_per_token(cfg: GPTConfig, seq_len: int) -> float:
-    """6*N + attention flops per token (fwd+bwd), PaLM-style accounting."""
-    N = num_params(cfg) - cfg.vocab_size * cfg.d_model  # non-embedding
+def train_flops_per_token(cfg: GPTConfig, seq_len: int,
+                          include_head: bool = True) -> float:
+    """Model flops per token, fwd+bwd — Megatron-LM-style accounting
+    (the reference's own lineage): 6*N_matmul + attention, where N_matmul
+    counts every matmul parameter including the logit projection (for tied
+    embeddings the d*V head matmul is real compute even though the weight
+    is shared with wte)."""
+    N = num_params(cfg) - cfg.vocab_size * cfg.d_model  # drop wte lookup
+    if cfg.tie_embeddings and include_head:
+        N += cfg.d_model * cfg.vocab_size  # the tied logit matmul
     attn = 12 * cfg.n_layers * cfg.d_model * seq_len
     return 6.0 * N + attn

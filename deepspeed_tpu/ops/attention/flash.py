@@ -337,14 +337,23 @@ def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_kv):
     o, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_kv)
     # named so a selective remat policy can keep the residuals — without
     # these, jax.checkpoint re-runs the whole forward kernel in the backward
-    # pass just to regenerate o/lse
-    o = checkpoint_name(o, "flash_out")
+    # pass just to regenerate o/lse. The o residual is stored with (H, D)
+    # merged into one 128-aligned trailing axis: saving it in the kernel's
+    # [B, H, S, D] layout would tile D=64 up to 128 lanes — 2x the HBM for
+    # every checkpointed layer.
+    B, H, S, D = o.shape
+    o_res = o.transpose(0, 2, 1, 3).reshape(B, S, H * D)
+    o_res = checkpoint_name(o_res, "flash_out")
     lse = checkpoint_name(lse, "flash_lse")
-    return o, (q, k, v, o, lse)
+    return o, (q, k, v, o_res, lse)
 
 
 def _flash_vjp_bwd(causal, scale, block_q, block_kv, res, g):
-    return _flash_bwd(causal, scale, block_q, block_kv, res, g)
+    q, k, v, o_res, lse = res
+    B, H, S, D = q.shape
+    o = o_res.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+    return _flash_bwd(causal, scale, block_q, block_kv,
+                      (q, k, v, o, lse), g)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)

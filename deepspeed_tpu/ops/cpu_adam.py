@@ -105,6 +105,19 @@ class DeepSpeedCPUAdagrad:
             self.lr if lr is None else lr, self.eps, self.weight_decay)
         return st["step"]
 
+    def state_arrays(self, key):
+        st = self.state[key]
+        # exp_avg slot kept for checkpoint-format uniformity with Adam
+        return {"exp_avg": np.zeros(0, np.float32),
+                "exp_avg_sq": st["exp_avg_sq"]}
+
+    def load_state(self, key, step: int, exp_avg: np.ndarray,
+                   exp_avg_sq: np.ndarray):
+        del exp_avg  # adagrad has no first moment
+        self.state[key] = {
+            "step": int(step),
+            "exp_avg_sq": np.ascontiguousarray(exp_avg_sq, np.float32)}
+
 
 def lamb_trust_ratio(lib, params: np.ndarray, update: np.ndarray) -> float:
     """||w|| / ||update|| via the native reduction (ref:

@@ -55,7 +55,11 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
 
     # host-resident optimizer state (ZeRO-Offload): fp32 masters + moments
     # (analog of the per-DP-rank optim_states.pt shards, engine.py:2327)
-    if getattr(engine, "offload_enabled", False) and jax.process_index() == 0:
+    if getattr(engine, "offload_enabled", False):
+        # per-process shard file: each process consolidates the shards it
+        # can address (the analog of the reference's per-DP-rank
+        # zero_pp_rank_X_..._optim_states.pt files, engine.py:2327). On a
+        # single host this is one file holding the full global state.
         sd = engine.host_optimizer.state_dict()
         arrays = {"step": np.asarray(sd["step"])}
         for i, m in enumerate(sd["master"]):
@@ -63,7 +67,9 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         for key, st in sd["state"].items():
             arrays[f"exp_avg_{key}"] = st["exp_avg"]
             arrays[f"exp_avg_sq_{key}"] = st["exp_avg_sq"]
-        np.savez(os.path.join(path, "host_optim_states.npz"), **arrays)
+        fname = (f"host_optim_states_p{jax.process_index()}.npz"
+                 if jax.process_count() > 1 else "host_optim_states.npz")
+        np.savez(os.path.join(path, fname), **arrays)
 
     meta = {
         "tag": tag,
@@ -133,7 +139,12 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     opt_state = restored["opt_state"] if load_optimizer_states else state.opt_state
 
     if getattr(engine, "offload_enabled", False):
-        host_path = os.path.join(path, "host_optim_states.npz")
+        # prefer this process's shard file (multi-host save), fall back to
+        # the single-host consolidated file
+        host_path = os.path.join(
+            path, f"host_optim_states_p{jax.process_index()}.npz")
+        if not os.path.isfile(host_path):
+            host_path = os.path.join(path, "host_optim_states.npz")
         if load_optimizer_states and os.path.isfile(host_path):
             z = np.load(host_path)
             n = len(engine.host_optimizer.master)

@@ -61,11 +61,19 @@ class FP16Config:
 @dataclass
 class BF16Config:
     enabled: bool = False
+    # memory-efficient mode: bf16 master weights (stochastic-rounding
+    # updates) + bf16 Adam moments — 8 bytes/param of training state
+    # instead of 16+. The capability that fits GPT-2 1.5B's full training
+    # state in one 16GB chip (the role fp32 masters + offload play in the
+    # reference, ref runtime/bf16_optimizer.py:75, at 2x the memory).
+    memory_efficient: bool = False
 
     @staticmethod
     def from_dict(d: Dict) -> "BF16Config":
-        return BF16Config(enabled=get_scalar_param(d, C.BFLOAT16_ENABLED,
-                                                   C.BFLOAT16_ENABLED_DEFAULT))
+        return BF16Config(
+            enabled=get_scalar_param(d, C.BFLOAT16_ENABLED,
+                                     C.BFLOAT16_ENABLED_DEFAULT),
+            memory_efficient=get_scalar_param(d, "memory_efficient", False))
 
 
 @dataclass

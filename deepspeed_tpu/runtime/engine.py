@@ -157,6 +157,14 @@ class DeepSpeedEngine:
         self.fp16_enabled = config.fp16.enabled
         self.bf16_enabled = config.bf16.enabled
         self.dynamic_loss_scale = config.fp16.dynamic_loss_scale
+        # memory-efficient bf16: bf16 masters (stochastic-rounding update)
+        # + bf16 Adam moments (see BF16Config.memory_efficient)
+        self.memory_efficient_bf16 = (config.bf16.enabled
+                                      and config.bf16.memory_efficient)
+        if config.bf16.memory_efficient and not config.bf16.enabled:
+            raise ValueError("bf16.memory_efficient requires bf16.enabled")
+        self.master_dtype = (jnp.bfloat16 if self.memory_efficient_bf16
+                             else jnp.float32)
 
         # --- shardings ------------------------------------------------
         self.partition_rules = list(partition_rules or [])
@@ -174,20 +182,14 @@ class DeepSpeedEngine:
         # only compute-dtype params on device
         self.offload_enabled = (config.zero.offload_optimizer.enabled
                                 and optimizer is None)
-        if self.offload_enabled and jax.process_count() > 1:
-            # the host step needs fully-addressable grads; multi-host
-            # offload requires per-process shard handling (future work)
-            raise NotImplementedError(
-                "offload_optimizer currently supports single-host meshes; "
-                "multi-host offload needs per-process grad shard handling")
         if self.offload_enabled:
             self._configure_offload_optimizer(params)
             self.optimizer = None
             opt_state = None
-            params = jax.device_put(
-                self.host_optimizer.device_params(), self.param_shardings)
+            # device_params() already assembles onto the mesh shardings
+            params = self.host_optimizer.device_params()
         else:
-            params = jax.device_put(_cast_tree(params, jnp.float32),
+            params = jax.device_put(_cast_tree(params, self.master_dtype),
                                     self.param_shardings)
             self.optimizer = optimizer if optimizer is not None \
                 else self._configure_basic_optimizer()
@@ -351,7 +353,14 @@ class DeepSpeedEngine:
             if name == C.ADAMW_OPTIMIZER:
                 adam_w_mode = True
             return fused_adam(lr, b1=betas[0], b2=betas[1], eps=eps,
-                              weight_decay=wd, adam_w_mode=adam_w_mode)
+                              weight_decay=wd, adam_w_mode=adam_w_mode,
+                              state_dtype=(jnp.bfloat16 if
+                                           self.memory_efficient_bf16
+                                           else None))
+        if self.memory_efficient_bf16:
+            raise ValueError(
+                "bf16.memory_efficient supports the Adam family only "
+                f"(got optimizer {name!r})")
         if name in (C.LAMB_OPTIMIZER, C.FUSED_LAMB_OPTIMIZER):
             return fused_lamb(lr, b1=betas[0], b2=betas[1],
                               eps=p.get("eps", 1e-6), weight_decay=wd,
@@ -383,9 +392,11 @@ class DeepSpeedEngine:
         ocfg = self.config.optimizer
         name = (ocfg.type or C.ADAMW_OPTIMIZER).lower()
         if name not in (C.ADAM_OPTIMIZER, C.ADAMW_OPTIMIZER,
-                        C.FUSED_ADAM_OPTIMIZER, C.CPU_ADAM_OPTIMIZER):
+                        C.FUSED_ADAM_OPTIMIZER, C.CPU_ADAM_OPTIMIZER,
+                        C.ADAGRAD_OPTIMIZER):
             raise ValueError(
-                f"offload_optimizer supports the Adam family, got {name}")
+                "offload_optimizer supports the Adam family and Adagrad, "
+                f"got {name}")
         p = dict(ocfg.params or {})
         off = self.config.zero.offload_optimizer
         nvme = off.nvme_path if off.device == C.OFFLOAD_DEVICE_NVME else None
@@ -399,7 +410,10 @@ class DeepSpeedEngine:
             adamw_mode=p.get("adam_w_mode", True) or name == C.ADAMW_OPTIMIZER,
             nvme_path=nvme,
             pipeline_swap=off.pipeline_read or off.pipeline_write,
-            param_dtype=self.compute_dtype)
+            param_dtype=self.compute_dtype,
+            shardings=self.param_shardings,
+            optimizer=("adagrad" if name == C.ADAGRAD_OPTIMIZER
+                       else "adam"))
 
     # ------------------------------------------------------------------
     # compressed DP gradient reduction (comm_backend_name="dcn_compressed")
@@ -507,11 +521,18 @@ class DeepSpeedEngine:
                                           scale_state, step)
                 if prescale and predivide != 1.0:
                     g = jax.tree_util.tree_map(lambda x: x / predivide, g)
-                grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, g)
+                grads_acc = jax.tree_util.tree_map(
+                    lambda a, b: (a + b.astype(a.dtype)), grads_acc, g)
                 return (grads_acc, loss_acc + loss.astype(jnp.float32), r), None
 
+            # memory-efficient mode keeps the accumulator in bf16 (half
+            # the transient grad memory — what lets 1.5B-class training
+            # state + grads fit one 16GB chip); gas is typically 1 there,
+            # so fp32 accumulation buys nothing
+            acc_dtype = (jnp.bfloat16 if self.memory_efficient_bf16
+                         else jnp.float32)
             zeros = jax.tree_util.tree_map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                lambda p: jnp.zeros(p.shape, acc_dtype), params)
             if gas > 1:
                 micro_batches = jax.tree_util.tree_map(
                     lambda x: x.reshape((gas, x.shape[0] // gas) + x.shape[1:]),
@@ -579,6 +600,8 @@ class DeepSpeedEngine:
                                                      list(e_flat))
             return grads, mean_loss, new_error, ovf
 
+        mem_eff = self.memory_efficient_bf16
+
         def step_fn(state: TrainState, batch: PyTree):
             rng, step_rng = jax.random.split(state.rng)
 
@@ -606,7 +629,14 @@ class DeepSpeedEngine:
             def do_step(operands):
                 g, os_, p = operands
                 updates, new_os = optimizer.update(g, os_, p)
-                new_p = optax.apply_updates(p, updates)
+                if mem_eff:
+                    # bf16 masters: stochastic-rounding add so sub-ulp
+                    # updates land in expectation (ops/adam.py)
+                    from deepspeed_tpu.ops.adam import sr_apply_updates
+                    new_p = sr_apply_updates(
+                        p, updates, jax.random.fold_in(step_rng, 0x5eed))
+                else:
+                    new_p = optax.apply_updates(p, updates)
                 return new_os, new_p
 
             def skip_step(operands):
@@ -747,13 +777,11 @@ class DeepSpeedEngine:
         self.state.rng = rng
         self.state.scale_state = new_scale
         if not bool(metrics["overflow"]):
-            # device -> host grad stream, host AVX Adam, host -> device
-            # updated bf16 params (ref: stage_1_and_2.py:1005,1725)
-            new_params = self.host_optimizer.step(
-                jax.device_get(grads),
-                lr=float(self.lr_schedule(int(self.state.step))))
-            self.state.params = jax.device_put(new_params,
-                                               self.param_shardings)
+            # pipelined shard-wise d2h -> host native optimizer -> h2d;
+            # the returned tree is already placed on the mesh
+            # (ref: stage_1_and_2.py:1005,1725)
+            self.state.params = self.host_optimizer.step(
+                grads, lr=float(self.lr_schedule(int(self.state.step))))
             self.state.step = self.state.step + 1
         metrics["lr"] = jnp.asarray(self.lr_schedule(int(self.state.step)),
                                     jnp.float32)
@@ -783,6 +811,15 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # public API
     # ------------------------------------------------------------------
+    def start_trace(self, log_dir: str, steps: int = 1) -> None:
+        """Capture an XPlane trace of the next ``steps`` train_batch calls
+        into ``log_dir`` (TensorBoard/xprof readable) — the runtime analog
+        of the reference's NVTX+nsight workflow (ref: utils/nvtx.py:4,
+        docs/_tutorials/pytorch-profiler.md). See utils/trace.py."""
+        jax.block_until_ready(self.state.params)  # trace only the window
+        jax.profiler.start_trace(log_dir)
+        self._trace_steps_left = max(1, int(steps))
+
     def train_batch(self, batch: PyTree) -> Dict[str, jnp.ndarray]:
         """One full optimizer step over a global batch
         (leading dim == train_batch_size). Fuses the reference's
@@ -809,10 +846,17 @@ class DeepSpeedEngine:
             # this step (set profile_step >= 2 to exclude compile time)
             jax.block_until_ready(self.state.params)
         t0 = time.perf_counter()
-        if self.offload_enabled:
-            metrics = self._offload_train_batch(batch)
-        else:
-            self.state, metrics = self._train_step(self.state, batch)
+        from deepspeed_tpu.utils.trace import annotation
+        with annotation("ds.train_batch"):
+            if self.offload_enabled:
+                metrics = self._offload_train_batch(batch)
+            else:
+                self.state, metrics = self._train_step(self.state, batch)
+        if getattr(self, "_trace_steps_left", 0) > 0:
+            self._trace_steps_left -= 1
+            if self._trace_steps_left == 0:
+                jax.block_until_ready(metrics["loss"])
+                jax.profiler.stop_trace()
         if profiling_now:
             # block only on the profiled step — every other step keeps
             # async dispatch so the host can run ahead

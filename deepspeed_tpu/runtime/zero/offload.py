@@ -8,16 +8,28 @@ TPU-native re-engineering of the reference's offload path
 
 Architecture on TPU:
 - the DEVICE holds only compute-dtype (bf16) parameters; the fp32 master
-  weights and Adam moments live on HOST (numpy) — device HBM per param is
-  2 bytes instead of the 16 (fp32 master + m + v + param) of the fused path.
-- the jitted step computes loss + fp32 grads only; grads stream
-  device->host, the native AVX Adam (ops/cpu_adam) updates the master
-  weights while simultaneously rounding them to bf16 into a staging buffer
-  (one memory pass), and the staged bf16 params stream host->device.
-- with ``device: nvme`` the moments live in per-leaf files and are swapped
-  through :class:`PipelinedOptimizerSwapper`, double-buffered so leaf
-  ``i+1`` reads while ``i`` computes — the reference's pipelined swapper
-  loop (pipelined_optimizer_swapper.py:60), re-timed for host cores.
+  weights and optimizer moments live on HOST — device HBM per param is
+  2 bytes instead of the 16 (fp32 master + m + v + param) of the fused
+  path.
+- masters are stored **per device shard**: each unique shard of a leaf's
+  sharding gets its own flat fp32 master + state key, so ZeRO-sharded
+  (fsdp/data-partitioned) parameters offload partition-wise exactly like
+  the reference's per-DP-rank partitions (stage_1_and_2.py:546), and on
+  multi-host meshes every process steps only the shards it can address —
+  updated leaves are rebuilt with
+  ``jax.make_array_from_single_device_arrays``, the multi-host-correct
+  assembly path.
+- the step is a 3-stage host pipeline: every shard's device->host copy is
+  launched async up front (``copy_to_host_async``), the native AVX
+  Adam/Adagrad then crunches shard-by-shard while later shards are still
+  in flight, and each updated bf16 shard's host->device DMA is enqueued
+  immediately (``jax.device_put`` is async) — transfers hide behind
+  compute in both directions, the reference's overlap design
+  (stage_1_and_2.py:1005, pipelined_optimizer_swapper.py:60) re-timed for
+  host cores.
+- with ``device: nvme`` the moments live in per-shard files and are
+  swapped through :class:`PipelinedOptimizerSwapper`, double-buffered so
+  shard ``i+1`` reads while ``i`` computes.
 """
 
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -26,18 +38,51 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deepspeed_tpu.ops.cpu_adam import DeepSpeedCPUAdam
+from deepspeed_tpu.ops.cpu_adam import DeepSpeedCPUAdagrad, DeepSpeedCPUAdam
 from deepspeed_tpu.utils.logging import logger
 
 PyTree = Any
 
 
-class HostOffloadOptimizer:
-    """Host-resident Adam over a pytree of parameters.
+def _index_key(idx: Tuple) -> str:
+    """Stable string key for a shard's global index (tuple of slices)."""
+    return ";".join(f"{s.start or 0}:{s.stop}" for s in idx)
 
-    Parameters stay leaf-partitioned (each leaf = one "subgroup" in the
-    reference's sense, stage3.py:1259 _optimizer_step loops subgroups the
-    same way).
+
+class _LeafShards:
+    """Per-leaf shard table derived from its sharding: unique shard
+    indices, the devices holding each, and the shard shapes."""
+
+    def __init__(self, shape, sharding):
+        self.shape = tuple(shape)
+        self.sharding = sharding
+        self.by_key: Dict[str, Dict] = {}
+        if sharding is None:
+            dev = jax.devices()[0]
+            self.by_key["full"] = {
+                "index": tuple(slice(0, n) for n in self.shape),
+                "devices": [dev], "shape": self.shape}
+            return
+        imap = sharding.addressable_devices_indices_map(self.shape)
+        for dev, idx in imap.items():
+            idx = tuple(idx) if idx is not None else tuple(
+                slice(0, n) for n in self.shape)
+            # normalize unbounded slices
+            idx = tuple(slice(s.start or 0,
+                              s.stop if s.stop is not None else n)
+                        for s, n in zip(idx, self.shape))
+            k = _index_key(idx)
+            ent = self.by_key.setdefault(
+                k, {"index": idx, "devices": [],
+                    "shape": tuple(s.stop - s.start for s in idx)})
+            ent["devices"].append(dev)
+
+
+class HostOffloadOptimizer:
+    """Host-resident Adam/Adagrad over a pytree of (possibly sharded)
+    parameters. Each (leaf, shard) pair is one state subgroup — the
+    analog of the reference's per-partition optimizer state
+    (stage3.py:1259 _optimizer_step loops subgroups the same way).
     """
 
     def __init__(self, params_fp32: PyTree, lr_schedule: Callable,
@@ -45,20 +90,44 @@ class HostOffloadOptimizer:
                  weight_decay: float = 0.0, adamw_mode: bool = True,
                  nvme_path: Optional[str] = None,
                  pipeline_swap: bool = True,
-                 param_dtype=jnp.bfloat16):
+                 param_dtype=jnp.bfloat16,
+                 shardings: Optional[PyTree] = None,
+                 optimizer: str = "adam"):
         self.lr_schedule = lr_schedule
-        self.adam = DeepSpeedCPUAdam(betas=betas, eps=eps,
-                                     weight_decay=weight_decay,
-                                     adamw_mode=adamw_mode)
+        self.optimizer_name = optimizer
+        if optimizer == "adagrad":
+            self.opt = DeepSpeedCPUAdagrad(eps=eps,
+                                           weight_decay=weight_decay)
+        else:
+            self.opt = DeepSpeedCPUAdam(betas=betas, eps=eps,
+                                        weight_decay=weight_decay,
+                                        adamw_mode=adamw_mode)
+        if optimizer == "adagrad" and nvme_path is not None:
+            raise ValueError(
+                "NVMe moment swapping supports Adam only (the reference's "
+                "swappable-optimizer set, ref zero/utils.py)")
         self.param_dtype = param_dtype
         leaves, self.treedef = jax.tree_util.tree_flatten(params_fp32)
-        self.shapes = [l.shape for l in leaves]
-        # flat fp32 master copies on host
-        self.master: List[np.ndarray] = [
-            np.ascontiguousarray(np.asarray(l, np.float32).ravel())
-            for l in leaves]
-        self.staging: List[np.ndarray] = [
-            np.empty(m.size, np.uint16) for m in self.master]
+        shard_leaves = (jax.tree_util.tree_leaves(shardings)
+                        if shardings is not None else [None] * len(leaves))
+        assert len(shard_leaves) == len(leaves)
+        self.shapes = [np.asarray(l).shape for l in leaves]
+        self.tables: List[_LeafShards] = []
+        # flat fp32 master copies on host, one per (leaf, unique shard)
+        self.master: List[Dict[str, np.ndarray]] = []
+        self.staging: List[Dict[str, np.ndarray]] = []
+        for l, sh, shape in zip(leaves, shard_leaves, self.shapes):
+            table = _LeafShards(shape, sh)
+            full = np.asarray(l, np.float32)
+            m: Dict[str, np.ndarray] = {}
+            st: Dict[str, np.ndarray] = {}
+            for k, ent in table.by_key.items():
+                piece = np.ascontiguousarray(full[ent["index"]].ravel())
+                m[k] = piece
+                st[k] = np.empty(piece.size, np.uint16)
+            self.tables.append(table)
+            self.master.append(m)
+            self.staging.append(st)
         self.step_count = 0
 
         self.swapper = None
@@ -70,93 +139,223 @@ class HostOffloadOptimizer:
             self.swapper = cls(nvme_path, n_tensors=2)
             # moments start as zeros on disk
             for i, m in enumerate(self.master):
-                z = np.zeros(m.size, np.float32)
-                self.swapper.swap_out(str(i), [z, z])
+                for k, piece in m.items():
+                    z = np.zeros(piece.size, np.float32)
+                    self.swapper.swap_out(f"{i}:{k}", [z, z])
         self._pipelined = pipeline_swap and self.swapper is not None
 
-    def device_params(self) -> PyTree:
-        """Compute-dtype param pytree for the device."""
-        leaves = [jnp.asarray(m.reshape(s), jnp.float32).astype(self.param_dtype)
-                  for m, s in zip(self.master, self.shapes)]
-        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+    # ------------------------------------------------------------------
+    def _assemble_leaf(self, i: int, per_key_np: Dict[str, np.ndarray]):
+        """Host shard values -> device array on the leaf's sharding
+        (multi-host correct: only addressable shards are supplied)."""
+        table = self.tables[i]
+        if table.sharding is None:
+            dev = table.by_key["full"]["devices"][0]
+            return jax.device_put(
+                per_key_np["full"].reshape(self.shapes[i]), dev)
+        arrs = []
+        for k, ent in table.by_key.items():
+            piece = per_key_np[k].reshape(ent["shape"])
+            for dev in ent["devices"]:
+                arrs.append(jax.device_put(piece, dev))
+        return jax.make_array_from_single_device_arrays(
+            self.shapes[i], table.sharding, arrs)
 
+    def device_params(self) -> PyTree:
+        """Compute-dtype param pytree placed on the mesh."""
+        out = []
+        for i, m in enumerate(self.master):
+            staged = {k: np.asarray(
+                piece.astype(np.float32), np.float32)
+                .astype(jnp.asarray(0, self.param_dtype).dtype)
+                for k, piece in m.items()}
+            out.append(self._assemble_leaf(i, staged))
+        return jax.tree_util.tree_unflatten(self.treedef, out)
+
+    # ------------------------------------------------------------------
     def step(self, grads: PyTree, lr: Optional[float] = None) -> PyTree:
-        """Apply one Adam step from host-side grads; returns the updated
-        compute-dtype param pytree (numpy-backed, ready to device_put)."""
+        """One optimizer step from (sharded) device grads; returns the
+        updated compute-dtype param pytree placed back on the mesh.
+
+        3-stage pipeline: async d2h for every shard up front, native
+        optimizer shard-by-shard, async h2d of each updated shard."""
         self.step_count += 1
         lr = float(self.lr_schedule(self.step_count - 1)) if lr is None else lr
-        glat = [np.ascontiguousarray(np.asarray(g, np.float32).ravel())
-                for g in jax.tree_util.tree_leaves(grads)]
-        assert len(glat) == len(self.master)
+        g_leaves = jax.tree_util.tree_leaves(grads)
+        assert len(g_leaves) == len(self.master)
 
-        n = len(self.master)
-        for i in range(n):
-            key = str(i)
-            if self.swapper is not None:
-                m, v = self.swapper.swap_in(key)
-                self.adam.load_state(key, self.step_count - 1, m, v)
-                if self._pipelined and i + 1 < n:
-                    self.swapper.prefetch(str(i + 1))
-            self.adam.step(key, self.master[i], glat[i], lr=lr,
-                           params_bf16_out=self.staging[i])
-            if self.swapper is not None:
-                st = self.adam.state_arrays(key)
-                if self._pipelined:
-                    self.swapper.swap_out_async(
-                        key, [st["exp_avg"], st["exp_avg_sq"]])
+        # stage 1: launch every shard's d2h copy (non-blocking)
+        shard_data: List[Dict[str, Any]] = []
+        for g, table in zip(g_leaves, self.tables):
+            d: Dict[str, Any] = {}
+            if isinstance(g, jax.Array):
+                for sh in g.addressable_shards:
+                    idx = tuple(slice(s.start or 0,
+                                      s.stop if s.stop is not None
+                                      else n)
+                                for s, n in zip(sh.index, g.shape))
+                    k = _index_key(idx)
+                    if k not in d and k in table.by_key:
+                        try:
+                            sh.data.copy_to_host_async()
+                        except Exception:
+                            pass
+                        d[k] = sh.data
+                if len(d) != len(table.by_key):
+                    # grad sharding does not line up with the param shard
+                    # table (e.g. replicated grads over sharded params):
+                    # fall back to slicing the global value, loudly
+                    # correct rather than silently wrong
+                    full = np.asarray(g, np.float32)
+                    d = {k: full[ent["index"]]
+                         for k, ent in table.by_key.items()}
+            else:
+                full = np.asarray(g, np.float32)
+                for k, ent in table.by_key.items():
+                    d[k] = full[ent["index"]]
+            shard_data.append(d)
+
+        # stage 2+3: native optimizer per shard; h2d enqueued immediately
+        out_leaves = []
+        bf16 = jnp.asarray(0, jnp.bfloat16).dtype
+        n_items = len(self.master)
+        for i in range(n_items):
+            table = self.tables[i]
+            staged_np: Dict[str, np.ndarray] = {}
+            for k in table.by_key:
+                skey = f"{i}:{k}"
+                mst = self.master[i][k]
+                g_np = np.ascontiguousarray(
+                    np.asarray(shard_data[i][k], np.float32).ravel())
+                assert g_np.size == mst.size, (
+                    f"grad shard {skey}: {g_np.size} elems vs master "
+                    f"{mst.size} — grad/param sharding mismatch")
+                if self.swapper is not None:
+                    m, v = self.swapper.swap_in(skey)
+                    self.opt.load_state(skey, self.step_count - 1, m, v)
+                    nxt = self._next_swap_key(i, k)
+                    if self._pipelined and nxt is not None:
+                        self.swapper.prefetch(nxt)
+                if self.optimizer_name == "adagrad":
+                    self.opt.step(skey, mst, g_np, lr=lr)
+                    stg = mst.astype(bf16)
                 else:
-                    self.swapper.swap_out(
-                        key, [st["exp_avg"], st["exp_avg_sq"]])
-                # free host copies of the moments — they live on NVMe now
-                del self.adam.state[key]
+                    self.opt.step(skey, mst, g_np, lr=lr,
+                                  params_bf16_out=self.staging[i][k])
+                    stg = self.staging[i][k].view(bf16)
+                if self.param_dtype == jnp.bfloat16:
+                    staged_np[k] = stg
+                else:
+                    staged_np[k] = mst.astype(np.dtype(self.param_dtype))
+                if self.swapper is not None:
+                    st = self.opt.state_arrays(skey)
+                    payload = [st["exp_avg"], st["exp_avg_sq"]]
+                    if self._pipelined:
+                        self.swapper.swap_out_async(skey, payload)
+                    else:
+                        self.swapper.swap_out(skey, payload)
+                    del self.opt.state[skey]
+            out_leaves.append(self._assemble_leaf(i, staged_np))
         if self.swapper is not None and self._pipelined:
             self.swapper.finish()
+        return jax.tree_util.tree_unflatten(self.treedef, out_leaves)
 
-        if self.param_dtype == jnp.bfloat16:
-            leaves = [s.view(jnp.bfloat16.dtype).reshape(shape)
-                      for s, shape in zip(self.staging, self.shapes)]
-        else:
-            leaves = [m.astype(np.dtype(self.param_dtype)).reshape(shape)
-                      for m, shape in zip(self.master, self.shapes)]
-        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+    def _next_swap_key(self, i: int, k: str) -> Optional[str]:
+        keys = list(self.tables[i].by_key)
+        j = keys.index(k)
+        if j + 1 < len(keys):
+            return f"{i}:{keys[j+1]}"
+        if i + 1 < len(self.tables):
+            return f"{i+1}:{list(self.tables[i+1].by_key)[0]}"
+        return None
 
+    # ------------------------------------------------------------------
     def reset_from_params(self, params: PyTree):
         """Re-seed the fp32 masters from a (restored) param pytree and zero
         the moments — used when a checkpoint has no host optimizer state."""
         leaves = jax.tree_util.tree_leaves(params)
         assert len(leaves) == len(self.master)
-        self.master = [
-            np.ascontiguousarray(np.asarray(l, np.float32).ravel())
-            for l in leaves]
-        self.adam.state.clear()
+        for i, (l, table) in enumerate(zip(leaves, self.tables)):
+            full = np.asarray(l, np.float32)
+            for k, ent in table.by_key.items():
+                self.master[i][k] = np.ascontiguousarray(
+                    full[ent["index"]].ravel())
+        self.opt.state.clear()
         if self.swapper is not None:
             for i, m in enumerate(self.master):
-                z = np.zeros(m.size, np.float32)
-                self.swapper.swap_out(str(i), [z, z])
+                for k, piece in m.items():
+                    z = np.zeros(piece.size, np.float32)
+                    self.swapper.swap_out(f"{i}:{k}", [z, z])
 
     # --- checkpointing hooks -----------------------------------------
+    def _global_master(self, i: int) -> np.ndarray:
+        """Assemble the full fp32 master for leaf i from its shards
+        (host-side consolidation, the zero_to_fp32 analog)."""
+        full = np.zeros(self.shapes[i], np.float32)
+        for k, ent in self.tables[i].by_key.items():
+            full[ent["index"]] = self.master[i][k].reshape(ent["shape"])
+        return full.ravel()
+
+    def _global_moment(self, i: int, which: str) -> np.ndarray:
+        """Assemble a full per-leaf moment from its shard states —
+        checkpoints are topology-INDEPENDENT (elastic: saved at any shard
+        layout, restorable at any other, matching the reference's elastic
+        ZeRO checkpoints, stage_1_and_2.py:2074)."""
+        full = np.zeros(self.shapes[i], np.float32)
+        for k, ent in self.tables[i].by_key.items():
+            skey = f"{i}:{k}"
+            if self.swapper is not None and self.swapper.has_state(skey):
+                m, v = self.swapper.swap_in(skey)
+                piece = m if which == "exp_avg" else v
+            elif skey in self.opt.state:
+                st = self.opt.state[skey]
+                piece = st.get(which)
+                if piece is None or piece.size == 0:
+                    continue
+            else:
+                continue
+            full[ent["index"]] = np.asarray(piece, np.float32).reshape(
+                ent["shape"])
+        return full.ravel()
+
     def state_dict(self) -> Dict:
         states = {}
         for i in range(len(self.master)):
-            key = str(i)
-            if self.swapper is not None and self.swapper.has_state(key):
-                m, v = self.swapper.swap_in(key)
-            elif key in self.adam.state:
-                st = self.adam.state[key]
-                m, v = st["exp_avg"], st["exp_avg_sq"]
-            else:
-                m = v = np.zeros(self.master[i].size, np.float32)
-            states[key] = {"exp_avg": np.array(m), "exp_avg_sq": np.array(v)}
-        return {"step": self.step_count, "master": self.master,
+            states[str(i)] = {
+                "exp_avg": self._global_moment(i, "exp_avg"),
+                "exp_avg_sq": self._global_moment(i, "exp_avg_sq")}
+        return {"step": self.step_count,
+                "master": [self._global_master(i)
+                           for i in range(len(self.master))],
                 "state": states}
 
     def load_state_dict(self, sd: Dict):
         self.step_count = int(sd["step"])
-        self.master = [np.ascontiguousarray(m, np.float32)
-                       for m in sd["master"]]
+        for i, flat in enumerate(sd["master"]):
+            full = np.asarray(flat, np.float32).reshape(self.shapes[i])
+            for k, ent in self.tables[i].by_key.items():
+                self.master[i][k] = np.ascontiguousarray(
+                    full[ent["index"]].ravel())
         for key, st in sd["state"].items():
-            if self.swapper is not None:
-                self.swapper.swap_out(key, [st["exp_avg"], st["exp_avg_sq"]])
-            else:
-                self.adam.load_state(key, self.step_count, st["exp_avg"],
-                                     st["exp_avg_sq"])
+            i = int(key)
+            m_full = np.asarray(st["exp_avg"], np.float32)
+            v_full = np.asarray(st["exp_avg_sq"], np.float32)
+            m_full = m_full.reshape(self.shapes[i]) if m_full.size else None
+            v_full = v_full.reshape(self.shapes[i])
+            for k2, ent in self.tables[i].by_key.items():
+                skey = f"{i}:{k2}"
+                v_piece = np.ascontiguousarray(
+                    v_full[ent["index"]].ravel())
+                m_piece = (np.ascontiguousarray(
+                    m_full[ent["index"]].ravel()) if m_full is not None
+                    else np.zeros_like(v_piece))
+                if self.swapper is not None:
+                    self.swapper.swap_out(skey, [m_piece, v_piece])
+                else:
+                    self.opt.load_state(skey, self.step_count, m_piece,
+                                        v_piece)
+
+    # back-compat: some callers poke .adam directly
+    @property
+    def adam(self):
+        return self.opt

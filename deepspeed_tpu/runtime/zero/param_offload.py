@@ -488,6 +488,13 @@ class InfinityParamEngine:
     def state_dict(self) -> Dict:
         states = {}
         for gi in range(self.n_groups):
+            if self.swapper is not None:
+                # moments live on NVMe concatenated per group — pull them
+                # back so the checkpoint is self-contained
+                if self.swapper.has_state(f"G{gi}"):
+                    m, v = self.swapper.swap_in(f"G{gi}")
+                    states[f"G{gi}"] = {"m": np.array(m), "v": np.array(v)}
+                continue
             for j in range(len(self.master[gi])):
                 key = f"G{gi}.{j}"
                 if key in self.adam.state:
@@ -516,5 +523,35 @@ class InfinityParamEngine:
         self.other_master = [np.ascontiguousarray(f, np.float32)
                              for f in sd["other_master"]]
         self.other_dev = self._other_to_device()
+        # moment entries come in two layouts — per-leaf keys "G{gi}.{j}"
+        # (host tier) or concatenated-per-group keys "G{gi}" (NVMe tier).
+        # Translate whichever we get into THIS engine's tier so cross-tier
+        # restores keep their moments instead of silently resetting.
+        concat: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
         for key, st in sd.get("adam", {}).items():
-            self.adam.load_state(key, self.step_count, st["m"], st["v"])
+            m = np.ascontiguousarray(st["m"], np.float32)
+            v = np.ascontiguousarray(st["v"], np.float32)
+            if key.startswith("G") and "." not in key:
+                concat[int(key[1:])] = (m, v)
+            elif key.startswith("G") and self.swapper is not None:
+                gi, j = (int(x) for x in key[1:].split("."))
+                cm, cv = concat.setdefault(gi, (
+                    np.zeros(sum(f.size for f in self.master[gi]),
+                             np.float32),
+                    np.zeros(sum(f.size for f in self.master[gi]),
+                             np.float32)))
+                off = sum(f.size for f in self.master[gi][:j])
+                cm[off:off + m.size] = m
+                cv[off:off + v.size] = v
+            else:
+                self.adam.load_state(key, self.step_count, m, v)
+        for gi, (cm, cv) in concat.items():
+            if self.swapper is not None:
+                self.swapper.swap_out(f"G{gi}", [cm, cv])
+            else:
+                off = 0
+                for j, f in enumerate(self.master[gi]):
+                    self.adam.load_state(f"G{gi}.{j}", self.step_count,
+                                         cm[off:off + f.size],
+                                         cv[off:off + f.size])
+                    off += f.size

@@ -1,0 +1,70 @@
+"""Debug helpers: parameter/module name mapping for prints and probes.
+
+Capability analog of the reference's debug module
+(ref: deepspeed/utils/debug.py:144 LoC —
+debug_extract_module_and_param_names called at runtime/engine.py:218,
+plus rank-gated param printers used while bringing up ZeRO). The torch
+version walks nn.Module attributes; the pytree-native version walks
+key paths.
+"""
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+PyTree = Any
+
+
+def param_names(tree: PyTree) -> Dict[str, Any]:
+    """Flat {'a/b/c': leaf} mapping of a parameter pytree (the
+    param->name map the reference builds at engine init,
+    ref utils/debug.py debug_extract_module_and_param_names)."""
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        out["/".join(_key_str(k) for k in path)] = leaf
+    return out
+
+
+def _key_str(k) -> str:
+    if hasattr(k, "key"):
+        return str(k.key)
+    if hasattr(k, "idx"):
+        return str(k.idx)
+    return str(k)
+
+
+def module_summary(tree: PyTree, max_rows: int = 0) -> str:
+    """Human-readable table: name, shape, dtype, #params, sharding."""
+    rows: List[Tuple[str, str, str, int, str]] = []
+    for name, leaf in param_names(tree).items():
+        arr = np.asarray(jax.eval_shape(lambda: leaf)) \
+            if not hasattr(leaf, "shape") else leaf
+        sh = getattr(leaf, "sharding", None)
+        spec = getattr(sh, "spec", "") if sh is not None else ""
+        rows.append((name, str(tuple(arr.shape)), str(arr.dtype),
+                     int(np.prod(arr.shape)) if arr.shape else 1,
+                     str(spec)))
+    if max_rows:
+        rows = rows[:max_rows]
+    total = sum(r[3] for r in rows)
+    w = max((len(r[0]) for r in rows), default=4)
+    lines = [f"{'name':<{w}}  shape            dtype     params      spec"]
+    for name, shape, dtype, n, spec in rows:
+        lines.append(f"{name:<{w}}  {shape:<15}  {dtype:<8}  {n:>10,}  {spec}")
+    lines.append(f"total parameters: {total:,}")
+    return "\n".join(lines)
+
+
+def debug_param(tree: PyTree, name: str,
+                summarize: int = 3) -> Optional[str]:
+    """One-leaf probe: stats + corner values (the rank-gated
+    print_ helpers' role in the reference's debug module)."""
+    leaf = param_names(tree).get(name)
+    if leaf is None:
+        return None
+    a = np.asarray(leaf, np.float32)
+    head = a.ravel()[:summarize]
+    return (f"{name}: shape={tuple(a.shape)} dtype={a.dtype} "
+            f"mean={a.mean():.3e} std={a.std():.3e} "
+            f"absmax={np.abs(a).max():.3e} head={head.tolist()}")

@@ -1,0 +1,65 @@
+"""Runtime trace capture + hot-path annotation.
+
+TPU analog of the reference's NVTX instrumentation
+(ref: deepspeed/utils/nvtx.py:4 instrument_w_nvtx, applied across
+zero/coordinator hot paths) and its pointer to torch.profiler
+(ref docs/_tutorials/pytorch-profiler.md). On TPU the equivalents are:
+
+- ``jax.named_scope`` — names traced ops so they appear as annotated
+  regions in the compiled program's XPlane timeline (device side),
+- ``jax.profiler.TraceAnnotation`` — host-side trace ranges,
+- ``jax.profiler.trace`` — XPlane/TensorBoard trace capture of a window
+  of steps (view with ``tensorboard --logdir`` or xprof).
+
+Usage::
+
+    from deepspeed_tpu.utils import trace
+
+    @trace.instrument()           # device scope when traced, host range
+    def hot_path(...): ...
+
+    with trace.capture("/tmp/tb"):   # one XPlane capture window
+        engine.train_batch(batch)
+
+or let the engine drive it: ``engine.start_trace(log_dir, steps=3)``
+captures the next 3 train_batch calls.
+"""
+
+import contextlib
+import functools
+from typing import Optional
+
+import jax
+
+
+def instrument(name: Optional[str] = None):
+    """Decorator naming a function in both device (named_scope) and host
+    (TraceAnnotation) timelines — the instrument_w_nvtx analog."""
+
+    def deco(fn):
+        scope = name or fn.__qualname__
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            with jax.named_scope(scope), \
+                    jax.profiler.TraceAnnotation(scope):
+                return fn(*args, **kwargs)
+
+        return wrapped
+
+    return deco
+
+
+def annotation(name: str):
+    """Host-side trace range context manager (NVTX push/pop analog)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+@contextlib.contextmanager
+def capture(log_dir: str):
+    """Capture an XPlane trace of the enclosed block into ``log_dir``."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,21 @@
+"""Debug helpers (ref: deepspeed/utils/debug.py param-name mapping)."""
+
+import numpy as np
+
+from deepspeed_tpu.utils import debug
+
+
+def test_param_names_and_summary():
+    tree = {"wte": {"embedding": np.ones((4, 8), np.float32)},
+            "block": {"qkv": {"kernel": np.zeros((2, 8, 24), np.float32)}}}
+    names = debug.param_names(tree)
+    assert set(names) == {"wte/embedding", "block/qkv/kernel"}
+    s = debug.module_summary(tree)
+    assert "total parameters: 416" in s
+
+
+def test_debug_param_probe():
+    tree = {"w": np.full((3, 3), 2.0, np.float32)}
+    p = debug.debug_param(tree, "w")
+    assert "mean=2.000e+00" in p
+    assert debug.debug_param(tree, "missing") is None

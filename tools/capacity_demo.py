@@ -1,0 +1,69 @@
+"""Capacity demo: train a GPT larger than device HBM via the ZeRO-Infinity
+parameter tier (runtime/zero/param_offload.py).
+
+Proof analog of the reference's "13B params on one 32GB V100"
+(ref docs/_pages/features.md:116). Prints one JSON line per step and a
+final summary with peak params/chip.
+
+Usage: python tools/capacity_demo.py [preset] [steps] [micro_batch] [seq]
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def main():
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt
+
+    preset = sys.argv[1] if len(sys.argv) > 1 else "gpt2-4b"
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 2
+    batch = int(sys.argv[3]) if len(sys.argv) > 3 else 4
+    seq = int(sys.argv[4]) if len(sys.argv) > 4 else 1024
+
+    on_tpu = "tpu" in (jax.devices()[0].platform +
+                       jax.devices()[0].device_kind).lower()
+    cfg = gpt.preset(preset, max_seq_len=seq, dtype=jnp.bfloat16,
+                     remat=True, use_flash_attention=on_tpu,
+                     flash_block_q=512, flash_block_kv=512)
+    fac = gpt.host_param_factory(0, cfg)
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt.layered_model(cfg), model_parameters=fac,
+        config={
+            "train_batch_size": batch,
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 3,
+                                  "offload_param": {"device": "cpu"}},
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+        })
+    r = np.random.default_rng(0)
+    data = {"tokens": r.integers(0, cfg.vocab_size,
+                                 (batch, seq + 1)).astype(np.int32)}
+    for i in range(steps):
+        t0 = time.perf_counter()
+        m = eng.train_batch(data)
+        print(json.dumps({
+            "step": i, "loss": round(m["loss"], 4),
+            "grad_norm": round(m["grad_norm"], 3),
+            "step_s": round(time.perf_counter() - t0, 1)}), flush=True)
+    print(json.dumps({
+        "metric": "peak_params_per_chip_with_offload",
+        "value": eng.n_params,
+        "model": preset,
+        "n_layers": cfg.n_layers, "d_model": cfg.d_model,
+        "device": jax.devices()[0].device_kind,
+        "device_working_set_gb": round(
+            eng.device_memory_bytes() / 1e9, 2),
+        "groups": eng.n_groups, "group_size": eng.group_size,
+    }))
+
+
+if __name__ == "__main__":
+    main()

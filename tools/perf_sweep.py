@@ -8,86 +8,46 @@ reference's perf sweep scripts, ref: tests/model/Megatron_GPT2/run_perf*).
 Usage: python tools/perf_sweep.py [preset] [steps]
 """
 
-import itertools
 import json
 import sys
-import time
 
 import jax
-import jax.numpy as jnp
-import numpy as np
 
 sys.path.insert(0, ".")
 
-
-def time_config(preset_name, batch, seq, bq, bkv, remat_policy, steps=10,
-                remat=True, zero_stage=1):
-    import deepspeed_tpu
-    from deepspeed_tpu.models import gpt
-
-    on_tpu = "tpu" in (jax.devices()[0].platform +
-                       jax.devices()[0].device_kind).lower()
-    cfg = gpt.preset(preset_name, max_seq_len=seq, dtype=jnp.bfloat16,
-                     remat=remat, remat_policy=remat_policy,
-                     use_flash_attention=on_tpu,
-                     flash_block_q=bq, flash_block_kv=bkv)
-    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
-    ds_config = {
-        "train_batch_size": batch,
-        "bf16": {"enabled": True},
-        "zero_optimization": {"stage": zero_stage},
-        "optimizer": {"type": "adamw", "params": {"lr": 1e-4,
-                                                  "weight_decay": 0.1}},
-        "steps_per_print": 10_000,
-    }
-    engine, _, _, _ = deepspeed_tpu.initialize(
-        model=gpt.make_loss_fn(cfg), model_parameters=params,
-        config=ds_config)
-    tokens = np.random.default_rng(0).integers(
-        0, cfg.vocab_size, (batch, seq + 1)).astype(np.int32)
-    data = {"tokens": tokens}
-    jax.block_until_ready(engine.train_batch(data))
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        m = engine.train_batch(data)
-    jax.block_until_ready(m["loss"])
-    dt = (time.perf_counter() - t0) / steps
-    tps = batch * seq / dt
-    mfu = tps * gpt.train_flops_per_token(cfg, seq) / 197e12
-    del engine, params
-    return dt, tps, mfu
+from bench import run_config  # noqa: E402
 
 
 def main():
     preset = sys.argv[1] if len(sys.argv) > 1 else "gpt2-medium"
     steps = int(sys.argv[2]) if len(sys.argv) > 2 else 10
     seq = 1024
+    on_tpu = "tpu" in (jax.devices()[0].platform +
+                       jax.devices()[0].device_kind).lower()
     grid = [
-        # (batch, bq, bkv, remat, policy)
-        (8, 512, 512, True, "selective"),    # round-1 bench config
-        (16, 512, 512, True, "selective"),
-        (32, 512, 512, True, "selective"),
-        (16, 256, 512, True, "selective"),
-        (16, 512, 1024, True, "selective"),
-        (16, 1024, 512, True, "selective"),
-        (16, 256, 256, True, "selective"),
-        (16, 512, 512, True, "full"),
-        (16, 512, 512, False, "selective"),
+        # (batch, flash_block, extra ds-config)
+        (8, 512, {}),
+        (16, 512, {}),
+        (32, 512, {}),
+        (16, 256, {}),
+        (16, 1024, {}),
+        (16, 512, {"bf16": {"enabled": True, "memory_efficient": True}}),
     ]
-    for batch, bq, bkv, remat, pol in grid:
+    for batch, fb, extra in grid:
+        overrides = {"zero_optimization": {"stage": 1}}
+        overrides.update(extra)
         try:
-            dt, tps, mfu = time_config(preset, batch, seq, bq, bkv, pol,
-                                       steps=steps, remat=remat)
+            dt, tps, mfu = run_config(preset, batch, seq, steps,
+                                      overrides, on_tpu, flash_block=fb)
             print(json.dumps({
-                "preset": preset, "batch": batch, "bq": bq, "bkv": bkv,
-                "remat": remat, "policy": pol,
+                "preset": preset, "batch": batch, "flash_block": fb,
+                "extra": extra,
                 "step_ms": round(dt * 1e3, 2),
                 "tokens_per_s": round(tps, 1), "mfu": round(mfu, 4)}),
                 flush=True)
         except Exception as e:  # OOM etc — report and continue
             print(json.dumps({
-                "preset": preset, "batch": batch, "bq": bq, "bkv": bkv,
-                "remat": remat, "policy": pol,
+                "preset": preset, "batch": batch, "flash_block": fb,
                 "error": repr(e)[:200]}), flush=True)
 
 
