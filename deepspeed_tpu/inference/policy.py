@@ -316,6 +316,173 @@ class HFBertPolicy:
         return cfg, params
 
 
+@register_policy("hf_distilbert")
+class HFDistilBertPolicy:
+    """HuggingFace DistilBERT -> fused encoder layout
+    (ref: HFDistilBertLayerPolicy in replace_policy.py). Post-LN like
+    BERT; no token-type embeddings (a 1-row zero table keeps the fused
+    encoder's segment lookup a no-op) and separate q/k/v projections."""
+
+    @staticmethod
+    def matches(model) -> bool:
+        return type(model).__name__ in ("DistilBertModel",
+                                        "DistilBertForMaskedLM")
+
+    @staticmethod
+    def convert(model):
+        import jax.numpy as jnp
+        from deepspeed_tpu.models.bert import BertConfig
+        hf_cfg = model.config
+        cfg = BertConfig(
+            vocab_size=hf_cfg.vocab_size,
+            n_layers=hf_cfg.n_layers,
+            n_heads=hf_cfg.n_heads,
+            d_model=hf_cfg.dim,
+            max_seq_len=hf_cfg.max_position_embeddings,
+            type_vocab_size=1,
+            layer_norm_eps=1e-12,
+            pre_layer_norm=False)
+        sd = {k: v.detach().cpu().numpy()
+              for k, v in model.state_dict().items()}
+        pre = "distilbert." if any(k.startswith("distilbert.")
+                                   for k in sd) else ""
+        L, d = cfg.n_layers, cfg.d_model
+        lay = pre + "transformer.layer.{}."
+
+        def lin(fmt):
+            return np.stack([sd[(lay + fmt).format(i)].T for i in range(L)])
+
+        def vec(fmt):
+            return np.stack([sd[(lay + fmt).format(i)] for i in range(L)])
+
+        qkv_k = np.concatenate([lin("attention.q_lin.weight"),
+                                lin("attention.k_lin.weight"),
+                                lin("attention.v_lin.weight")], axis=-1)
+        qkv_b = np.concatenate([vec("attention.q_lin.bias"),
+                                vec("attention.k_lin.bias"),
+                                vec("attention.v_lin.bias")], axis=-1)
+        emb = pre + "embeddings."
+        params = {
+            "embeddings": {
+                "word": jnp.asarray(sd[emb + "word_embeddings.weight"]),
+                "position": jnp.asarray(
+                    sd[emb + "position_embeddings.weight"]),
+                "token_type": jnp.zeros((1, d), jnp.float32),
+                "ln": {"scale": jnp.asarray(sd[emb + "LayerNorm.weight"]),
+                       "bias": jnp.asarray(sd[emb + "LayerNorm.bias"])},
+            },
+            "block": {
+                "qkv": {"kernel": jnp.asarray(qkv_k),
+                        "bias": jnp.asarray(qkv_b)},
+                "attn_out": {
+                    "kernel": jnp.asarray(lin("attention.out_lin.weight")),
+                    "bias": jnp.asarray(vec("attention.out_lin.bias"))},
+                "ln1": {"scale": jnp.asarray(vec("sa_layer_norm.weight")),
+                        "bias": jnp.asarray(vec("sa_layer_norm.bias"))},
+                "mlp_in": {"kernel": jnp.asarray(lin("ffn.lin1.weight")),
+                           "bias": jnp.asarray(vec("ffn.lin1.bias"))},
+                "mlp_out": {"kernel": jnp.asarray(lin("ffn.lin2.weight")),
+                            "bias": jnp.asarray(vec("ffn.lin2.bias"))},
+                "ln2": {"scale": jnp.asarray(
+                            vec("output_layer_norm.weight")),
+                        "bias": jnp.asarray(vec("output_layer_norm.bias"))},
+            },
+        }
+        logger.info(
+            f"injected HF DistilBERT: {cfg.n_layers}L/{cfg.d_model}d post-LN")
+        return cfg, params
+
+
+@register_policy("megatron_sd")
+class MegatronPolicy:
+    """Megatron-LM GPT-2 state_dict -> fused GPT layout
+    (ref: MegatronLayerPolicy, replace_policy.py:202; TP-resharding of
+    these checkpoints lives in runtime/state_dict_factory.py). Accepts a
+    raw (already TP-merged) Megatron state dict — torch Linear layout
+    ([out, in] weights, transposed here) with the fused
+    query_key_value projection stored q|k|v-contiguous (the "version 0"
+    layout; interleaved megatron_v2 dicts should first pass through
+    MegatronSDLoader.sanity-reorder)."""
+
+    @staticmethod
+    def matches(model) -> bool:
+        if not isinstance(model, dict):
+            return False
+        return any("attention.query_key_value.weight" in k for k in model)
+
+    @staticmethod
+    def convert(model):
+        import jax.numpy as jnp
+        meta = dict(model.get("config", {})) if isinstance(
+            model.get("config", None), dict) else {}
+        sd = {k: (v.detach().cpu().numpy() if hasattr(v, "detach")
+                  else np.asarray(v))
+              for k, v in model.items() if k != "config"}
+        # locate the layer prefix, e.g. "language_model.transformer.layers."
+        probe = next(k for k in sd
+                     if "attention.query_key_value.weight" in k)
+        pre = probe.split("layers.")[0] + "layers."
+        import re as _re
+        L = 1 + max(int(_re.search(r"layers\.(\d+)\.", k).group(1))
+                    for k in sd if pre in k)
+        d = sd[probe].shape[1]
+        emb_key = next(k for k in sd if "word_embeddings.weight" in k)
+        pos_key = next(k for k in sd if "position_embeddings.weight" in k)
+        n_heads = int(meta.get("n_heads", 0))
+        if not n_heads:
+            # Megatron's standard head_dim is 64; pass {"config":
+            # {"n_heads": N}} in the dict to override
+            assert d % 64 == 0, (
+                f"cannot infer n_heads for d_model={d}; supply "
+                "sd['config'] = {'n_heads': ...}")
+            n_heads = d // 64
+            logger.warning(
+                f"Megatron policy: n_heads not given, assuming "
+                f"head_dim=64 -> {n_heads} heads")
+
+        def lin(fmt):
+            return np.stack([sd[(pre + fmt).format(i)].T for i in range(L)])
+
+        def vec(fmt):
+            return np.stack([sd[(pre + fmt).format(i)] for i in range(L)])
+
+        cfg = GPTConfig(
+            vocab_size=sd[emb_key].shape[0], n_layers=L, n_heads=n_heads,
+            d_model=d, max_seq_len=sd[pos_key].shape[0],
+            tie_embeddings=True)
+        params = {
+            "wte": {"embedding": jnp.asarray(sd[emb_key])},
+            "wpe": {"embedding": jnp.asarray(sd[pos_key])},
+            "block": {
+                "ln1": {"scale": vec("{}.input_layernorm.weight"),
+                        "bias": vec("{}.input_layernorm.bias")},
+                "qkv": {"kernel": lin("{}.attention.query_key_value.weight"),
+                        "bias": vec("{}.attention.query_key_value.bias")},
+                "attn_out": {"kernel": lin("{}.attention.dense.weight"),
+                             "bias": vec("{}.attention.dense.bias")},
+                "ln2": {"scale": vec("{}.post_attention_layernorm.weight"),
+                        "bias": vec("{}.post_attention_layernorm.bias")},
+                "mlp_in": {"kernel": lin("{}.mlp.dense_h_to_4h.weight"),
+                           "bias": vec("{}.mlp.dense_h_to_4h.bias")},
+                "mlp_out": {"kernel": lin("{}.mlp.dense_4h_to_h.weight"),
+                            "bias": vec("{}.mlp.dense_4h_to_h.bias")},
+            },
+        }
+        lnf_w = next((k for k in sd if "final_layernorm.weight" in k), None)
+        if lnf_w is not None:
+            params["ln_f"] = {
+                "scale": jnp.asarray(sd[lnf_w]),
+                "bias": jnp.asarray(sd[lnf_w.replace("weight", "bias")])}
+        else:
+            params["ln_f"] = {"scale": jnp.ones((d,), np.float32),
+                              "bias": jnp.zeros((d,), np.float32)}
+        params["block"] = {
+            kk: {k2: jnp.asarray(v2) for k2, v2 in vv.items()}
+            for kk, vv in params["block"].items()}
+        logger.info(f"injected Megatron GPT: {L}L/{d}d heads={n_heads}")
+        return cfg, params
+
+
 @register_policy("gpt_tuple")
 class NativePolicy:
     """Our own (config, params) tuples — GPT (incl. MoE-GPT) or BERT."""

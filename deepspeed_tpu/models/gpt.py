@@ -52,8 +52,12 @@ class GPTConfig:
     flash_block_kv: int = 512
     tie_embeddings: bool = True
     # sequence/context parallelism: shard the token dim over the 'sequence'
-    # mesh axis and run ring attention over ICI (set mesh too)
+    # mesh axis (set mesh too). sp_impl: 'ring' rotates K/V over ICI
+    # (ops/attention/ring.py), 'ulysses' re-shards seq<->heads with two
+    # all-to-alls and runs the full flash kernel locally
+    # (ops/attention/ulysses.py).
     sequence_parallel: bool = False
+    sp_impl: str = "ring"
     mesh: Any = None
     # --- architecture variants for foreign-checkpoint injection --------
     # (ref: module_inject/replace_policy.py — GPT-Neo :112 uses unscaled
@@ -193,6 +197,12 @@ def _attention(q, k, v, cfg: GPTConfig):
     """Causal multi-head attention. q,k,v: [B, S, H, Dh]."""
     scale = cfg.attn_scale  # None -> kernels default to 1/sqrt(Dh)
     if cfg.sequence_parallel and cfg.mesh is not None:
+        if cfg.sp_impl == "ulysses":
+            from deepspeed_tpu.ops.attention.ulysses import ulysses_attention
+            return ulysses_attention(
+                q, k, v, cfg.mesh, causal=True, scale=scale,
+                use_flash=_flash_eligible(cfg, q.shape[1]),
+                block_q=cfg.flash_block_q, block_kv=cfg.flash_block_kv)
         from deepspeed_tpu.ops.attention.ring import ring_attention
         return ring_attention(q, k, v, cfg.mesh, causal=True, scale=scale)
     if _flash_eligible(cfg, q.shape[1]):
@@ -292,9 +302,12 @@ def forward(params: Dict, tokens: jnp.ndarray, cfg: GPTConfig,
         return (y, r), None
 
     if cfg.remat:
+        # the policy must match the attention path actually taken: when
+        # flash is requested but ineligible for this S, the jnp path tags
+        # "attn" and produces no flash residuals
         body = jax.checkpoint(
             body, policy=remat_policy(cfg.remat_policy,
-                                       flash=cfg.use_flash_attention))
+                                      flash=_flash_eligible(cfg, S)))
 
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     (x, _), _ = jax.lax.scan(body, (x, rng), (block, jnp.arange(L)))

@@ -1,0 +1,84 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+The reference version has NO sequence parallelism (SURVEY §2.2 — absent at
+v0.6.4; DeepSpeed-Ulysses is the lineage's later answer). This is the
+TPU-native equivalent: where ring attention (ops/attention/ring.py)
+rotates K/V blocks around the ICI ring, Ulysses re-shards with two
+all-to-alls so every device runs a FULL-sequence attention over a slice
+of the heads:
+
+- activations arrive sharded on the sequence dim: [B, S/sp, H, D];
+- all-to-all #1 swaps the shard dim: seq -> heads, giving every device
+  the whole sequence for H/sp heads;
+- local attention (the Pallas flash kernel when eligible — full sequence
+  locally means the fused kernel applies unchanged);
+- all-to-all #2 swaps back: heads -> seq.
+
+Trade-off vs ring: 2 all-to-alls of activation size per attention call
+(O(B·S·d/sp) bytes each, constant in sp) instead of sp ppermute hops of
+K/V; attention compute is perfectly balanced even for causal masks
+(ring's lower-triangle causes stage imbalance), and the unmodified
+single-device kernel runs inside. Requires n_heads % sp == 0.
+"""
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _ulysses_local(q, k, v, *, axis: str, causal: bool, scale: float,
+                   use_flash: bool, block_q: int, block_kv: int):
+    """Inside shard_map: q,k,v local [B, S_loc, H, D] -> [B, S_loc, H, D]."""
+    sp = jax.lax.axis_size(axis)
+    B, S_loc, H, D = q.shape
+    assert H % sp == 0, f"n_heads {H} not divisible by sp degree {sp}"
+
+    # seq-sharded -> head-sharded: [B, S_loc, H, D] -> [B, S, H/sp, D]
+    def seq2head(x):
+        return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def head2seq(x):
+        return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    qh, kh, vh = seq2head(q), seq2head(k), seq2head(v)
+
+    if use_flash:
+        from deepspeed_tpu.ops.attention.flash import flash_attention
+        out = flash_attention(qh, kh, vh, causal=causal, scale=scale,
+                              block_q=block_q, block_kv=block_kv)
+    else:
+        from deepspeed_tpu.ops.attention.flash import mha_reference
+        out = mha_reference(qh, kh, vh, causal=causal, scale=scale)
+
+    return head2seq(out)
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      mesh: Mesh, *, causal: bool = True,
+                      scale: Optional[float] = None,
+                      axis: str = "sequence",
+                      use_flash: bool = False,
+                      block_q: int = 512,
+                      block_kv: int = 512) -> jnp.ndarray:
+    """Exact (causal) attention with the sequence dim sharded over ``axis``
+    via head<->sequence all-to-alls. q,k,v: [B, S, H, D] global arrays.
+    """
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    inner = partial(_ulysses_local, axis=axis, causal=causal, scale=scale,
+                    use_flash=use_flash, block_q=block_q, block_kv=block_kv)
+    spec = P(None, axis, None, None)
+    mapped = jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names={axis},
+        check_vma=False)
+    # same eager-canonicalization workaround as ring_attention
+    return jax.jit(mapped)(q, k, v)

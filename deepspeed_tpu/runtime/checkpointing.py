@@ -56,20 +56,33 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     # host-resident optimizer state (ZeRO-Offload): fp32 masters + moments
     # (analog of the per-DP-rank optim_states.pt shards, engine.py:2327)
     if getattr(engine, "offload_enabled", False):
-        # per-process shard file: each process consolidates the shards it
-        # can address (the analog of the reference's per-DP-rank
-        # zero_pp_rank_X_..._optim_states.pt files, engine.py:2327). On a
-        # single host this is one file holding the full global state.
-        sd = engine.host_optimizer.state_dict()
-        arrays = {"step": np.asarray(sd["step"])}
-        for i, m in enumerate(sd["master"]):
-            arrays[f"master_{i}"] = m
-        for key, st in sd["state"].items():
-            arrays[f"exp_avg_{key}"] = st["exp_avg"]
-            arrays[f"exp_avg_sq_{key}"] = st["exp_avg_sq"]
-        fname = (f"host_optim_states_p{jax.process_index()}.npz"
-                 if jax.process_count() > 1 else "host_optim_states.npz")
-        np.savez(os.path.join(path, fname), **arrays)
+        if jax.process_count() > 1:
+            # per-process shard-piece files (the analog of the reference's
+            # per-DP-rank zero_pp_rank_X_..._optim_states.pt shards,
+            # engine.py:2327): each process saves exactly the regions it
+            # addresses; load merges every process's pieces, so restores
+            # work at ANY process count / shard layout.
+            pieces = engine.host_optimizer.shard_export()
+            arrays = {"step": np.asarray(
+                engine.host_optimizer.step_count),
+                "n_pieces": np.asarray(len(pieces))}
+            for n_, p in enumerate(pieces):
+                for field in ("leaf", "starts", "stops", "master",
+                              "exp_avg", "exp_avg_sq"):
+                    arrays[f"piece{n_}_{field}"] = p[field]
+            np.savez(os.path.join(
+                path, f"host_optim_states_p{jax.process_index()}.npz"),
+                **arrays)
+        else:
+            # single host: one consolidated global file
+            sd = engine.host_optimizer.state_dict()
+            arrays = {"step": np.asarray(sd["step"])}
+            for i, m in enumerate(sd["master"]):
+                arrays[f"master_{i}"] = m
+            for key, st in sd["state"].items():
+                arrays[f"exp_avg_{key}"] = st["exp_avg"]
+                arrays[f"exp_avg_sq_{key}"] = st["exp_avg_sq"]
+            np.savez(os.path.join(path, "host_optim_states.npz"), **arrays)
 
     meta = {
         "tag": tag,
@@ -139,13 +152,24 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     opt_state = restored["opt_state"] if load_optimizer_states else state.opt_state
 
     if getattr(engine, "offload_enabled", False):
-        # prefer this process's shard file (multi-host save), fall back to
-        # the single-host consolidated file
-        host_path = os.path.join(
-            path, f"host_optim_states_p{jax.process_index()}.npz")
-        if not os.path.isfile(host_path):
-            host_path = os.path.join(path, "host_optim_states.npz")
-        if load_optimizer_states and os.path.isfile(host_path):
+        import glob as _glob
+        piece_files = sorted(_glob.glob(
+            os.path.join(path, "host_optim_states_p*.npz")))
+        host_path = os.path.join(path, "host_optim_states.npz")
+        if load_optimizer_states and piece_files:
+            # multi-host save: merge every process's shard pieces —
+            # restores at any process count / shard layout
+            pieces, step = [], 0
+            for f in piece_files:
+                z = np.load(f)
+                step = int(z["step"])
+                for n_ in range(int(z["n_pieces"])):
+                    pieces.append({
+                        field: z[f"piece{n_}_{field}"]
+                        for field in ("leaf", "starts", "stops", "master",
+                                      "exp_avg", "exp_avg_sq")})
+            engine.host_optimizer.shard_import(pieces, step)
+        elif load_optimizer_states and os.path.isfile(host_path):
             z = np.load(host_path)
             n = len(engine.host_optimizer.master)
             engine.host_optimizer.load_state_dict({

@@ -287,6 +287,62 @@ class HostOffloadOptimizer:
                     z = np.zeros(piece.size, np.float32)
                     self.swapper.swap_out(f"{i}:{k}", [z, z])
 
+    # --- multi-host checkpointing: per-shard region pieces ------------
+    def _shard_moments(self, i: int, k: str):
+        skey = f"{i}:{k}"
+        n = self.master[i][k].size
+        if self.swapper is not None and self.swapper.has_state(skey):
+            m, v = self.swapper.swap_in(skey)
+            return np.asarray(m, np.float32), np.asarray(v, np.float32)
+        if skey in self.opt.state:
+            st = self.opt.state[skey]
+            m = st.get("exp_avg")
+            v = st.get("exp_avg_sq")
+            m = (np.asarray(m, np.float32) if m is not None and m.size
+                 else np.zeros(n, np.float32))
+            return m, np.asarray(v, np.float32)
+        return np.zeros(n, np.float32), np.zeros(n, np.float32)
+
+    def shard_export(self) -> List[Dict]:
+        """Pieces for the shards THIS process addresses — the multi-host
+        save path (analog of the reference's per-DP-rank
+        optim_states.pt shards, engine.py:2327). Restoring merges every
+        process's pieces, so any topology can load any other's save."""
+        out = []
+        for i, table in enumerate(self.tables):
+            for k, ent in table.by_key.items():
+                m, v = self._shard_moments(i, k)
+                out.append({
+                    "leaf": np.asarray(i),
+                    "starts": np.asarray([s.start for s in ent["index"]]),
+                    "stops": np.asarray([s.stop for s in ent["index"]]),
+                    "master": self.master[i][k],
+                    "exp_avg": m, "exp_avg_sq": v})
+        return out
+
+    def shard_import(self, pieces: List[Dict], step: int):
+        """Merge exported shard pieces (from any number of processes at
+        any save-time topology) into this instance's masters/moments."""
+        g_master = [np.zeros(s, np.float32) for s in self.shapes]
+        g_m = [np.zeros(s, np.float32) for s in self.shapes]
+        g_v = [np.zeros(s, np.float32) for s in self.shapes]
+        for p in pieces:
+            i = int(p["leaf"])
+            idx = tuple(slice(int(a), int(b))
+                        for a, b in zip(p["starts"], p["stops"]))
+            shp = tuple(s.stop - s.start for s in idx)
+            g_master[i][idx] = np.asarray(p["master"],
+                                          np.float32).reshape(shp)
+            g_m[i][idx] = np.asarray(p["exp_avg"], np.float32).reshape(shp)
+            g_v[i][idx] = np.asarray(p["exp_avg_sq"],
+                                     np.float32).reshape(shp)
+        self.load_state_dict({
+            "step": step,
+            "master": [m.ravel() for m in g_master],
+            "state": {str(i): {"exp_avg": g_m[i].ravel(),
+                               "exp_avg_sq": g_v[i].ravel()}
+                      for i in range(len(self.shapes))}})
+
     # --- checkpointing hooks -----------------------------------------
     def _global_master(self, i: int) -> np.ndarray:
         """Assemble the full fp32 master for leaf i from its shards
