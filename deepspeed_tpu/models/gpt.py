@@ -203,6 +203,9 @@ def _attention(q, k, v, cfg: GPTConfig):
                 q, k, v, cfg.mesh, causal=True, scale=scale,
                 use_flash=_flash_eligible(cfg, q.shape[1]),
                 block_q=cfg.flash_block_q, block_kv=cfg.flash_block_kv)
+        if cfg.sp_impl != "ring":
+            raise ValueError(f"unknown sp_impl {cfg.sp_impl!r} "
+                             "(expected 'ring' or 'ulysses')")
         from deepspeed_tpu.ops.attention.ring import ring_attention
         return ring_attention(q, k, v, cfg.mesh, causal=True, scale=scale)
     if _flash_eligible(cfg, q.shape[1]):
@@ -288,9 +291,28 @@ def forward(params: Dict, tokens: jnp.ndarray, cfg: GPTConfig,
     block = params["block"]
     L = cfg.n_layers
 
+    # pin the scan carry's layout: without this, XLA's sharding
+    # propagation may pick conflicting activation shardings between the
+    # forward and transpose scan bodies under fsdp x tp (an "involuntary
+    # full rematerialization" reshard per layer); batch stays over the dp
+    # axes, token/feature dims replicated
+    # under sequence parallelism the token dim stays sharded over
+    # 'sequence' — pinning it replicated would allgather the full
+    # residual stream every layer and erase SP's memory win
+    carry_spec = P(("data", "fsdp"),
+                   "sequence" if cfg.sequence_parallel else None, None)
+
+    def _pin(t):
+        from jax.sharding import get_abstract_mesh
+        m = get_abstract_mesh()
+        if m is None or m.empty or not {"data", "fsdp"} <= set(m.axis_names):
+            return t  # no engine mesh in context (e.g. raw single-device)
+        return jax.lax.with_sharding_constraint(t, carry_spec)
+
     def body(carry, scanned):
         layer, lidx = scanned
         x, r = carry
+        x = _pin(x)
         r, dr = jax.random.split(r) if r is not None else (None, None)
         y = _block(x, layer, cfg, dropout_rng=dr, deterministic=deterministic)
         if pld_theta is not None and not deterministic:
@@ -299,7 +321,7 @@ def forward(params: Dict, tokens: jnp.ndarray, cfg: GPTConfig,
                 (1.0 - pld_theta.astype(jnp.float32))
             keep = jax.random.bernoulli(kr, keep_p)
             y = jnp.where(keep, y, x)
-        return (y, r), None
+        return (_pin(y), r), None
 
     if cfg.remat:
         # the policy must match the attention path actually taken: when

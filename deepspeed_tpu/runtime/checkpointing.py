@@ -160,15 +160,39 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
             # multi-host save: merge every process's shard pieces —
             # restores at any process count / shard layout
             pieces, step = [], 0
+            legacy_file = None
             for f in piece_files:
                 z = np.load(f)
+                if "n_pieces" not in z:
+                    # pre-shard-piece per-process file (consolidated
+                    # global arrays): only valid for this process's own
+                    # shard layout — handled below
+                    if f.endswith(f"_p{jax.process_index()}.npz"):
+                        legacy_file = f
+                    continue
                 step = int(z["step"])
                 for n_ in range(int(z["n_pieces"])):
                     pieces.append({
                         field: z[f"piece{n_}_{field}"]
                         for field in ("leaf", "starts", "stops", "master",
                                       "exp_avg", "exp_avg_sq")})
-            engine.host_optimizer.shard_import(pieces, step)
+            if pieces:
+                engine.host_optimizer.shard_import(pieces, step)
+            elif legacy_file is not None:
+                z = np.load(legacy_file)
+                n = len(engine.host_optimizer.master)
+                engine.host_optimizer.load_state_dict({
+                    "step": int(z["step"]),
+                    "master": [z[f"master_{i}"] for i in range(n)],
+                    "state": {str(i): {"exp_avg": z[f"exp_avg_{i}"],
+                                       "exp_avg_sq": z[f"exp_avg_sq_{i}"]}
+                              for i in range(n)},
+                })
+            else:
+                logger.warning(
+                    "offload engine: no readable host state pieces; "
+                    "reinitializing masters from restored params")
+                engine.host_optimizer.reset_from_params(restored["params"])
         elif load_optimizer_states and os.path.isfile(host_path):
             z = np.load(host_path)
             n = len(engine.host_optimizer.master)

@@ -847,7 +847,9 @@ class DeepSpeedEngine:
             jax.block_until_ready(self.state.params)
         t0 = time.perf_counter()
         from deepspeed_tpu.utils.trace import annotation
-        with annotation("ds.train_batch"):
+        # mesh in context: models can pin activation layouts with bare
+        # PartitionSpecs (gpt.py scan-carry constraint) during tracing
+        with annotation("ds.train_batch"), jax.set_mesh(self.mesh):
             if self.offload_enabled:
                 metrics = self._offload_train_batch(batch)
             else:
