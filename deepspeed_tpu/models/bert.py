@@ -33,6 +33,11 @@ class BertConfig:
     layer_norm_eps: float = 1e-12
     pre_layer_norm: bool = True
     dtype: jnp.dtype = jnp.bfloat16
+    # per-layer activation checkpointing; off by default (small-model
+    # fine-tuning fits HBM) — pretraining batch sizes need it (the
+    # bert_bench/pretrain call sites enable it)
+    remat: bool = False
+    remat_policy: str = "selective"   # see models.gpt.remat_policy
 
     @property
     def layer_config(self) -> DeepSpeedTransformerConfig:
@@ -115,6 +120,17 @@ def encode(params: Dict, tokens: jnp.ndarray, cfg: BertConfig,
                           rng=None if deterministic else lr,
                           deterministic=deterministic)
         return (y, r), None
+
+    if cfg.remat:
+        # per-layer activation checkpointing: without it the scan keeps
+        # every layer's attention/MLP intermediates for the backward —
+        # BERT-large at pretraining batch sizes does not fit HBM
+        # (ref capability: activation_checkpointing/checkpointing.py).
+        # Policy shared with the GPT family (encoder_layer tags
+        # qkv/attn/mlp_pre and the flash kernel its packed residuals).
+        from deepspeed_tpu.models.gpt import remat_policy
+        body = jax.checkpoint(
+            body, policy=remat_policy(cfg.remat_policy, flash=False))
 
     (x, _), _ = jax.lax.scan(body, (x, rng), params["block"])
     return x

@@ -126,8 +126,10 @@ def layer_forward(params: Dict, x: jnp.ndarray,
         deterministic = True
 
     def attn_block(inp):
+        from jax.ad_checkpoint import checkpoint_name
         qkv = inp @ params["qkv"]["kernel"].astype(inp.dtype) + \
             params["qkv"]["bias"].astype(inp.dtype)
+        qkv = checkpoint_name(qkv, "qkv")
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, S, H, D)
         k = k.reshape(B, S, H, D)
@@ -135,6 +137,7 @@ def layer_forward(params: Dict, x: jnp.ndarray,
         ctx = _attention_core(q, k, v, attn_mask, cfg, r_probs,
                               deterministic,
                               allow_flash=allow_flash).reshape(B, S, h)
+        ctx = checkpoint_name(ctx, "attn")
         out = ctx @ params["attn_out"]["kernel"].astype(inp.dtype) + \
             params["attn_out"]["bias"].astype(inp.dtype)
         if not deterministic and cfg.hidden_dropout_ratio > 0:
@@ -142,8 +145,10 @@ def layer_forward(params: Dict, x: jnp.ndarray,
         return out
 
     def mlp_block(inp):
+        from jax.ad_checkpoint import checkpoint_name
         mid = inp @ params["mlp_in"]["kernel"].astype(inp.dtype) + \
             params["mlp_in"]["bias"].astype(inp.dtype)
+        mid = checkpoint_name(mid, "mlp_pre")
         mid = jax.nn.gelu(mid, approximate=True)
         out = mid @ params["mlp_out"]["kernel"].astype(inp.dtype) + \
             params["mlp_out"]["bias"].astype(inp.dtype)

@@ -92,6 +92,10 @@ class OffloadConfig:
     # (runtime/zero/param_offload.py); 0 = auto-size (<=8 groups,
     # capped block bytes)
     stream_group_layers: int = 0
+    # delayed param update: overlap the host optimizer with the NEXT
+    # step's device compute at one step of staleness (the ZeRO-Offload
+    # paper's DPU mode; bf16 only)
+    delayed_param_update: bool = False
 
     @property
     def enabled(self) -> bool:
@@ -112,6 +116,8 @@ class OffloadConfig:
             max_in_cpu=int(get_scalar_param(d, C.OFFLOAD_MAX_IN_CPU, 1_000_000_000)),
             stream_group_layers=int(get_scalar_param(
                 d, "stream_group_layers", 0)),
+            delayed_param_update=get_scalar_param(
+                d, "delayed_param_update", False),
         )
 
 

@@ -182,6 +182,18 @@ class DeepSpeedEngine:
         # only compute-dtype params on device
         self.offload_enabled = (config.zero.offload_optimizer.enabled
                                 and optimizer is None)
+        self.dpu_enabled = (self.offload_enabled
+                            and config.zero.offload_optimizer
+                            .delayed_param_update)
+        self._dpu_pending = None
+        if self.dpu_enabled:
+            if config.fp16.enabled:
+                raise ValueError(
+                    "delayed_param_update requires bf16 (fp16 overflow "
+                    "skipping cannot compose with one-step staleness)")
+            import concurrent.futures as _fut
+            self._dpu_executor = _fut.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="ds-dpu")
         if self.offload_enabled:
             self._configure_offload_optimizer(params)
             self.optimizer = None
@@ -776,7 +788,19 @@ class DeepSpeedEngine:
             self.state.params, batch, self.state.rng, self.state.scale_state)
         self.state.rng = rng
         self.state.scale_state = new_scale
-        if not bool(metrics["overflow"]):
+        if self.dpu_enabled:
+            # delayed param update (ZeRO-Offload DPU): the grad program
+            # for THIS batch was dispatched with the previous params;
+            # install the overlapped update from the last step, then hand
+            # this step's grads to the worker — the host Adam runs behind
+            # the device's next forward/backward at one step of staleness
+            if self._dpu_pending is not None:
+                self.state.params = self._dpu_pending.result()
+                self.state.step = self.state.step + 1
+            lr = float(self.lr_schedule(int(self.state.step)))
+            self._dpu_pending = self._dpu_executor.submit(
+                self.host_optimizer.step, grads, lr)
+        elif not bool(metrics["overflow"]):
             # pipelined shard-wise d2h -> host native optimizer -> h2d;
             # the returned tree is already placed on the mesh
             # (ref: stage_1_and_2.py:1005,1725)
@@ -786,6 +810,14 @@ class DeepSpeedEngine:
         metrics["lr"] = jnp.asarray(self.lr_schedule(int(self.state.step)),
                                     jnp.float32)
         return metrics
+
+    def flush_delayed_update(self) -> None:
+        """Join a pending DPU host step (call before checkpointing or
+        evaluation so the installed params are current)."""
+        if getattr(self, "_dpu_pending", None) is not None:
+            self.state.params = self._dpu_pending.result()
+            self.state.step = self.state.step + 1
+            self._dpu_pending = None
 
     def _shard_batch(self, batch: PyTree) -> PyTree:
         """Place a host batch on the mesh: leading dim over the dp axes,
@@ -1047,6 +1079,7 @@ class DeepSpeedEngine:
         return loss
 
     def eval_batch(self, batch, rng: Optional[jax.Array] = None):
+        self.flush_delayed_update()
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         return self._eval_step(self.state.params, self._shard_batch(batch), rng)
 
@@ -1104,6 +1137,7 @@ class DeepSpeedEngine:
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[Dict] = None,
                         save_latest: bool = True):
+        self.flush_delayed_update()
         from deepspeed_tpu.runtime.checkpointing import save_checkpoint
         return save_checkpoint(self, save_dir, tag=tag,
                                client_state=client_state or {},
@@ -1112,6 +1146,12 @@ class DeepSpeedEngine:
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_optimizer_states: bool = True,
                         load_lr_scheduler_states: bool = True):
+        # join-and-DISCARD any in-flight DPU update: the worker must not
+        # mutate host masters during restore, and its pre-load result
+        # must never overwrite the restored weights
+        if getattr(self, "_dpu_pending", None) is not None:
+            self._dpu_pending.result()
+            self._dpu_pending = None
         from deepspeed_tpu.runtime.checkpointing import load_checkpoint
         return load_checkpoint(self, load_dir, tag=tag,
                                load_optimizer_states=load_optimizer_states)

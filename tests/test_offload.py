@@ -246,3 +246,54 @@ def test_shard_export_import_cross_topology():
         m2 = engine2.host_optimizer._global_moment(i, "exp_avg_sq")
         np.testing.assert_array_equal(m1, m2)
         assert np.abs(m1).sum() > 0  # moments actually carried over
+
+
+def test_delayed_param_update():
+    """DPU (ZeRO-Offload delayed param update): one-step-stale host Adam
+    overlapped with the next step's device work still converges, and
+    flush_delayed_update installs the pending update before
+    checkpoint/eval."""
+    cfg = _base_config(offload_optimizer={"device": "cpu",
+                                          "delayed_param_update": True})
+    engine, losses = _train(cfg, steps=25)
+    assert engine.dpu_enabled
+    assert losses[-1] < losses[0] * 0.6, losses
+    # pending update exists mid-stream; flush installs it
+    step_before = int(engine.state.step)
+    engine.flush_delayed_update()
+    assert engine._dpu_pending is None
+    assert int(engine.state.step) == step_before + 1
+    # eval after flush uses current params and is finite
+    batch = random_batch(8, HIDDEN, seed=3)
+    loss, _ = engine.eval_batch(batch)
+    assert np.isfinite(float(loss))
+
+
+def test_dpu_requires_bf16():
+    cfg = _base_config(offload_optimizer={"device": "cpu",
+                                          "delayed_param_update": True})
+    cfg["bf16"] = {"enabled": False}
+    cfg["fp16"] = {"enabled": True}
+    params = simple_model_params(hidden_dim=HIDDEN, nlayers=2, seed=0)
+    with pytest.raises(ValueError, match="delayed_param_update"):
+        deepspeed_tpu.initialize(model=simple_model_loss,
+                                 model_parameters=params, config=cfg)
+
+
+def test_dpu_load_checkpoint_discards_pending(tmp_path):
+    """A pending DPU update must never overwrite restored weights."""
+    cfg = _base_config(offload_optimizer={"device": "cpu",
+                                          "delayed_param_update": True})
+    engine, _ = _train(cfg, steps=6)
+    engine.save_checkpoint(str(tmp_path / "ck"), tag="t6")  # flushes
+    saved = engine.host_optimizer._global_master(0).copy()
+    # create a fresh pending update, then load over it
+    engine.train_batch(random_batch(8, HIDDEN, seed=7))
+    assert engine._dpu_pending is not None
+    engine.load_checkpoint(str(tmp_path / "ck"), tag="t6")
+    assert engine._dpu_pending is None
+    np.testing.assert_array_equal(
+        engine.host_optimizer._global_master(0), saved)
+    # next step trains from the restored weights, not the stale update
+    m = engine.train_batch(random_batch(8, HIDDEN, seed=8))
+    assert np.isfinite(float(m["loss"]))
