@@ -48,6 +48,11 @@ def peak_flops() -> float:
     return 197e12
 
 
+def _on_tpu() -> bool:
+    d = jax.devices()[0]
+    return "tpu" in (d.platform + d.device_kind).lower()
+
+
 def run_config(preset, batch, seq, steps, ds_overrides, on_tpu,
                flash_block=512, remat_pol="selective"):
     import deepspeed_tpu
@@ -103,22 +108,24 @@ def run_config(preset, batch, seq, steps, ds_overrides, on_tpu,
 def _sub(which):
     """Run one bench config in a FRESH subprocess (the remote compile
     helper on this rig can 500 on repeat compiles in one long process)
-    and parse its JSON line. Falls back to in-process on failure."""
+    and parse its JSON line. Returns None (with a stderr note) on any
+    failure so the caller can fall back in-process."""
     import subprocess
     try:
         r = subprocess.run([sys.executable, __file__, "--one", which],
-                           capture_output=True, text=True)
+                           capture_output=True, text=True, timeout=1800)
         for line in reversed(r.stdout.splitlines()):
             if line.startswith("{"):
                 return json.loads(line)
-    except Exception:
-        pass
+        print(f"bench subprocess {which!r} rc={r.returncode}: "
+              f"{r.stderr[-300:]}", file=sys.stderr)
+    except Exception as e:
+        print(f"bench subprocess {which!r} failed: {e!r}", file=sys.stderr)
     return None
 
 
 def _run_one(which):
-    on_tpu = "tpu" in (jax.devices()[0].platform +
-                       jax.devices()[0].device_kind).lower()
+    on_tpu = _on_tpu()
     if which == "headline":
         preset = "gpt2-1.5b" if on_tpu else "gpt2-small"
         batch, seq = (16, 1024) if on_tpu else (2, 128)
@@ -147,13 +154,12 @@ def _run_one(which):
 
 
 def main():
-    on_tpu = "tpu" in (jax.devices()[0].platform +
-                       jax.devices()[0].device_kind).lower()
-    dev = jax.devices()[0].device_kind
-
     if len(sys.argv) > 2 and sys.argv[1] == "--one":
         print(json.dumps(_run_one(sys.argv[2])))
         return
+
+    on_tpu = _on_tpu()
+    dev = jax.devices()[0].device_kind
 
     # --- headline: GPT-2 1.5B, full training state on one chip --------
     # (off-TPU the bench is a smoke test — small preset)

@@ -147,3 +147,7 @@ def add_config_arguments(parser):
 def argparse_suppress():
     import argparse
     return argparse.SUPPRESS
+
+
+# zero namespace (ref: deepspeed.zero.Init re-export, deepspeed/__init__.py)
+from deepspeed_tpu.runtime import zero  # noqa: E402

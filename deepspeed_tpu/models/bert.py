@@ -128,9 +128,20 @@ def encode(params: Dict, tokens: jnp.ndarray, cfg: BertConfig,
         # (ref capability: activation_checkpointing/checkpointing.py).
         # Policy shared with the GPT family (encoder_layer tags
         # qkv/attn/mlp_pre and the flash kernel its packed residuals).
+        # The flash flag must mirror _attention_core's gate so the
+        # selective policy never saves the attention output twice
+        # (packed flash_out + 'attn').
         from deepspeed_tpu.models.gpt import remat_policy
+        head_dim = cfg.d_model // cfg.n_heads
+        try:
+            d0 = jax.devices()[0]
+            on_tpu = "tpu" in (d0.platform + d0.device_kind).lower()
+        except Exception:
+            on_tpu = False
+        flash_used = (attention_mask is None and S >= 128
+                      and head_dim % 8 == 0 and on_tpu)
         body = jax.checkpoint(
-            body, policy=remat_policy(cfg.remat_policy, flash=False))
+            body, policy=remat_policy(cfg.remat_policy, flash=flash_used))
 
     (x, _), _ = jax.lax.scan(body, (x, rng), params["block"])
     return x

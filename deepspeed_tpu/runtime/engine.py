@@ -1064,7 +1064,11 @@ class DeepSpeedEngine:
             self._train_step = self._build_train_step(self._donate_state)
 
     def destroy(self) -> None:
-        """Flush and release engine-owned sinks (monitor/TB writer)."""
+        """Flush and release engine-owned sinks (monitor/TB writer) and
+        any pending delayed param update + its worker thread."""
+        self.flush_delayed_update()
+        if getattr(self, "_dpu_executor", None) is not None:
+            self._dpu_executor.shutdown(wait=True)
         self._flush_monitor_buffer()
         self.monitor.close()
 
@@ -1074,6 +1078,7 @@ class DeepSpeedEngine:
 
     def forward(self, batch, rng: Optional[jax.Array] = None):
         """Inference/eval forward (loss only; ref: engine.py:1523)."""
+        self.flush_delayed_update()
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         loss, _ = self._eval_step(self.state.params, self._shard_batch(batch), rng)
         return loss
@@ -1095,6 +1100,7 @@ class DeepSpeedEngine:
     # properties ------------------------------------------------------
     @property
     def params(self):
+        self.flush_delayed_update()
         return self.state.params
 
     @property

@@ -1,0 +1,10 @@
+"""ZeRO subsystem: sharded init, offload tiers, tiling.
+
+(ref: deepspeed/runtime/zero/__init__.py exposing zero.Init etc.)
+"""
+
+from deepspeed_tpu.runtime.zero.init import materialize_sharded
+
+# functional analog of the reference's zero.Init context manager
+# (partition_parameters.py:548): params come into existence sharded
+Init = materialize_sharded

