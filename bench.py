@@ -54,7 +54,7 @@ def _on_tpu() -> bool:
 
 
 def run_config(preset, batch, seq, steps, ds_overrides, on_tpu,
-               flash_block=512, remat_pol="selective"):
+               flash_block=1024, remat_pol="selective"):
     import deepspeed_tpu
     from deepspeed_tpu.models import gpt
 
@@ -133,7 +133,7 @@ def _run_one(which):
             preset, batch, seq, 10 if on_tpu else 2,
             {"bf16": {"enabled": True, "memory_efficient": True},
              "zero_optimization": {"stage": 3}},
-            on_tpu, remat_pol="full")
+            on_tpu, remat_pol="full", flash_block=1024)
         return {"preset": preset, "batch": batch, "seq": seq,
                 "dt": dt, "tps": tps, "mfu": mfu}
     if which == "medium":
@@ -142,7 +142,7 @@ def _run_one(which):
         dt, tps, mfu = run_config(preset, batch, seq,
                                   20 if on_tpu else 2,
                                   {"zero_optimization": {"stage": 1}},
-                                  on_tpu)
+                                  on_tpu, flash_block=1024)
         return {"preset": preset, "dt": dt, "tps": tps, "mfu": mfu}
     if which == "bert":
         from tools.bert_bench import run as bert_run

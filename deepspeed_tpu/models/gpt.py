@@ -48,8 +48,10 @@ class GPTConfig:
     # elementwise only (~25% less recompute for ~8*d bytes/token/layer)
     remat_policy: str = "selective"
     use_flash_attention: bool = True
-    flash_block_q: int = 512
-    flash_block_kv: int = 512
+    # 1024-blocks measured fastest at seq>=1024 on v5e (PERF.md); the
+    # kernel clamps to the sequence length for shorter inputs
+    flash_block_q: int = 1024
+    flash_block_kv: int = 1024
     tie_embeddings: bool = True
     # sequence/context parallelism: shard the token dim over the 'sequence'
     # mesh axis (set mesh too). sp_impl: 'ring' rotates K/V over ICI
@@ -177,20 +179,36 @@ def _layernorm(x, scale, bias, eps=1e-5):
     return (y * scale + bias).astype(x.dtype)
 
 
-def _flash_eligible(cfg: GPTConfig, seq_len: int) -> bool:
-    """Explicit gate (no blanket except — Mosaic failures surface at
+def _effective_block(pref: int, seq_len: int) -> Optional[int]:
+    """Largest block <= pref (>=128) that divides seq_len — keeps the
+    flash kernel active when the preferred size doesn't tile the
+    sequence (e.g. 1024-blocks with S=1536 fall back to 512)."""
+    b = min(pref, seq_len)
+    while b >= 128 and seq_len % b != 0:
+        b //= 2
+    return b if b >= 128 and seq_len % b == 0 else None
+
+
+def _flash_blocks(cfg: GPTConfig, seq_len: int):
+    """(block_q, block_kv) for this sequence, or None if ineligible.
+    Explicit gate (no blanket except — Mosaic failures surface at
     jit-compile time, outside any trace-time try)."""
     if not cfg.use_flash_attention or seq_len < 128:
-        return False
-    bq = min(cfg.flash_block_q, seq_len)
-    bkv = min(cfg.flash_block_kv, seq_len)
-    if seq_len % bq != 0 or seq_len % bkv != 0:
-        return False
+        return None
+    bq = _effective_block(cfg.flash_block_q, seq_len)
+    bkv = _effective_block(cfg.flash_block_kv, seq_len)
+    if bq is None or bkv is None:
+        return None
     try:
         d = jax.devices()[0]
-        return "tpu" in (d.platform + d.device_kind).lower()
+        on_tpu = "tpu" in (d.platform + d.device_kind).lower()
     except Exception:
-        return False
+        return None
+    return (bq, bkv) if on_tpu else None
+
+
+def _flash_eligible(cfg: GPTConfig, seq_len: int) -> bool:
+    return _flash_blocks(cfg, seq_len) is not None
 
 
 def _attention(q, k, v, cfg: GPTConfig):
@@ -199,20 +217,22 @@ def _attention(q, k, v, cfg: GPTConfig):
     if cfg.sequence_parallel and cfg.mesh is not None:
         if cfg.sp_impl == "ulysses":
             from deepspeed_tpu.ops.attention.ulysses import ulysses_attention
+            blocks = _flash_blocks(cfg, q.shape[1])
             return ulysses_attention(
                 q, k, v, cfg.mesh, causal=True, scale=scale,
-                use_flash=_flash_eligible(cfg, q.shape[1]),
-                block_q=cfg.flash_block_q, block_kv=cfg.flash_block_kv)
+                use_flash=blocks is not None,
+                block_q=blocks[0] if blocks else cfg.flash_block_q,
+                block_kv=blocks[1] if blocks else cfg.flash_block_kv)
         if cfg.sp_impl != "ring":
             raise ValueError(f"unknown sp_impl {cfg.sp_impl!r} "
                              "(expected 'ring' or 'ulysses')")
         from deepspeed_tpu.ops.attention.ring import ring_attention
         return ring_attention(q, k, v, cfg.mesh, causal=True, scale=scale)
-    if _flash_eligible(cfg, q.shape[1]):
+    blocks = _flash_blocks(cfg, q.shape[1])
+    if blocks is not None:
         from deepspeed_tpu.ops.attention.flash import flash_attention
         return flash_attention(q, k, v, causal=True, scale=scale,
-                               block_q=cfg.flash_block_q,
-                               block_kv=cfg.flash_block_kv)
+                               block_q=blocks[0], block_kv=blocks[1])
     from deepspeed_tpu.ops.attention.flash import mha_reference
     return mha_reference(q, k, v, causal=True, scale=scale)
 
