@@ -54,14 +54,15 @@ def _on_tpu() -> bool:
 
 
 def run_config(preset, batch, seq, steps, ds_overrides, on_tpu,
-               flash_block=1024, remat_pol="selective"):
+               flash_block=1024, remat_pol="selective", loss_chunk=0):
     import deepspeed_tpu
     from deepspeed_tpu.models import gpt
 
     cfg = gpt.preset(preset, max_seq_len=seq, dtype=jnp.bfloat16,
                      remat=True, remat_policy=remat_pol,
                      use_flash_attention=on_tpu,
-                     flash_block_q=flash_block, flash_block_kv=flash_block)
+                     flash_block_q=flash_block, flash_block_kv=flash_block,
+                     loss_chunk=loss_chunk)
     params = gpt.init_params(jax.random.PRNGKey(0), cfg)
     ds_config = {
         "train_batch_size": batch,
@@ -133,7 +134,8 @@ def _run_one(which):
             preset, batch, seq, 10 if on_tpu else 2,
             {"bf16": {"enabled": True, "memory_efficient": True},
              "zero_optimization": {"stage": 3}},
-            on_tpu, remat_pol="full", flash_block=1024)
+            on_tpu, remat_pol="full", flash_block=1024,
+            loss_chunk=2048 if on_tpu else 0)
         return {"preset": preset, "batch": batch, "seq": seq,
                 "dt": dt, "tps": tps, "mfu": mfu}
     if which == "medium":
@@ -198,7 +200,7 @@ def main():
                 "mfu": round(mfu15, 4),
                 "mode": "bf16 memory_efficient (bf16 params+moments, "
                         "stochastic rounding), zero_stage=3, "
-                        "full remat, flash attention",
+                        "full remat, flash attention, chunked CE",
             },
             "secondary_gpt2_medium": {
                 "tokens_per_sec": round(tps_m, 1),

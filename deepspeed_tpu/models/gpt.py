@@ -53,6 +53,11 @@ class GPTConfig:
     flash_block_q: int = 1024
     flash_block_kv: int = 1024
     tie_embeddings: bool = True
+    # tokens per chunk for the fused chunked cross-entropy (0 = off, use
+    # the dense log_softmax path). At large vocab×batch×seq the dense path
+    # materializes multi-GB logits; chunking caps loss-path memory at
+    # ~chunk×V fp32 (ops/cross_entropy.py)
+    loss_chunk: int = 0
     # sequence/context parallelism: shard the token dim over the 'sequence'
     # mesh axis (set mesh too). sp_impl: 'ring' rotates K/V over ICI
     # (ops/attention/ring.py), 'ulysses' re-shards seq<->heads with two
@@ -294,7 +299,8 @@ def _dropout(x, rate, rng):
 def forward(params: Dict, tokens: jnp.ndarray, cfg: GPTConfig,
             rng: Optional[jax.Array] = None,
             deterministic: bool = True,
-            pld_theta: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+            pld_theta: Optional[jnp.ndarray] = None,
+            hidden_only: bool = False) -> jnp.ndarray:
     """tokens [B, S] int32 -> logits [B, S, V] (compute dtype).
 
     pld_theta: optional progressive-layer-drop keep-base (traced scalar;
@@ -355,6 +361,8 @@ def forward(params: Dict, tokens: jnp.ndarray, cfg: GPTConfig,
     (x, _), _ = jax.lax.scan(body, (x, rng), (block, jnp.arange(L)))
 
     x = _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    if hidden_only:
+        return x
     if cfg.tie_embeddings:
         logits = x @ wte.T
     else:
@@ -363,6 +371,35 @@ def forward(params: Dict, tokens: jnp.ndarray, cfg: GPTConfig,
         if "bias" in head:   # e.g. GPT-J ships an lm_head bias
             logits = logits + head["bias"].astype(dtype)
     return logits
+
+
+def _head_nll(other: Dict, y: jnp.ndarray, targets: jnp.ndarray,
+              cfg: GPTConfig) -> jnp.ndarray:
+    """Mean next-token NLL from post-ln_f hidden states (pipeline / layered
+    heads). Honors cfg.loss_chunk (fused chunked CE, ops/cross_entropy.py)."""
+    w, b = _vocab_proj(other, cfg)
+    if cfg.loss_chunk:
+        from deepspeed_tpu.ops.cross_entropy import chunked_softmax_xent
+        return chunked_softmax_xent(y, w, targets, bias=b,
+                                    chunk=cfg.loss_chunk)
+    logits = jax.lax.dot_general(
+        y, w, (((y.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    if b is not None:
+        logits = logits + b.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
+    return -ll.mean()
+
+
+def _vocab_proj(params: Dict, cfg: GPTConfig):
+    """(w [V, H], bias [V] | None) for the chunked-loss path."""
+    if cfg.tie_embeddings:
+        return params["wte"]["embedding"].astype(cfg.dtype), None
+    head = params["lm_head"]
+    b = head.get("bias")
+    return (head["kernel"].astype(cfg.dtype).T,
+            None if b is None else b.astype(cfg.dtype))
 
 
 def loss_fn(params: Dict, batch: Dict, rng: jax.Array, cfg: GPTConfig,
@@ -374,12 +411,21 @@ def loss_fn(params: Dict, batch: Dict, rng: jax.Array, cfg: GPTConfig,
     if targets is None:
         targets = tokens[:, 1:]
         tokens = tokens[:, :-1]
+    mask = batch.get("loss_mask")
+    if cfg.loss_chunk:
+        # fused vocab-projection + loss: never materializes [B, S, V]
+        # (ops/cross_entropy.py — frees ~3GB+ at GPT-2-1.5B scale)
+        from deepspeed_tpu.ops.cross_entropy import chunked_softmax_xent
+        x = forward(params, tokens, cfg, rng, deterministic=deterministic,
+                    pld_theta=batch.get("pld_theta"), hidden_only=True)
+        w, b = _vocab_proj(params, cfg)
+        return chunked_softmax_xent(x, w, targets, bias=b,
+                                    chunk=cfg.loss_chunk, loss_mask=mask)
     logits = forward(params, tokens, cfg, rng, deterministic=deterministic,
                      pld_theta=batch.get("pld_theta"))
     logits = logits.astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
-    mask = batch.get("loss_mask")
     if mask is not None:
         return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
     return -ll.mean()
@@ -473,12 +519,7 @@ def make_pipeline_loss_fn(cfg: GPTConfig, mesh, num_stages: int,
 
     def head_loss_fn(other, y, targets):
         y = _layernorm(y, other["ln_f"]["scale"], other["ln_f"]["bias"])
-        logits = (y @ other["wte"]["embedding"].astype(cfg.dtype).T
-                  if cfg.tie_embeddings
-                  else y @ other["lm_head"]["kernel"].astype(cfg.dtype))
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
-        return -ll.mean()
+        return _head_nll(other, y, targets, cfg)
 
     # block leaves: rank 2 -> P('pipe'), rank 3 -> P('pipe')
     def spec_of(leaf):
@@ -528,12 +569,7 @@ def layered_model(cfg: GPTConfig):
 
     def head_fn(other, y, targets):
         y = _layernorm(y, other["ln_f"]["scale"], other["ln_f"]["bias"])
-        logits = (y @ other["wte"]["embedding"].astype(cfg.dtype).T
-                  if cfg.tie_embeddings
-                  else y @ other["lm_head"]["kernel"].astype(cfg.dtype))
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
-        return -ll.mean()
+        return _head_nll(other, y, targets, cfg)
 
     return LayeredModel(split_params=split_params, embed_fn=embed_fn,
                         layer_fn=layer_fn, head_fn=head_fn,

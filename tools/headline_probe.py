@@ -1,0 +1,61 @@
+"""A/B probe for the 1.5B single-chip headline config.
+
+Each variant runs in a fresh subprocess (the rig's remote compile helper
+can 500 on repeat compiles in one process). Prints one JSON line per
+variant. Usage: python tools/headline_probe.py [variant ...]
+"""
+
+import json
+import subprocess
+import sys
+
+sys.path.insert(0, ".")
+
+VARIANTS = {
+    # name: (batch, remat_policy, loss_chunk)
+    "b16-full": (16, "full", 0),
+    "b16-full-ce": (16, "full", 2048),
+    "b16-flashonly-ce": (16, "flash_only", 2048),
+    "b24-full-ce": (24, "full", 2048),
+    "b24-flashonly-ce": (24, "flash_only", 2048),
+    "b32-full-ce": (32, "full", 2048),
+    "b16-sel-ce": (16, "selective", 2048),
+}
+
+
+def run_one(name):
+    batch, pol, lc = VARIANTS[name]
+    code = (
+        "import sys, json; sys.path.insert(0, '.')\n"
+        "from bench import run_config, MFU_BAR\n"
+        f"dt, tps, mfu = run_config('gpt2-1.5b', {batch}, 1024, 8,\n"
+        "    {'bf16': {'enabled': True, 'memory_efficient': True},\n"
+        "     'zero_optimization': {'stage': 3}},\n"
+        f"    True, flash_block=1024, remat_pol='{pol}', loss_chunk={lc})\n"
+        f"print(json.dumps({{'variant': '{name}', 'batch': {batch},\n"
+        f"    'remat': '{pol}', 'loss_chunk': {lc},\n"
+        "    'step_ms': round(dt*1e3, 1), 'tokens_per_s': round(tps, 1),\n"
+        "    'mfu': round(mfu, 4), 'vs_bar': round(mfu/MFU_BAR, 3)}))\n"
+    )
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=2400)
+    out = None
+    for line in reversed(r.stdout.splitlines()):
+        if line.startswith("{"):
+            out = line
+            break
+    if out:
+        print(out, flush=True)
+    else:
+        print(json.dumps({"variant": name, "rc": r.returncode,
+                          "err": r.stderr[-400:]}), flush=True)
+
+
+def main():
+    names = sys.argv[1:] or list(VARIANTS)
+    for n in names:
+        run_one(n)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,99 @@
+"""Long-context benchmark: flash on one chip, ring vs Ulysses on a mesh.
+
+The reference's long-sequence story is block-sparse attention (README
+claims 10x longer sequences, ref README.md:38); this framework's is exact
+attention — the Pallas flash kernel at long S on one chip, and
+sequence-parallel attention (ring / Ulysses) over the mesh. This tool
+measures both:
+
+  python tools/longcontext_bench.py chip   # real-TPU: GPT train step at 2k-16k
+  python tools/longcontext_bench.py mesh   # 8-dev CPU mesh: ring vs ulysses
+
+"chip" runs each sequence length in a fresh subprocess and prints one JSON
+line per config (attention-flops MFU rises with S — attention dominates).
+"mesh" checks ring/Ulysses parity against dense attention and prints step
+times (CPU wall times are indicative only; the point is the collective
+program compiles and the math matches).
+"""
+
+import json
+import subprocess
+import sys
+
+sys.path.insert(0, ".")
+
+CHIP_CODE = """
+import sys, json, time
+sys.path.insert(0, '.')
+import jax, numpy as np, jax.numpy as jnp
+from bench import run_config, peak_flops
+from deepspeed_tpu.models import gpt
+
+seq = {seq}
+batch = {batch}
+dt, tps, mfu = run_config('gpt2-small', batch, seq, 6,
+    {{'zero_optimization': {{'stage': 1}}}}, True,
+    flash_block=1024, remat_pol='{pol}', loss_chunk=2048)
+print(json.dumps({{'config': 'gpt2-small', 'seq': seq, 'batch': batch,
+    'remat': '{pol}',
+    'step_ms': round(dt*1e3, 1), 'tokens_per_s': round(tps, 1),
+    'mfu': round(mfu, 4)}}))
+"""
+
+
+def chip():
+    # tokens/step held ~constant: long S trades batch
+    grid = [(8, 2048, "selective"), (2, 8192, "selective"),
+            (1, 16384, "full")]
+    for batch, seq, pol in grid:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             CHIP_CODE.format(seq=seq, batch=batch, pol=pol)],
+            capture_output=True, text=True, timeout=2400)
+        line = next((ln for ln in reversed(r.stdout.splitlines())
+                     if ln.startswith("{")), None)
+        print(line or json.dumps({"seq": seq, "rc": r.returncode,
+                                  "err": r.stderr[-300:]}), flush=True)
+
+
+def mesh():
+    import os
+    os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+        " --xla_force_host_platform_device_count=8"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import time
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from deepspeed_tpu.ops.attention.flash import mha_reference
+    from deepspeed_tpu.ops.attention.ring import ring_attention
+    from deepspeed_tpu.ops.attention.ulysses import ulysses_attention
+
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("sequence",))
+    B, S, H, D = 1, 4096, 8, 64
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.standard_normal((B, S, H, D)),
+                           jnp.float32) * 0.3 for _ in range(3))
+    sh = NamedSharding(mesh, P(None, "sequence", None, None))
+    qs, ks, vs = (jax.device_put(t, sh) for t in (q, k, v))
+
+    dense = mha_reference(q, k, v, causal=True)
+    for name, fn in (("ring", ring_attention), ("ulysses", ulysses_attention)):
+        f = jax.jit(lambda a, b, c, fn=fn: fn(
+            a, b, c, mesh=mesh, axis="sequence", causal=True))
+        out = jax.block_until_ready(f(qs, ks, vs))
+        err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - dense)))
+        t0 = time.perf_counter()
+        for _ in range(3):
+            jax.block_until_ready(f(qs, ks, vs))
+        dt = (time.perf_counter() - t0) / 3
+        print(json.dumps({"impl": name, "seq": S, "sp": 8,
+                          "max_err_vs_dense": round(err, 6),
+                          "step_ms_cpu": round(dt * 1e3, 1)}), flush=True)
+
+
+if __name__ == "__main__":
+    (chip if (sys.argv[1:] or ["mesh"])[0] == "chip" else mesh)()
