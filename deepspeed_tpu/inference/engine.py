@@ -286,12 +286,7 @@ class InferenceEngine:
         if "mlm" not in params:
             return x
         dtype = x.dtype
-        h = x @ params["mlm"]["kernel"].astype(dtype) + \
-            params["mlm"]["bias"].astype(dtype)
-        h = jax.nn.gelu(h, approximate=True)
-        h = bert_lib._layernorm(h, params["mlm"]["ln"]["scale"].astype(dtype),
-                                params["mlm"]["ln"]["bias"].astype(dtype),
-                                self.cfg.layer_norm_eps)
+        h = bert_lib._mlm_hidden(params, x, self.cfg)
         return h @ params["embeddings"]["word"].astype(dtype).T + \
             params["mlm"]["decoder_bias"].astype(dtype)
 

@@ -38,6 +38,10 @@ class BertConfig:
     # bert_bench/pretrain call sites enable it)
     remat: bool = False
     remat_policy: str = "selective"   # see models.gpt.remat_policy
+    # fused chunked MLM cross-entropy (0 = dense log_softmax). At
+    # seq512 x batch32 the dense path materializes a 2GB fp32 [B,S,V]
+    # logits tensor; chunking caps it at ~chunk x V (ops/cross_entropy.py)
+    loss_chunk: int = 0
 
     @property
     def layer_config(self) -> DeepSpeedTransformerConfig:
@@ -147,6 +151,25 @@ def encode(params: Dict, tokens: jnp.ndarray, cfg: BertConfig,
     return x
 
 
+def _mlm_hidden(params: Dict, x: jnp.ndarray, cfg: BertConfig):
+    """MLM head transform: encoder states -> pre-decode hidden [B,S,d]."""
+    dtype = x.dtype
+    h = x @ params["mlm"]["kernel"].astype(dtype) + \
+        params["mlm"]["bias"].astype(dtype)
+    h = jax.nn.gelu(h, approximate=True)
+    return _layernorm(h, params["mlm"]["ln"]["scale"].astype(dtype),
+                      params["mlm"]["ln"]["bias"].astype(dtype),
+                      cfg.layer_norm_eps)
+
+
+def _nsp_logits(params: Dict, x: jnp.ndarray):
+    dtype = x.dtype
+    pooled = jnp.tanh(x[:, 0] @ params["pooler"]["kernel"].astype(dtype) +
+                      params["pooler"]["bias"].astype(dtype))
+    return pooled @ params["nsp"]["kernel"].astype(dtype) + \
+        params["nsp"]["bias"].astype(dtype)
+
+
 def forward(params: Dict, tokens: jnp.ndarray, cfg: BertConfig,
             token_type_ids=None, attention_mask=None,
             rng: Optional[jax.Array] = None,
@@ -156,20 +179,10 @@ def forward(params: Dict, tokens: jnp.ndarray, cfg: BertConfig,
                rng, deterministic)
     dtype = x.dtype
     # MLM head: transform -> LN -> tied-embedding decode
-    h = x @ params["mlm"]["kernel"].astype(dtype) + \
-        params["mlm"]["bias"].astype(dtype)
-    h = jax.nn.gelu(h, approximate=True)
-    h = _layernorm(h, params["mlm"]["ln"]["scale"].astype(dtype),
-                   params["mlm"]["ln"]["bias"].astype(dtype),
-                   cfg.layer_norm_eps)
+    h = _mlm_hidden(params, x, cfg)
     mlm_logits = h @ params["embeddings"]["word"].astype(dtype).T + \
         params["mlm"]["decoder_bias"].astype(dtype)
-    # NSP head on pooled [CLS]
-    pooled = jnp.tanh(x[:, 0] @ params["pooler"]["kernel"].astype(dtype) +
-                      params["pooler"]["bias"].astype(dtype))
-    nsp_logits = pooled @ params["nsp"]["kernel"].astype(dtype) + \
-        params["nsp"]["bias"].astype(dtype)
-    return mlm_logits, nsp_logits
+    return mlm_logits, _nsp_logits(params, x)
 
 
 def loss_fn(params: Dict, batch: Dict, rng: jax.Array, cfg: BertConfig,
@@ -177,17 +190,30 @@ def loss_fn(params: Dict, batch: Dict, rng: jax.Array, cfg: BertConfig,
     """MLM (+optional NSP) loss. batch:
     tokens [B,S]; mlm_labels [B,S] with -1 = not masked;
     optional token_type_ids, attention_mask, nsp_labels [B]."""
-    mlm_logits, nsp_logits = forward(
-        params, batch["tokens"], cfg,
-        token_type_ids=batch.get("token_type_ids"),
-        attention_mask=batch.get("attention_mask"),
-        rng=rng, deterministic=deterministic)
     labels = batch["mlm_labels"]
-    logp = jax.nn.log_softmax(mlm_logits.astype(jnp.float32), axis=-1)
-    picked = jnp.take_along_axis(
-        logp, jnp.maximum(labels, 0)[..., None], axis=-1).squeeze(-1)
     mask = (labels >= 0).astype(jnp.float32)
-    loss = -(picked * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    if cfg.loss_chunk:
+        from deepspeed_tpu.ops.cross_entropy import chunked_softmax_xent
+        x = encode(params, batch["tokens"], cfg,
+                   batch.get("token_type_ids"), batch.get("attention_mask"),
+                   rng, deterministic)
+        h = _mlm_hidden(params, x, cfg)
+        loss = chunked_softmax_xent(
+            h, params["embeddings"]["word"].astype(h.dtype),
+            jnp.maximum(labels, 0),
+            bias=params["mlm"]["decoder_bias"].astype(h.dtype),
+            chunk=cfg.loss_chunk, loss_mask=mask)
+        nsp_logits = _nsp_logits(params, x)
+    else:
+        mlm_logits, nsp_logits = forward(
+            params, batch["tokens"], cfg,
+            token_type_ids=batch.get("token_type_ids"),
+            attention_mask=batch.get("attention_mask"),
+            rng=rng, deterministic=deterministic)
+        logp = jax.nn.log_softmax(mlm_logits.astype(jnp.float32), axis=-1)
+        picked = jnp.take_along_axis(
+            logp, jnp.maximum(labels, 0)[..., None], axis=-1).squeeze(-1)
+        loss = -(picked * mask).sum() / jnp.maximum(mask.sum(), 1.0)
     if "nsp_labels" in batch:
         nsp_logp = jax.nn.log_softmax(nsp_logits.astype(jnp.float32), -1)
         loss = loss - jnp.mean(jnp.take_along_axis(

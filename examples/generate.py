@@ -32,10 +32,14 @@ def main():
 
     engine = deepspeed_tpu.init_inference(model=model)
     prompt = np.array([[1, 2, 3, 4]], np.int32)
-    out = engine.generate(prompt, max_new_tokens=args.tokens,
-                          temperature=0.8, seed=0)
+    # generate_fused runs the whole decode loop as ONE compiled program
+    # (no host round-trip per token); generate() is the host-driven loop
+    out = engine.generate_fused(prompt, max_new_tokens=args.tokens,
+                                temperature=0.8, seed=0)
     print("prompt:", prompt[0].tolist())
     print("generated:", np.asarray(out)[0].tolist())
+    print("latency:", {k: round(v, 2)
+                       for k, v in engine.latency_ms.items()})
 
 
 if __name__ == "__main__":

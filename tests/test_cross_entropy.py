@@ -134,3 +134,23 @@ def test_untied_head_with_bias():
                           dataclasses.replace(cfg, loss_chunk=4),
                           deterministic=True)
     np.testing.assert_allclose(chunked, dense, rtol=1e-5, atol=1e-6)
+
+
+def test_bert_mlm_loss_chunked_parity():
+    import dataclasses
+    from deepspeed_tpu.models import bert
+    cfg = bert.BertConfig(vocab_size=96, n_layers=2, n_heads=2, d_model=32,
+                          max_seq_len=32, dtype=jnp.float32, dropout=0.0)
+    params = bert.init_params(jax.random.PRNGKey(0), cfg)
+    r = np.random.default_rng(8)
+    labels = r.integers(0, 96, (2, 16)).astype(np.int32)
+    labels[r.random((2, 16)) > 0.2] = -1   # ~20% masked
+    batch = {"tokens": jnp.asarray(r.integers(0, 96, (2, 16)), jnp.int32),
+             "mlm_labels": jnp.asarray(labels),
+             "nsp_labels": jnp.asarray(r.integers(0, 2, (2,)), jnp.int32)}
+    rng = jax.random.PRNGKey(1)
+    dense = bert.loss_fn(params, batch, rng, cfg, deterministic=True)
+    chunked = bert.loss_fn(params, batch, rng,
+                           dataclasses.replace(cfg, loss_chunk=8),
+                           deterministic=True)
+    np.testing.assert_allclose(chunked, dense, rtol=1e-5, atol=1e-6)

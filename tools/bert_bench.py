@@ -41,7 +41,8 @@ def run(seq, batch, steps):
     on_tpu = jax.devices()[0].platform == "tpu"
     cfg = bert.preset("bert-large", max_seq_len=max(seq, 128),
                       dropout=0.0, dtype=jnp.bfloat16,
-                      remat=True, remat_policy="full")
+                      remat=True, remat_policy="full",
+                      loss_chunk=2048 if on_tpu else 0)
     params = bert.init_params(jax.random.PRNGKey(0), cfg)
     eng, _, _, _ = deepspeed_tpu.initialize(
         model=bert.make_loss_fn(cfg), model_parameters=params,
@@ -85,7 +86,8 @@ def main():
         return
     import subprocess
     steps = int(sys.argv[1]) if len(sys.argv) > 1 else 8
-    for seq, batch in [(128, 128), (128, 256), (512, 16), (512, 32)]:
+    for seq, batch in [(128, 128), (128, 256), (128, 512),
+                       (512, 16), (512, 32), (512, 64)]:
         r = subprocess.run(
             [sys.executable, __file__, "--one", str(seq), str(batch),
              str(steps)], capture_output=True, text=True)

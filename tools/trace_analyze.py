@@ -1,0 +1,132 @@
+"""Capture + analyze a device trace of the training step.
+
+Runs N traced train steps (any bench config) and aggregates the XPlane
+Chrome-trace events into a per-op-category time breakdown — the tool that
+turns "MFU is X%" into "Y ms goes to fusions / dots / the flash custom
+call / copies". TPU analog of reading an nsys timeline of the reference's
+NVTX ranges (ref: deepspeed/utils/nvtx.py + docs/_tutorials/pytorch-profiler.md).
+
+Usage:
+  python tools/trace_analyze.py run [preset] [batch] [remat] [loss_chunk]
+      — trains 2 traced steps on the local chip, writes /tmp/dstrace,
+        then analyzes it.
+  python tools/trace_analyze.py read /tmp/dstrace
+      — re-analyze an existing capture.
+"""
+
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+
+def categorize(name: str) -> str:
+    n = name.lower()
+    if "custom-call" in n or "tpu_custom_call" in n or "pallas" in n:
+        return "pallas kernels (flash etc.)"
+    if n.startswith("fusion") or ".fusion" in n:
+        return "XLA fusions (elementwise/LN/softmax)"
+    if "convolution" in n or n.startswith("dot") or "einsum" in n or \
+            "matmul" in n or ".dot" in n:
+        return "matmuls (MXU)"
+    if "all-reduce" in n or "all-gather" in n or "reduce-scatter" in n or \
+            "all-to-all" in n or "collective" in n or "permute" in n:
+        return "collectives"
+    if "copy" in n or "transpose" in n or "reshape" in n or "bitcast" in n:
+        return "copies/transposes"
+    if "dynamic-update-slice" in n or "dynamic-slice" in n or "slice" in n \
+            or "scatter" in n or "gather" in n or "pad" in n or "concat" in n:
+        return "slice/gather/pad"
+    if "infeed" in n or "outfeed" in n or "host" in n or "transfer" in n:
+        return "host transfer"
+    return "other"
+
+
+def analyze(log_dir: str, top: int = 25):
+    files = glob.glob(os.path.join(
+        log_dir, "**", "*.trace.json.gz"), recursive=True)
+    assert files, f"no trace.json.gz under {log_dir}"
+    path = max(files, key=os.path.getmtime)
+    with gzip.open(path, "rt") as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+
+    # device-lane complete events only (TensorCore ops have 'dur')
+    pid_names = {e["pid"]: e["args"].get("name", "")
+                 for e in events if e.get("ph") == "M"
+                 and e.get("name") == "process_name" and "args" in e}
+    dev_pids = {p for p, n in pid_names.items()
+                if "/device:TPU" in n or "TPU Core" in n or "TensorCore" in n}
+
+    by_op = collections.Counter()
+    by_cat = collections.Counter()
+    total = 0.0
+    for e in events:
+        if e.get("ph") != "X" or "dur" not in e:
+            continue
+        if dev_pids and e.get("pid") not in dev_pids:
+            continue
+        name = e.get("name", "?")
+        dur = e["dur"]  # microseconds
+        by_op[name] += dur
+        by_cat[categorize(name)] += dur
+        total += dur
+
+    print(json.dumps({"trace": os.path.relpath(path, log_dir),
+                      "total_device_us": round(total, 1)}))
+    print("\n-- by category --")
+    for cat, us in by_cat.most_common():
+        print(f"{us/1e3:10.2f} ms  {100*us/max(total,1e-9):5.1f}%  {cat}")
+    print(f"\n-- top {top} ops --")
+    for name, us in by_op.most_common(top):
+        print(f"{us/1e3:10.2f} ms  {100*us/max(total,1e-9):5.1f}%  {name[:110]}")
+
+
+def run():
+    import jax
+    import numpy as np
+
+    preset = sys.argv[2] if len(sys.argv) > 2 else "gpt2-1.5b"
+    batch = int(sys.argv[3]) if len(sys.argv) > 3 else 16
+    remat = sys.argv[4] if len(sys.argv) > 4 else "full"
+    loss_chunk = int(sys.argv[5]) if len(sys.argv) > 5 else 2048
+
+    import deepspeed_tpu
+    from bench import run_config  # engine path identical to the bench
+    from deepspeed_tpu.models import gpt
+    import jax.numpy as jnp
+
+    cfg = gpt.preset(preset, max_seq_len=1024, dtype=jnp.bfloat16,
+                     remat=True, remat_policy=remat,
+                     use_flash_attention=True, flash_block_q=1024,
+                     flash_block_kv=1024, loss_chunk=loss_chunk)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt.make_loss_fn(cfg), model_parameters=params,
+        config={"train_batch_size": batch,
+                "bf16": {"enabled": True, "memory_efficient": True},
+                "zero_optimization": {"stage": 3},
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+                "steps_per_print": 10_000})
+    del params
+    tokens = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (batch, 1025)).astype(np.int32)
+    data = {"tokens": tokens}
+    jax.block_until_ready(engine.train_batch(data)["loss"])  # compile
+
+    log_dir = "/tmp/dstrace"
+    engine.start_trace(log_dir, steps=2)
+    for _ in range(2):
+        float(engine.train_batch(data)["loss"])
+    analyze(log_dir)
+
+
+if __name__ == "__main__":
+    if sys.argv[1:] and sys.argv[1] == "read":
+        analyze(sys.argv[2])
+    else:
+        run()
