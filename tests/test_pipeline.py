@@ -233,3 +233,22 @@ def test_pipeline_with_fsdp(devices):
     shard = qkv.sharding.shard_shape(qkv.shape)
     assert shard[0] == cfg.n_layers // 2                  # pipe
     assert int(np.prod(shard)) == int(np.prod(qkv.shape)) // 4  # + fsdp
+
+
+def test_pipeline_loss_chunked_ce(devices):
+    """The pipelined head honors loss_chunk (fused chunked CE) and still
+    matches the dense single-program loss."""
+    cfg = tiny_cfg(n_layers=4, loss_chunk=16)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = np.random.default_rng(2).integers(0, 128, (8, 17)).astype(np.int32)
+    batch = {"tokens": jnp.asarray(tokens)}
+
+    import dataclasses
+    dense_cfg = dataclasses.replace(cfg, loss_chunk=0)
+    ref = float(gpt.loss_fn(params, dict(batch), jax.random.PRNGKey(0),
+                            dense_cfg, deterministic=True))
+    mesh = make_mesh(MeshSpec(pipe=4, data=-1))
+    loss_fn = gpt.make_pipeline_loss_fn(cfg, mesh, num_stages=4, num_micro=2)
+    with jax.set_mesh(mesh):
+        pl_loss = float(jax.jit(loss_fn)(params, batch, jax.random.PRNGKey(0)))
+    np.testing.assert_allclose(ref, pl_loss, rtol=1e-5)

@@ -1,0 +1,77 @@
+"""Serialized on-chip measurement queue.
+
+The rig exposes ONE real TPU through a tunnel whose remote compile helper
+wedges under concurrent use and borderline-HBM compiles (see PERF.md).
+This driver runs each measurement in its own subprocess, STRICTLY one at
+a time, with a health probe between items — fire it once and collect
+every number needed for PERF.md/BENCH in a single pass.
+
+Usage: python tools/chip_queue.py [item ...]
+Items default to the full queue; each prints its JSON line(s) as it lands.
+"""
+
+import json
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+HEALTH = (
+    "import jax, jax.numpy as jnp\n"
+    "print('devices', jax.devices())\n"
+    "print('ok', float(jax.jit(lambda a: (a@a).sum())"
+    "(jnp.ones((256,256), jnp.bfloat16))))\n"
+)
+
+QUEUE = [
+    ("trace-1.5b", [sys.executable, "tools/trace_analyze.py", "run",
+                    "gpt2-1.5b", "16", "full", "2048"], 1500),
+    # outer budgets cover each tool's own per-config 1500s timeouts
+    ("bert-grid", [sys.executable, "tools/bert_bench.py", "8"], 9200),
+    ("moe", [sys.executable, "tools/moe_bench.py", "8"], 6200),
+    ("longcontext", [sys.executable, "tools/longcontext_bench.py", "chip"],
+     3600),
+]
+
+
+def healthy(timeout=180):
+    try:
+        r = subprocess.run([sys.executable, "-c", HEALTH],
+                           capture_output=True, text=True, timeout=timeout)
+        return r.returncode == 0 and "ok" in r.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def main():
+    wanted = sys.argv[1:]
+    items = [q for q in QUEUE if not wanted or q[0] in wanted]
+    for name, cmd, tmo in items:
+        if not healthy():
+            print(json.dumps({"item": name, "skipped": "chip unhealthy"}),
+                  flush=True)
+            time.sleep(60)
+            continue
+        t0 = time.time()
+        try:
+            r = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=tmo)
+            print(f"== {name} (rc={r.returncode}, "
+                  f"{round(time.time()-t0)}s) ==", flush=True)
+            print(r.stdout.strip()[-4000:], flush=True)
+            if r.returncode != 0:
+                print("stderr:", r.stderr.strip()[-600:], flush=True)
+        except subprocess.TimeoutExpired as e:
+            # keep whatever JSON lines already landed before the hang
+            partial = (e.stdout or b"")
+            if isinstance(partial, bytes):
+                partial = partial.decode("utf-8", "replace")
+            print(json.dumps({"item": name, "timeout_s": tmo}), flush=True)
+            if partial.strip():
+                print(f"partial output before timeout:\n"
+                      f"{partial.strip()[-2000:]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

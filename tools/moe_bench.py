@@ -26,9 +26,12 @@ on_tpu = 'tpu' in (jax.devices()[0].platform + jax.devices()[0].device_kind).low
 
 if kind == 'dense':
     from deepspeed_tpu.models import gpt as M
+    # match the MoE path's cost model: moe_gpt remats with
+    # nothing_saveable (full) and uses the dense CE — keep both equal so
+    # the ratio isolates DISPATCH cost, not remat/CE differences
     cfg = M.preset('gpt2-small', max_seq_len=seq, dtype=jnp.bfloat16,
                    remat=True, remat_policy='full', use_flash_attention=on_tpu,
-                   loss_chunk=2048)
+                   loss_chunk=0)
 else:
     from deepspeed_tpu.models import moe_gpt as M
     cfg = M.MoEGPTConfig(n_layers=12, n_heads=12, d_model=768,
@@ -66,16 +69,20 @@ def main():
     batch, seq = 8, 1024
     grid = [("dense", 0, 0), ("moe", 8, 1), ("moe", 8, 2), ("moe", 16, 1)]
     for kind, experts, k in grid:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             CODE.format(kind=kind, experts=experts, k=k, batch=batch,
-                         seq=seq, steps=steps)],
-            capture_output=True, text=True, timeout=2400)
-        line = next((ln for ln in reversed(r.stdout.splitlines())
-                     if ln.startswith("{")), None)
-        print(line or json.dumps({"kind": kind, "experts": experts,
-                                  "rc": r.returncode,
-                                  "err": r.stderr[-300:]}), flush=True)
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 CODE.format(kind=kind, experts=experts, k=k, batch=batch,
+                             seq=seq, steps=steps)],
+                capture_output=True, text=True, timeout=1500)
+            line = next((ln for ln in reversed(r.stdout.splitlines())
+                         if ln.startswith("{")), None)
+            print(line or json.dumps({"kind": kind, "experts": experts,
+                                      "rc": r.returncode,
+                                      "err": r.stderr[-300:]}), flush=True)
+        except subprocess.TimeoutExpired:
+            print(json.dumps({"kind": kind, "experts": experts,
+                              "timeout_s": 1500}), flush=True)
 
 
 if __name__ == "__main__":

@@ -62,6 +62,11 @@ def analyze(log_dir: str, top: int = 25):
     dev_pids = {p for p, n in pid_names.items()
                 if "/device:TPU" in n or "TPU Core" in n or "TensorCore" in n}
 
+    if not dev_pids:
+        print("WARNING: no TPU device lane matched — totals below include "
+              "HOST lanes and are not a device-time breakdown",
+              file=sys.stderr)
+
     by_op = collections.Counter()
     by_cat = collections.Counter()
     total = 0.0
@@ -96,7 +101,6 @@ def run():
     loss_chunk = int(sys.argv[5]) if len(sys.argv) > 5 else 2048
 
     import deepspeed_tpu
-    from bench import run_config  # engine path identical to the bench
     from deepspeed_tpu.models import gpt
     import jax.numpy as jnp
 
