@@ -19,10 +19,18 @@ as round 1, for cross-round comparability.
 """
 
 import json
+import os
 import sys
 import time
 
 import jax
+
+# honor an explicit CPU request even though the rig's sitecustomize
+# imports jax early (the env var alone is ignored after import; a hung
+# TPU tunnel would otherwise block jax.devices() forever)
+if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
 import jax.numpy as jnp
 import numpy as np
 

@@ -109,8 +109,18 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_kv):
     def qmap(b, h, qi, ki):
         return (b, h, qi, 0)
 
-    def kvmap(b, h, qi, ki):
-        return (b, h, ki, 0)
+    if causal:
+        # skipped above-diagonal steps re-map to the last valid KV block:
+        # the index equals the previous step's, so Mosaic elides the DMA
+        # (the compute is already skipped by pl.when). Halves K/V HBM
+        # reads at long S. Clamped into range for Skv != S callers.
+        def kvmap(b, h, qi, ki):
+            limit = jnp.minimum((qi * block_q + block_q - 1) // block_kv,
+                                num_kv - 1)
+            return (b, h, jnp.minimum(ki, limit), 0)
+    else:
+        def kvmap(b, h, qi, ki):
+            return (b, h, ki, 0)
 
     grid = (B, H, num_q, num_kv)
     kernel = functools.partial(
@@ -262,8 +272,15 @@ def _flash_bwd(causal, scale, block_q, block_kv, res, g):
     def qmap(b, h, i, j):
         return (b, h, i, 0)
 
-    def kvmap_q_outer(b, h, i, j):
-        return (b, h, j, 0)
+    if causal:
+        # clamp skipped steps to the last valid block — DMA elided (see fwd)
+        def kvmap_q_outer(b, h, i, j):
+            limit = jnp.minimum((i * block_q + block_q - 1) // block_kv,
+                                num_kv - 1)
+            return (b, h, jnp.minimum(j, limit), 0)
+    else:
+        def kvmap_q_outer(b, h, i, j):
+            return (b, h, j, 0)
 
     # ---- dq ----
     dq = pl.pallas_call(
@@ -289,8 +306,17 @@ def _flash_bwd(causal, scale, block_q, block_kv, res, g):
     def kvmap(b, h, ki, qi):
         return (b, h, ki, 0)
 
-    def qmap_kv_outer(b, h, ki, qi):
-        return (b, h, qi, 0)
+    if causal:
+        # early q blocks are above the diagonal for this kv block: clamp
+        # to the first valid q block so the skipped steps' fetches elide
+        # (min'd into range for Skv > S callers, where no q block may be
+        # valid for the last kv blocks)
+        def qmap_kv_outer(b, h, ki, qi):
+            first = jnp.minimum((ki * block_kv) // block_q, num_q - 1)
+            return (b, h, jnp.maximum(qi, first), 0)
+    else:
+        def qmap_kv_outer(b, h, ki, qi):
+            return (b, h, qi, 0)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale,

@@ -316,3 +316,17 @@ def test_generate_fused_sampled_valid(devices):
     loop = eng.generate(tokens, max_new_tokens=6, temperature=0.8,
                         top_k=10, seed=7)
     np.testing.assert_array_equal(out, loop)
+
+
+def test_tp_generate_fused_matches_single(devices):
+    """Fused-scan generation under tensor-parallel inference reproduces
+    the single-device greedy sequence."""
+    cfg, params = tiny()
+    ref_eng = InferenceEngine(config=cfg, params=params, dtype=jnp.float32)
+    tokens = np.random.default_rng(9).integers(0, 128, (1, 8)).astype(np.int32)
+    ref = ref_eng.generate_fused(tokens, max_new_tokens=5)
+
+    tp_eng = InferenceEngine(config=cfg, params=params, dtype=jnp.float32,
+                             mp_size=2)
+    out = tp_eng.generate_fused(tokens, max_new_tokens=5)
+    np.testing.assert_array_equal(ref, out)

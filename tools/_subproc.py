@@ -1,0 +1,21 @@
+"""Shared helper for the on-chip bench tools: run one measurement config
+in a subprocess with a timeout and print exactly one JSON line."""
+
+import json
+import subprocess
+import sys
+
+
+def run_json(cmd, timeout, tag):
+    """Run cmd; print its last JSON stdout line, or a {**tag, ...} error
+    line on failure/timeout. Never raises."""
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout)
+    except subprocess.TimeoutExpired:
+        print(json.dumps({**tag, "timeout_s": timeout}), flush=True)
+        return
+    line = next((ln for ln in reversed(r.stdout.splitlines())
+                 if ln.startswith("{")), None)
+    print(line or json.dumps({**tag, "rc": r.returncode,
+                              "err": r.stderr[-300:]}), flush=True)

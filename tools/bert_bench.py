@@ -86,24 +86,14 @@ def main():
         return
     import subprocess
     steps = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    from tools._subproc import run_json
+
+    # per-config 1500s timeout: borderline-HBM compiles can grind >20min
+    # on this rig (PERF.md) — report and keep going
     for seq, batch in [(128, 128), (128, 256), (128, 512),
                        (512, 16), (512, 32), (512, 64)]:
-        try:
-            r = subprocess.run(
-                [sys.executable, __file__, "--one", str(seq), str(batch),
-                 str(steps)], capture_output=True, text=True, timeout=1500)
-        except subprocess.TimeoutExpired:
-            # borderline-HBM compiles can grind >20min on this rig
-            # (PERF.md) — report and keep going
-            print(json.dumps({"seq": seq, "batch": batch,
-                              "timeout_s": 1500}), flush=True)
-            continue
-        line = [l for l in r.stdout.splitlines() if l.startswith("{")]
-        if line:
-            print(line[-1], flush=True)
-        else:
-            print(json.dumps({"seq": seq, "batch": batch,
-                              "error": r.stderr[-140:]}), flush=True)
+        run_json([sys.executable, __file__, "--one", str(seq), str(batch),
+                  str(steps)], 1500, {"seq": seq, "batch": batch})
 
 
 if __name__ == "__main__":

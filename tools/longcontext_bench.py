@@ -17,7 +17,6 @@ program compiles and the math matches).
 """
 
 import json
-import subprocess
 import sys
 
 sys.path.insert(0, ".")
@@ -42,18 +41,15 @@ print(json.dumps({{'config': 'gpt2-small', 'seq': seq, 'batch': batch,
 
 
 def chip():
+    from tools._subproc import run_json
+
     # tokens/step held ~constant: long S trades batch
     grid = [(8, 2048, "selective"), (2, 8192, "selective"),
             (1, 16384, "full")]
     for batch, seq, pol in grid:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             CHIP_CODE.format(seq=seq, batch=batch, pol=pol)],
-            capture_output=True, text=True, timeout=2400)
-        line = next((ln for ln in reversed(r.stdout.splitlines())
-                     if ln.startswith("{")), None)
-        print(line or json.dumps({"seq": seq, "rc": r.returncode,
-                                  "err": r.stderr[-300:]}), flush=True)
+        run_json([sys.executable, "-c",
+                  CHIP_CODE.format(seq=seq, batch=batch, pol=pol)],
+                 1500, {"seq": seq, "batch": batch})
 
 
 def mesh():

@@ -8,8 +8,6 @@ dispatch overhead ratio. Each config runs in a fresh subprocess.
 Usage: python tools/moe_bench.py [steps]
 """
 
-import json
-import subprocess
 import sys
 
 sys.path.insert(0, ".")
@@ -68,21 +66,13 @@ def main():
     steps = int(sys.argv[1]) if len(sys.argv) > 1 else 8
     batch, seq = 8, 1024
     grid = [("dense", 0, 0), ("moe", 8, 1), ("moe", 8, 2), ("moe", 16, 1)]
+    from tools._subproc import run_json
+
     for kind, experts, k in grid:
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c",
-                 CODE.format(kind=kind, experts=experts, k=k, batch=batch,
-                             seq=seq, steps=steps)],
-                capture_output=True, text=True, timeout=1500)
-            line = next((ln for ln in reversed(r.stdout.splitlines())
-                         if ln.startswith("{")), None)
-            print(line or json.dumps({"kind": kind, "experts": experts,
-                                      "rc": r.returncode,
-                                      "err": r.stderr[-300:]}), flush=True)
-        except subprocess.TimeoutExpired:
-            print(json.dumps({"kind": kind, "experts": experts,
-                              "timeout_s": 1500}), flush=True)
+        run_json([sys.executable, "-c",
+                  CODE.format(kind=kind, experts=experts, k=k, batch=batch,
+                              seq=seq, steps=steps)],
+                 1500, {"kind": kind, "experts": experts})
 
 
 if __name__ == "__main__":
