@@ -82,8 +82,9 @@ def _moe_block(x, layer_params, cfg: MoEGPTConfig, rng, train: bool):
 
 def forward(params: Dict, tokens: jnp.ndarray, cfg: MoEGPTConfig,
             rng: Optional[jax.Array] = None,
-            train: bool = True) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """-> (logits [B,S,V], total_l_aux)."""
+            train: bool = True,
+            hidden_only: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """-> (logits [B,S,V] — or post-ln_f hidden states —, total_l_aux)."""
     B, S = tokens.shape
     dtype = cfg.dtype
     wte = params["wte"]["embedding"].astype(dtype)
@@ -103,6 +104,8 @@ def forward(params: Dict, tokens: jnp.ndarray, cfg: MoEGPTConfig,
         body_fn, (x, jnp.zeros([], jnp.float32), rng), params["block"])
 
     x = _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    if hidden_only:
+        return x, aux / cfg.n_layers
     logits = x @ wte.T if cfg.tie_embeddings else \
         x @ params["lm_head"]["kernel"].astype(dtype)
     return logits, aux / cfg.n_layers
@@ -114,6 +117,11 @@ def loss_fn(params, batch, rng, cfg: MoEGPTConfig, train: bool = True):
     if targets is None:
         targets = tokens[:, 1:]
         tokens = tokens[:, :-1]
+    if cfg.loss_chunk:
+        from deepspeed_tpu.models.gpt import _head_nll
+        x, l_aux = forward(params, tokens, cfg, rng, train, hidden_only=True)
+        lm_loss = _head_nll(params, x, targets, cfg)
+        return lm_loss + cfg.aux_loss_weight * l_aux
     logits, l_aux = forward(params, tokens, cfg, rng, train)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)

@@ -37,6 +37,21 @@ def _ceil_to(x, m):
     return (x + m - 1) // m * m
 
 
+def _causal_kv_index_map(block_q, block_kv, num_kv):
+    """Block index map for KV-blocked inputs when the grid is
+    (b, h, q-block, kv-block) and causal skipping applies: skipped
+    above-diagonal steps re-map to the last valid KV block, so the index
+    equals the previous step's and Mosaic elides the DMA (the compute is
+    already skipped by pl.when). Clamped into range for Skv != S callers."""
+
+    def kvmap(b, h, qi, ki):
+        limit = jnp.minimum((qi * block_q + block_q - 1) // block_kv,
+                            num_kv - 1)
+        return (b, h, jnp.minimum(ki, limit), 0)
+
+    return kvmap
+
+
 # ---------------------------------------------------------------------------
 # forward kernel
 # ---------------------------------------------------------------------------
@@ -110,14 +125,7 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_kv):
         return (b, h, qi, 0)
 
     if causal:
-        # skipped above-diagonal steps re-map to the last valid KV block:
-        # the index equals the previous step's, so Mosaic elides the DMA
-        # (the compute is already skipped by pl.when). Halves K/V HBM
-        # reads at long S. Clamped into range for Skv != S callers.
-        def kvmap(b, h, qi, ki):
-            limit = jnp.minimum((qi * block_q + block_q - 1) // block_kv,
-                                num_kv - 1)
-            return (b, h, jnp.minimum(ki, limit), 0)
+        kvmap = _causal_kv_index_map(block_q, block_kv, num_kv)
     else:
         def kvmap(b, h, qi, ki):
             return (b, h, ki, 0)
@@ -273,11 +281,7 @@ def _flash_bwd(causal, scale, block_q, block_kv, res, g):
         return (b, h, i, 0)
 
     if causal:
-        # clamp skipped steps to the last valid block — DMA elided (see fwd)
-        def kvmap_q_outer(b, h, i, j):
-            limit = jnp.minimum((i * block_q + block_q - 1) // block_kv,
-                                num_kv - 1)
-            return (b, h, jnp.minimum(j, limit), 0)
+        kvmap_q_outer = _causal_kv_index_map(block_q, block_kv, num_kv)
     else:
         def kvmap_q_outer(b, h, i, j):
             return (b, h, j, 0)

@@ -130,3 +130,28 @@ def test_tp_engine(devices):
         m = engine.train_batch(random_batch(16, HIDDEN, seed=i))
         losses.append(float(m["loss"]))
     assert losses[-1] < losses[0]
+
+def test_prefetch_loader(devices):
+    """PrefetchLoader yields pre-sharded batches one step ahead; training
+    through it matches the expected number of steps with device-committed
+    arrays."""
+    from deepspeed_tpu.runtime.dataloader import (DeepSpeedDataLoader,
+                                                  PrefetchLoader)
+    from tests.simple_model import simple_model_loss, simple_model_params
+    params = simple_model_params(hidden_dim=HIDDEN, nlayers=2, seed=0)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_model_loss, model_parameters=params,
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "steps_per_print": 1000})
+    r = np.random.default_rng(0)
+    data = [{"x": r.standard_normal(HIDDEN).astype(np.float32),
+             "y": np.zeros((), np.float32)} for _ in range(24)]
+    loader = DeepSpeedDataLoader(data, batch_size=8, shuffle=False)
+    seen = 0
+    for batch in PrefetchLoader(loader, engine, depth=2):
+        import jax
+        assert all(isinstance(v, jax.Array) for v in batch.values())
+        engine.train_batch(batch)
+        seen += 1
+    assert seen == 3

@@ -139,3 +139,22 @@ def test_top2_matches_top1_structure(devices):
     d = np.asarray(out.dispatch)
     slot_use = d.sum(axis=1)
     assert slot_use.max() <= 1
+
+
+def test_moe_loss_chunked_parity(devices):
+    import dataclasses
+    from deepspeed_tpu.models import moe_gpt
+    cfg = moe_gpt.MoEGPTConfig(
+        vocab_size=128, n_layers=2, n_heads=2, d_model=32, max_seq_len=32,
+        dtype=jnp.float32, use_flash_attention=False, remat=False,
+        num_experts=4, moe_k=1)
+    params = moe_gpt.init_params(jax.random.PRNGKey(0), cfg)
+    batch = {"tokens": jnp.asarray(
+        np.random.default_rng(11).integers(0, 128, (4, 17)), jnp.int32)}
+    rng = jax.random.PRNGKey(1)
+    dense = moe_gpt.loss_fn(params, batch, rng, cfg, train=False)
+    chunked = moe_gpt.loss_fn(params, batch, rng,
+                              dataclasses.replace(cfg, loss_chunk=16),
+                              train=False)
+    np.testing.assert_allclose(np.asarray(chunked), np.asarray(dense),
+                               rtol=1e-5, atol=1e-6)

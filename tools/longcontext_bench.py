@@ -43,7 +43,11 @@ print(json.dumps({{'config': 'gpt2-small', 'seq': seq, 'batch': batch,
 def chip():
     from tools._subproc import run_json
 
-    # tokens/step held ~constant: long S trades batch
+    # tokens/step held ~constant: long S trades batch. 1500s/config
+    # (matching the other bench tools, and 3x1500 fits chip_queue's
+    # 4800s item budget): on this rig a compile that runs longer is in
+    # the borderline-HBM grind and will not produce a number anyway
+    # (PERF.md).
     grid = [(8, 2048, "selective"), (2, 8192, "selective"),
             (1, 16384, "full")]
     for batch, seq, pol in grid:
