@@ -19,22 +19,19 @@ as round 1, for cross-round comparability.
 """
 
 import json
-import os
 import sys
 import time
 
 import jax
 
-# honor an explicit CPU request even though the rig's sitecustomize
-# imports jax early (the env var alone is ignored after import; a hung
-# TPU tunnel would otherwise block jax.devices() forever)
-if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-    jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, ".")
+
+from deepspeed_tpu.utils import honor_platform_request
+
+honor_platform_request()   # make JAX_PLATFORMS=cpu work despite sitecustomize
 
 import jax.numpy as jnp
 import numpy as np
-
-sys.path.insert(0, ".")
 
 # per-chip bf16 peak FLOPS by device kind
 PEAK_FLOPS = {

@@ -117,16 +117,11 @@ def loss_fn(params, batch, rng, cfg: MoEGPTConfig, train: bool = True):
     if targets is None:
         targets = tokens[:, 1:]
         tokens = tokens[:, :-1]
-    if cfg.loss_chunk:
-        from deepspeed_tpu.models.gpt import _head_nll
-        x, l_aux = forward(params, tokens, cfg, rng, train, hidden_only=True)
-        lm_loss = _head_nll(params, x, targets, cfg)
-        return lm_loss + cfg.aux_loss_weight * l_aux
-    logits, l_aux = forward(params, tokens, cfg, rng, train)
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
-    lm_loss = -ll.mean()
-    return lm_loss + cfg.aux_loss_weight * l_aux
+    # _head_nll owns the CE math for both paths (dense log_softmax, or
+    # the fused chunked CE when cfg.loss_chunk is set)
+    from deepspeed_tpu.models.gpt import _head_nll
+    x, l_aux = forward(params, tokens, cfg, rng, train, hidden_only=True)
+    return _head_nll(params, x, targets, cfg) + cfg.aux_loss_weight * l_aux
 
 
 def make_loss_fn(cfg: MoEGPTConfig):

@@ -1,0 +1,14 @@
+import os
+
+
+def honor_platform_request() -> None:
+    """Apply an explicit ``JAX_PLATFORMS`` env request through jax.config.
+
+    Some environments pre-import jax from a sitecustomize with another
+    platform pinned; setting the env var afterwards is silently ignored
+    and a dead accelerator tunnel can then hang ``jax.devices()`` forever.
+    Call this before first device use (bench.py and the examples do)."""
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if want:
+        import jax
+        jax.config.update("jax_platforms", want)

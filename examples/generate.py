@@ -10,6 +10,10 @@ import sys
 
 sys.path.insert(0, ".")
 
+from deepspeed_tpu.utils import honor_platform_request
+
+honor_platform_request()   # make JAX_PLATFORMS=cpu work despite sitecustomize
+
 import numpy as np
 
 import deepspeed_tpu

@@ -14,6 +14,11 @@ import time
 sys.path.insert(0, ".")
 
 import jax
+
+from deepspeed_tpu.utils import honor_platform_request
+
+honor_platform_request()   # make JAX_PLATFORMS=cpu work despite sitecustomize
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -42,7 +47,9 @@ def main():
 
     on_tpu = jax.devices()[0].platform == "tpu"
     cfg = gpt.preset(args.preset, max_seq_len=args.seq,
-                     dtype=jnp.bfloat16, use_flash_attention=on_tpu)
+                     dtype=jnp.bfloat16, use_flash_attention=on_tpu,
+                     # fused chunked CE: skips the [B,S,V] logits tensor
+                     loss_chunk=2048)
     params = gpt.init_params(jax.random.PRNGKey(0), cfg)
 
     ds_config = args.deepspeed_config or {
