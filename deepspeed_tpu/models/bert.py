@@ -228,6 +228,61 @@ def make_loss_fn(cfg: BertConfig):
     return _loss
 
 
+# ---------------------------------------------------------------------------
+# SQuAD fine-tuning head (the BingBertSquad workload,
+# ref: tests/model/BingBertSquad + DeepSpeedExamples' nvidia/modeling
+# BertForQuestionAnswering — a start/end span classifier on the encoder)
+# ---------------------------------------------------------------------------
+
+def init_squad_head(rng: jax.Array, cfg: BertConfig) -> Dict:
+    """Span-prediction head params: add under params["qa"]."""
+    return {"kernel": jax.random.normal(rng, (cfg.d_model, 2)) * 0.02,
+            "bias": jnp.zeros((2,))}
+
+
+def squad_logits(params: Dict, tokens: jnp.ndarray, cfg: BertConfig,
+                 token_type_ids=None, attention_mask=None,
+                 rng: Optional[jax.Array] = None,
+                 deterministic: bool = True):
+    """-> (start_logits [B, S], end_logits [B, S]) fp32."""
+    x = encode(params, tokens, cfg, token_type_ids, attention_mask,
+               rng, deterministic)
+    qa = params["qa"]
+    logits = x @ qa["kernel"].astype(x.dtype) + qa["bias"].astype(x.dtype)
+    s, e = jnp.split(logits.astype(jnp.float32), 2, axis=-1)
+    return s[..., 0], e[..., 0]
+
+
+def squad_loss_fn(params: Dict, batch: Dict, rng: jax.Array,
+                  cfg: BertConfig, deterministic: bool = False):
+    """Mean of start/end-position cross-entropies. batch: tokens [B,S],
+    start_positions [B], end_positions [B], optional token_type_ids /
+    attention_mask."""
+    s_logits, e_logits = squad_logits(
+        params, batch["tokens"], cfg, batch.get("token_type_ids"),
+        batch.get("attention_mask"), rng, deterministic)
+    S = s_logits.shape[1]
+
+    def xent(logits, pos):
+        # out-of-range positions (e.g. unanswerable examples marked with
+        # seq_len, the reference's ignored_index convention, or -1) are
+        # excluded from the loss
+        valid = ((pos >= 0) & (pos < S)).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        picked = jnp.take_along_axis(
+            logp, jnp.clip(pos, 0, S - 1)[:, None], axis=-1)[:, 0]
+        return -(picked * valid).sum() / jnp.maximum(valid.sum(), 1.0)
+
+    return 0.5 * (xent(s_logits, batch["start_positions"]) +
+                  xent(e_logits, batch["end_positions"]))
+
+
+def make_squad_loss_fn(cfg: BertConfig):
+    def _loss(params, batch, rng):
+        return squad_loss_fn(params, batch, rng, cfg)
+    return _loss
+
+
 def bert_partition_rules(vocab_parallel: bool = False):
     """TP rules: column-parallel qkv/mlp_in, row-parallel
     attn_out/mlp_out — the Megatron recipe the reference delegates to
