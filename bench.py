@@ -59,12 +59,13 @@ def _on_tpu() -> bool:
 
 
 def run_config(preset, batch, seq, steps, ds_overrides, on_tpu,
-               flash_block=1024, remat_pol="selective", loss_chunk=0):
+               flash_block=1024, remat_pol="selective", loss_chunk=0,
+               remat=True):
     import deepspeed_tpu
     from deepspeed_tpu.models import gpt
 
     cfg = gpt.preset(preset, max_seq_len=seq, dtype=jnp.bfloat16,
-                     remat=True, remat_policy=remat_pol,
+                     remat=remat, remat_policy=remat_pol,
                      use_flash_attention=on_tpu,
                      flash_block_q=flash_block, flash_block_kv=flash_block,
                      loss_chunk=loss_chunk)

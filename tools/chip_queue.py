@@ -23,6 +23,8 @@ HEALTH = (
 )
 
 QUEUE = [
+    ("probe", [sys.executable, "tools/headline_probe.py",
+               "med-b8-noremat", "med-b16-noremat", "med-b16-ce"], 7400),
     ("trace-1.5b", [sys.executable, "tools/trace_analyze.py", "run",
                     "gpt2-1.5b", "16", "full", "2048"], 1500),
     # outer budgets cover each tool's own per-config 1500s timeouts

@@ -1,60 +1,58 @@
-"""A/B probe for the 1.5B single-chip headline config.
+"""A/B probe for single-chip bench configs.
 
-Each variant runs in a fresh subprocess (the rig's remote compile helper
-can 500 on repeat compiles in one process). Prints one JSON line per
-variant. Usage: python tools/headline_probe.py [variant ...]
+A thin wrapper over ``bench.run_config`` (same engine path, warmup,
+per-step-synced median timing and MFU accounting as the driver bench)
+run once per variant in a fresh subprocess (the rig's remote compile
+helper can 500 on repeat compiles in one process). Prints one JSON line
+per variant. Usage: python tools/headline_probe.py [variant ...]
 """
 
-import json
-import subprocess
 import sys
 
 sys.path.insert(0, ".")
 
+from tools._subproc import run_json  # noqa: E402
+
+# name: (preset, batch, remat(True/False), remat_policy, loss_chunk, stage,
+#        memory_efficient)
 VARIANTS = {
-    # name: (batch, remat_policy, loss_chunk)
-    "b16-full": (16, "full", 0),
-    "b16-full-ce": (16, "full", 2048),
-    "b16-flashonly-ce": (16, "flash_only", 2048),
-    "b24-full-ce": (24, "full", 2048),
-    "b24-flashonly-ce": (24, "flash_only", 2048),
-    "b32-full-ce": (32, "full", 2048),
-    "b16-sel-ce": (16, "selective", 2048),
+    "b16-full": ("gpt2-1.5b", 16, True, "full", 0, 3, True),
+    "b16-full-ce": ("gpt2-1.5b", 16, True, "full", 2048, 3, True),
+    "b16-flashonly-ce": ("gpt2-1.5b", 16, True, "flash_only", 2048, 3, True),
+    "b24-full-ce": ("gpt2-1.5b", 24, True, "full", 2048, 3, True),
+    "b32-full-ce": ("gpt2-1.5b", 32, True, "full", 2048, 3, True),
+    "b16-sel-ce": ("gpt2-1.5b", 16, True, "selective", 2048, 3, True),
+    "med-b8": ("gpt2-medium", 8, True, "selective", 0, 1, False),
+    "med-b8-noremat": ("gpt2-medium", 8, False, "selective", 2048, 1, False),
+    "med-b16-noremat": ("gpt2-medium", 16, False, "selective", 2048, 1, False),
+    "med-b16-ce": ("gpt2-medium", 16, True, "selective", 2048, 1, False),
 }
 
+CODE = """
+import sys, json
+sys.path.insert(0, '.')
+from bench import run_config, MFU_BAR
 
-def run_one(name):
-    batch, pol, lc = VARIANTS[name]
-    code = (
-        "import sys, json; sys.path.insert(0, '.')\n"
-        "from bench import run_config, MFU_BAR\n"
-        f"dt, tps, mfu = run_config('gpt2-1.5b', {batch}, 1024, 8,\n"
-        "    {'bf16': {'enabled': True, 'memory_efficient': True},\n"
-        "     'zero_optimization': {'stage': 3}},\n"
-        f"    True, flash_block=1024, remat_pol='{pol}', loss_chunk={lc})\n"
-        f"print(json.dumps({{'variant': '{name}', 'batch': {batch},\n"
-        f"    'remat': '{pol}', 'loss_chunk': {lc},\n"
-        "    'step_ms': round(dt*1e3, 1), 'tokens_per_s': round(tps, 1),\n"
-        "    'mfu': round(mfu, 4), 'vs_bar': round(mfu/MFU_BAR, 3)}))\n"
-    )
-    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                       text=True, timeout=2400)
-    out = None
-    for line in reversed(r.stdout.splitlines()):
-        if line.startswith("{"):
-            out = line
-            break
-    if out:
-        print(out, flush=True)
-    else:
-        print(json.dumps({"variant": name, "rc": r.returncode,
-                          "err": r.stderr[-400:]}), flush=True)
+preset, batch, remat, pol, lc, stage, me = {spec!r}
+overrides = {{"zero_optimization": {{"stage": stage}}}}
+if me:
+    overrides["bf16"] = {{"enabled": True, "memory_efficient": True}}
+dt, tps, mfu = run_config(preset, batch, 1024, 8, overrides, True,
+                          flash_block=1024, remat_pol=pol, loss_chunk=lc,
+                          remat=remat)
+print(json.dumps({{"variant": {name!r}, "preset": preset, "batch": batch,
+    "remat": (pol if remat else "none"), "loss_chunk": lc,
+    "step_ms": round(dt*1e3, 1), "tokens_per_s": round(tps, 1),
+    "mfu": round(mfu, 4), "vs_bar": round(mfu/MFU_BAR, 3)}}))
+"""
 
 
 def main():
     names = sys.argv[1:] or list(VARIANTS)
     for n in names:
-        run_one(n)
+        run_json([sys.executable, "-c",
+                  CODE.format(spec=VARIANTS[n], name=n)],
+                 2400, {"variant": n})
 
 
 if __name__ == "__main__":
