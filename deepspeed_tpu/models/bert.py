@@ -142,8 +142,10 @@ def encode(params: Dict, tokens: jnp.ndarray, cfg: BertConfig,
             on_tpu = "tpu" in (d0.platform + d0.device_kind).lower()
         except Exception:
             on_tpu = False
-        flash_used = (attention_mask is None and S >= 128
-                      and head_dim % 8 == 0 and on_tpu)
+        # masked batches take the flash path too (kv_mask support); the
+        # gate must still mirror _attention_core's dropout condition
+        flash_used = (S >= 128 and head_dim % 8 == 0 and on_tpu
+                      and (deterministic or cfg.dropout == 0.0))
         body = jax.checkpoint(
             body, policy=remat_policy(cfg.remat_policy, flash=flash_used))
 

@@ -56,10 +56,15 @@ def _causal_kv_index_map(block_q, block_kv, num_kv):
 # forward kernel
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scratch, l_scratch, acc_scratch,
-                *, causal: bool, scale: float, block_q: int, block_kv: int,
-                num_kv: int):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
+                causal: bool, has_mask: bool, scale: float, block_q: int,
+                block_kv: int, num_kv: int):
+    if has_mask:
+        (mask_ref, o_ref, lse_ref,
+         m_scratch, l_scratch, acc_scratch) = rest
+    else:
+        mask_ref = None
+        o_ref, lse_ref, m_scratch, l_scratch, acc_scratch = rest
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -87,6 +92,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + qi * block_q
             cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ki * block_kv
             s = jnp.where(rows >= cols, s, NEG_INF)
+        if has_mask:
+            s = jnp.where(mask_ref[0][None, :] > 0, s, NEG_INF)
 
         m_prev = m_scratch[:, :1]                        # [bq, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)       # [bq, 1]
@@ -111,7 +118,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:]).astype(jnp.float32)
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_kv):
+def _mask_spec(block_kv, kvmap):
+    """Block spec for the optional [B, Skv] key-validity mask, following
+    the (possibly clamped) kv block index map."""
+    def mmap(b, h, qi, ki):
+        _, _, kblk, _ = kvmap(b, h, qi, ki)
+        return (b, kblk)
+
+    return pl.BlockSpec((1, block_kv), mmap)
+
+
+def _flash_fwd(q, k, v, mask, causal, scale, block_q, block_kv):
     # arrays are [B, H, S, D] inside the op (wrapper transposes)
     B, H, S, D = q.shape
     Skv = k.shape[2]
@@ -131,9 +148,20 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_kv):
             return (b, h, ki, 0)
 
     grid = (B, H, num_q, num_kv)
+    has_mask = mask is not None
     kernel = functools.partial(
-        _fwd_kernel, causal=causal, scale=scale, block_q=block_q,
-        block_kv=block_kv, num_kv=num_kv)
+        _fwd_kernel, causal=causal, has_mask=has_mask, scale=scale,
+        block_q=block_q, block_kv=block_kv, num_kv=num_kv)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, D), qmap),
+        pl.BlockSpec((1, 1, block_kv, D), kvmap),
+        pl.BlockSpec((1, 1, block_kv, D), kvmap),
+    ]
+    operands = [q, k, v]
+    if has_mask:
+        in_specs.append(_mask_spec(block_kv, kvmap))
+        operands.append(mask)
 
     out_shape = [
         jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
@@ -142,11 +170,7 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_kv):
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), qmap),
-            pl.BlockSpec((1, 1, block_kv, D), kvmap),
-            pl.BlockSpec((1, 1, block_kv, D), kvmap),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_q, D), qmap),
             pl.BlockSpec((1, 1, block_q, STATS), qmap),
@@ -159,7 +183,7 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_kv):
         out_shape=out_shape,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
-    )(q, k, v)
+    )(*operands)
     return o, lse[..., 0]
 
 
@@ -168,9 +192,13 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_kv):
 # ---------------------------------------------------------------------------
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_scratch, dv_scratch,
-                    *, causal: bool, scale: float, block_q: int,
-                    block_kv: int, num_q: int):
+                    *rest, causal: bool, has_mask: bool, scale: float,
+                    block_q: int, block_kv: int, num_q: int):
+    if has_mask:
+        mask_ref, dk_ref, dv_ref, dk_scratch, dv_scratch = rest
+    else:
+        mask_ref = None
+        dk_ref, dv_ref, dk_scratch, dv_scratch = rest
     ki = pl.program_id(2)
     qi = pl.program_id(3)
 
@@ -198,6 +226,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + qi * block_q
             cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ki * block_kv
             s = jnp.where(rows >= cols, s, NEG_INF)
+        if has_mask:
+            s = jnp.where(mask_ref[0][None, :] > 0, s, NEG_INF)
         p = jnp.exp(s - lse)                               # [bq, bkv]
 
         # dv += p^T @ do
@@ -220,9 +250,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_scratch,
-                   *, causal: bool, scale: float, block_q: int,
-                   block_kv: int, num_kv: int):
+                   *rest, causal: bool, has_mask: bool, scale: float,
+                   block_q: int, block_kv: int, num_kv: int):
+    if has_mask:
+        mask_ref, dq_ref, dq_scratch = rest
+    else:
+        mask_ref = None
+        dq_ref, dq_scratch = rest
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -249,6 +283,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + qi * block_q
             cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ki * block_kv
             s = jnp.where(rows >= cols, s, NEG_INF)
+        if has_mask:
+            s = jnp.where(mask_ref[0][None, :] > 0, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -263,7 +299,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(causal, scale, block_q, block_kv, res, g):
-    q, k, v, o, lse = res
+    q, k, v, mask, o, lse = res
     do = g
     B, H, S, D = q.shape
     Skv = k.shape[2]
@@ -271,6 +307,7 @@ def _flash_bwd(causal, scale, block_q, block_kv, res, g):
     block_kv = min(block_kv, Skv)
     num_q = S // block_q
     num_kv = Skv // block_kv
+    has_mask = mask is not None
 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)                                  # [B,H,S]
@@ -287,24 +324,30 @@ def _flash_bwd(causal, scale, block_q, block_kv, res, g):
             return (b, h, j, 0)
 
     # ---- dq ----
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, D), qmap),
+        pl.BlockSpec((1, 1, block_kv, D), kvmap_q_outer),
+        pl.BlockSpec((1, 1, block_kv, D), kvmap_q_outer),
+        pl.BlockSpec((1, 1, block_q, D), qmap),
+        pl.BlockSpec((1, 1, block_q, STATS), qmap),
+        pl.BlockSpec((1, 1, block_q, STATS), qmap),
+    ]
+    operands = [q, k, v, do, lse_b, delta_b]
+    if has_mask:
+        in_specs.append(_mask_spec(block_kv, kvmap_q_outer))
+        operands.append(mask)
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
-                          block_q=block_q, block_kv=block_kv, num_kv=num_kv),
+        functools.partial(_bwd_dq_kernel, causal=causal, has_mask=has_mask,
+                          scale=scale, block_q=block_q, block_kv=block_kv,
+                          num_kv=num_kv),
         grid=(B, H, num_q, num_kv),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), qmap),
-            pl.BlockSpec((1, 1, block_kv, D), kvmap_q_outer),
-            pl.BlockSpec((1, 1, block_kv, D), kvmap_q_outer),
-            pl.BlockSpec((1, 1, block_q, D), qmap),
-            pl.BlockSpec((1, 1, block_q, STATS), qmap),
-            pl.BlockSpec((1, 1, block_q, STATS), qmap),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, block_q, D), qmap),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
-    )(q, k, v, do, lse_b, delta_b)
+    )(*operands)
 
     # ---- dk, dv ---- (kv outer, q inner)
     def kvmap(b, h, ki, qi):
@@ -322,18 +365,26 @@ def _flash_bwd(causal, scale, block_q, block_kv, res, g):
         def qmap_kv_outer(b, h, ki, qi):
             return (b, h, qi, 0)
 
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, D), qmap_kv_outer),
+        pl.BlockSpec((1, 1, block_kv, D), kvmap),
+        pl.BlockSpec((1, 1, block_kv, D), kvmap),
+        pl.BlockSpec((1, 1, block_q, D), qmap_kv_outer),
+        pl.BlockSpec((1, 1, block_q, STATS), qmap_kv_outer),
+        pl.BlockSpec((1, 1, block_q, STATS), qmap_kv_outer),
+    ]
+    operands = [q, k, v, do, lse_b, delta_b]
+    if has_mask:
+        # kv blocks are on the OUTER grid dim here; _mask_spec follows
+        # this call's kvmap, which resolves to (b, ki)
+        in_specs.append(_mask_spec(block_kv, kvmap))
+        operands.append(mask)
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale,
-                          block_q=block_q, block_kv=block_kv, num_q=num_q),
+        functools.partial(_bwd_dkv_kernel, causal=causal, has_mask=has_mask,
+                          scale=scale, block_q=block_q, block_kv=block_kv,
+                          num_q=num_q),
         grid=(B, H, num_kv, num_q),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), qmap_kv_outer),
-            pl.BlockSpec((1, 1, block_kv, D), kvmap),
-            pl.BlockSpec((1, 1, block_kv, D), kvmap),
-            pl.BlockSpec((1, 1, block_q, D), qmap_kv_outer),
-            pl.BlockSpec((1, 1, block_q, STATS), qmap_kv_outer),
-            pl.BlockSpec((1, 1, block_q, STATS), qmap_kv_outer),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_kv, D), kvmap),
             pl.BlockSpec((1, 1, block_kv, D), kvmap),
@@ -348,7 +399,7 @@ def _flash_bwd(causal, scale, block_q, block_kv, res, g):
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
-    )(q, k, v, do, lse_b, delta_b)
+    )(*operands)
 
     return dq, dk, dv
 
@@ -357,14 +408,14 @@ def _flash_bwd(causal, scale, block_q, block_kv, res, g):
 # public op
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, scale, block_q, block_kv):
-    o, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_kv)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, mask, causal, scale, block_q, block_kv):
+    o, _ = _flash_fwd(q, k, v, mask, causal, scale, block_q, block_kv)
     return o
 
 
-def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_kv):
-    o, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_kv)
+def _flash_vjp_fwd(q, k, v, mask, causal, scale, block_q, block_kv):
+    o, lse = _flash_fwd(q, k, v, mask, causal, scale, block_q, block_kv)
     # named so a selective remat policy can keep the residuals — without
     # these, jax.checkpoint re-runs the whole forward kernel in the backward
     # pass just to regenerate o/lse. The o residual is stored with (H, D)
@@ -375,15 +426,16 @@ def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_kv):
     o_res = o.transpose(0, 2, 1, 3).reshape(B, S, H * D)
     o_res = checkpoint_name(o_res, "flash_out")
     lse = checkpoint_name(lse, "flash_lse")
-    return o, (q, k, v, o_res, lse)
+    return o, (q, k, v, mask, o_res, lse)
 
 
 def _flash_vjp_bwd(causal, scale, block_q, block_kv, res, g):
-    q, k, v, o_res, lse = res
+    q, k, v, mask, o_res, lse = res
     B, H, S, D = q.shape
     o = o_res.reshape(B, S, H, D).transpose(0, 2, 1, 3)
-    return _flash_bwd(causal, scale, block_q, block_kv,
-                      (q, k, v, o, lse), g)
+    dq, dk, dv = _flash_bwd(causal, scale, block_q, block_kv,
+                            (q, k, v, mask, o, lse), g)
+    return dq, dk, dv, None
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -391,7 +443,8 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     causal: bool = True, scale: Optional[float] = None,
-                    block_q: int = 512, block_kv: int = 512) -> jnp.ndarray:
+                    block_q: int = 512, block_kv: int = 512,
+                    kv_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Flash attention over [B, S, H, D] tensors.
 
     Head dims that are sublane-aligned (multiple of 8) run unpadded: Mosaic
@@ -400,6 +453,12 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     (the previous behavior) doubled both the attention matmul cycles and the
     q/k/v/o HBM traffic. Odd head dims still pad to the next sublane
     multiple. Fallback is the caller's job (models gate via _flash_eligible).
+
+    kv_mask: optional [B, Skv] key-validity mask (1 = attend, 0 = padding)
+    — the encoder attention-mask path. Padded QUERY rows produce
+    normalized-over-valid-keys outputs like the dense path; rows with NO
+    valid key emit zeros (their gradients are zero as long as the loss
+    masks them, which every masked loss here does).
     """
     B, S, H, D = q.shape
     if scale is None:
@@ -414,14 +473,16 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     q = q.transpose(0, 2, 1, 3)
     k = k.transpose(0, 2, 1, 3)
     v = v.transpose(0, 2, 1, 3)
-    out = _flash(q, k, v, causal, scale, block_q, block_kv)
+    if kv_mask is not None:
+        kv_mask = kv_mask.astype(jnp.float32)
+    out = _flash(q, k, v, kv_mask, causal, scale, block_q, block_kv)
     out = out.transpose(0, 2, 1, 3)
     if Dp != D:
         out = out[..., :D]
     return out
 
 
-def mha_reference(q, k, v, causal=True, scale=None):
+def mha_reference(q, k, v, causal=True, scale=None, kv_mask=None):
     """Pure-jnp reference for parity tests (analog of the python BERT
     baselines in ref tests/unit/test_cuda_forward.py)."""
     B, S, H, D = q.shape
@@ -431,5 +492,7 @@ def mha_reference(q, k, v, causal=True, scale=None):
     if causal:
         mask = jnp.tril(jnp.ones((S, k.shape[1]), bool))
         logits = jnp.where(mask[None, None], logits, NEG_INF)
+    if kv_mask is not None:
+        logits = jnp.where(kv_mask[:, None, None, :] > 0, logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

@@ -84,13 +84,14 @@ def _attention_core(q, k, v, attn_mask, cfg, dropout_rng, deterministic,
     """[B,S,H,D] attention; flash kernel when unmasked + deterministic,
     masked jnp softmax otherwise."""
     B, S, H, D = q.shape
-    use_flash = (allow_flash and attn_mask is None
+    use_flash = (allow_flash
                  and (deterministic or cfg.attn_dropout_ratio == 0.0)
                  and S >= 128 and D % 8 == 0)
     if use_flash:
         try:
             from deepspeed_tpu.ops.attention.flash import flash_attention
-            return flash_attention(q, k, v, causal=False)
+            return flash_attention(q, k, v, causal=False,
+                                   kv_mask=attn_mask)
         except Exception:
             pass
     scale = 1.0 / jnp.sqrt(jnp.float32(D))
