@@ -216,9 +216,16 @@ def _flash_eligible(cfg: GPTConfig, seq_len: int) -> bool:
     return _flash_blocks(cfg, seq_len) is not None
 
 
-def _attention(q, k, v, cfg: GPTConfig):
-    """Causal multi-head attention. q,k,v: [B, S, H, Dh]."""
+def _attention(q, k, v, cfg: GPTConfig, segment_ids=None):
+    """Causal multi-head attention. q,k,v: [B, S, H, Dh].
+
+    segment_ids: optional [B, S] packed-sequence ids — attention stays
+    inside each segment (block-diagonal x causal)."""
     scale = cfg.attn_scale  # None -> kernels default to 1/sqrt(Dh)
+    if segment_ids is not None and cfg.sequence_parallel:
+        raise NotImplementedError(
+            "packed segment_ids + sequence parallelism is not supported; "
+            "pack within the local shard or disable one of the two")
     if cfg.sequence_parallel and cfg.mesh is not None:
         if cfg.sp_impl == "ulysses":
             from deepspeed_tpu.ops.attention.ulysses import ulysses_attention
@@ -237,13 +244,15 @@ def _attention(q, k, v, cfg: GPTConfig):
     if blocks is not None:
         from deepspeed_tpu.ops.attention.flash import flash_attention
         return flash_attention(q, k, v, causal=True, scale=scale,
-                               block_q=blocks[0], block_kv=blocks[1])
+                               block_q=blocks[0], block_kv=blocks[1],
+                               segment_ids=segment_ids)
     from deepspeed_tpu.ops.attention.flash import mha_reference
-    return mha_reference(q, k, v, causal=True, scale=scale)
+    return mha_reference(q, k, v, causal=True, scale=scale,
+                         segment_ids=segment_ids)
 
 
 def _block(x, layer_params, cfg: GPTConfig, dropout_rng=None,
-           deterministic=True):
+           deterministic=True, segment_ids=None):
     """One transformer block. x: [B, S, D]."""
     B, S, D = x.shape
     H, Dh = cfg.n_heads, cfg.head_dim
@@ -264,7 +273,7 @@ def _block(x, layer_params, cfg: GPTConfig, dropout_rng=None,
     if cfg.rotary_dim:
         from deepspeed_tpu.ops.attention.rotary import apply_rotary
         q, k = apply_rotary(q, k, jnp.arange(S), cfg.rotary_dim)
-    attn = _attention(q, k, v, cfg).reshape(B, S, D)
+    attn = _attention(q, k, v, cfg, segment_ids=segment_ids).reshape(B, S, D)
     attn = checkpoint_name(attn, "attn")
     attn = attn @ p["attn_out"]["kernel"].astype(attn.dtype) + \
         p["attn_out"]["bias"].astype(attn.dtype)
@@ -300,19 +309,27 @@ def forward(params: Dict, tokens: jnp.ndarray, cfg: GPTConfig,
             rng: Optional[jax.Array] = None,
             deterministic: bool = True,
             pld_theta: Optional[jnp.ndarray] = None,
-            hidden_only: bool = False) -> jnp.ndarray:
+            hidden_only: bool = False,
+            segment_ids: Optional[jnp.ndarray] = None,
+            positions: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """tokens [B, S] int32 -> logits [B, S, V] (compute dtype).
 
     pld_theta: optional progressive-layer-drop keep-base (traced scalar;
     ref: deepspeed/runtime/progressive_layer_drop.py + arXiv:2010.13369):
     layer l survives with prob 1 - (l/L)*(1-theta), deeper layers dropped
-    more often. Training-only (pass None for eval)."""
+    more often. Training-only (pass None for eval).
+
+    segment_ids/positions: packed-sequence support — [B, S] ids keep
+    attention block-diagonal per document, [B, S] positions restart the
+    learned positional embedding at each document start."""
     B, S = tokens.shape
     dtype = cfg.dtype
     wte = params["wte"]["embedding"].astype(dtype)
     x = wte[tokens]
     if cfg.use_wpe:
-        x = x + params["wpe"]["embedding"].astype(dtype)[:S][None]
+        wpe = params["wpe"]["embedding"].astype(dtype)
+        x = x + (wpe[positions] if positions is not None
+                 else wpe[:S][None])
 
     block = params["block"]
     L = cfg.n_layers
@@ -340,7 +357,8 @@ def forward(params: Dict, tokens: jnp.ndarray, cfg: GPTConfig,
         x, r = carry
         x = _pin(x)
         r, dr = jax.random.split(r) if r is not None else (None, None)
-        y = _block(x, layer, cfg, dropout_rng=dr, deterministic=deterministic)
+        y = _block(x, layer, cfg, dropout_rng=dr, deterministic=deterministic,
+                   segment_ids=segment_ids)
         if pld_theta is not None and not deterministic:
             kr = jax.random.fold_in(dr, jnp.int32(7))
             keep_p = 1.0 - (lidx.astype(jnp.float32) / L) * \
@@ -405,24 +423,34 @@ def _vocab_proj(params: Dict, cfg: GPTConfig):
 def loss_fn(params: Dict, batch: Dict, rng: jax.Array, cfg: GPTConfig,
             deterministic: bool = False) -> jnp.ndarray:
     """Causal LM cross-entropy. batch: {"tokens": [B, S]} (next-token) or
-    {"tokens", "targets"}. fp32 log-softmax for stability."""
+    {"tokens", "targets"}. fp32 log-softmax for stability.
+
+    Packed batches add "segment_ids"/"positions" [B, S]; pair them with a
+    "loss_mask" zeroing each segment's last token (whose next-token
+    target crosses into the following document)."""
     tokens = batch["tokens"]
     targets = batch.get("targets")
+    segs = batch.get("segment_ids")
+    poss = batch.get("positions")
     if targets is None:
         targets = tokens[:, 1:]
         tokens = tokens[:, :-1]
+        segs = None if segs is None else segs[:, :-1]
+        poss = None if poss is None else poss[:, :-1]
     mask = batch.get("loss_mask")
     if cfg.loss_chunk:
         # fused vocab-projection + loss: never materializes [B, S, V]
         # (ops/cross_entropy.py — frees ~3GB+ at GPT-2-1.5B scale)
         from deepspeed_tpu.ops.cross_entropy import chunked_softmax_xent
         x = forward(params, tokens, cfg, rng, deterministic=deterministic,
-                    pld_theta=batch.get("pld_theta"), hidden_only=True)
+                    pld_theta=batch.get("pld_theta"), hidden_only=True,
+                    segment_ids=segs, positions=poss)
         w, b = _vocab_proj(params, cfg)
         return chunked_softmax_xent(x, w, targets, bias=b,
                                     chunk=cfg.loss_chunk, loss_mask=mask)
     logits = forward(params, tokens, cfg, rng, deterministic=deterministic,
-                     pld_theta=batch.get("pld_theta"))
+                     pld_theta=batch.get("pld_theta"),
+                     segment_ids=segs, positions=poss)
     logits = logits.astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)

@@ -57,14 +57,13 @@ def _causal_kv_index_map(block_q, block_kv, num_kv):
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
-                causal: bool, has_mask: bool, scale: float, block_q: int,
-                block_kv: int, num_kv: int):
-    if has_mask:
-        (mask_ref, o_ref, lse_ref,
-         m_scratch, l_scratch, acc_scratch) = rest
-    else:
-        mask_ref = None
-        o_ref, lse_ref, m_scratch, l_scratch, acc_scratch = rest
+                causal: bool, has_mask: bool, has_segs: bool, scale: float,
+                block_q: int, block_kv: int, num_kv: int):
+    rest = list(rest)
+    mask_ref = rest.pop(0) if has_mask else None
+    qseg_ref = rest.pop(0) if has_segs else None
+    kseg_ref = rest.pop(0) if has_segs else None
+    o_ref, lse_ref, m_scratch, l_scratch, acc_scratch = rest
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -94,6 +93,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
             s = jnp.where(rows >= cols, s, NEG_INF)
         if has_mask:
             s = jnp.where(mask_ref[0][None, :] > 0, s, NEG_INF)
+        if has_segs:
+            s = jnp.where(qseg_ref[0][:, None] == kseg_ref[0][None, :],
+                          s, NEG_INF)
 
         m_prev = m_scratch[:, :1]                        # [bq, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)       # [bq, 1]
@@ -128,7 +130,16 @@ def _mask_spec(block_kv, kvmap):
     return pl.BlockSpec((1, block_kv), mmap)
 
 
-def _flash_fwd(q, k, v, mask, causal, scale, block_q, block_kv):
+def _qseg_spec(block_q, qmap):
+    """Block spec for the q-side [B, S] segment ids, following qmap."""
+    def smap(*ids):
+        _, _, qblk, _ = qmap(*ids)
+        return (ids[0], qblk)
+
+    return pl.BlockSpec((1, block_q), smap)
+
+
+def _flash_fwd(q, k, v, mask, segs, causal, scale, block_q, block_kv):
     # arrays are [B, H, S, D] inside the op (wrapper transposes)
     B, H, S, D = q.shape
     Skv = k.shape[2]
@@ -149,9 +160,10 @@ def _flash_fwd(q, k, v, mask, causal, scale, block_q, block_kv):
 
     grid = (B, H, num_q, num_kv)
     has_mask = mask is not None
+    has_segs = segs is not None
     kernel = functools.partial(
-        _fwd_kernel, causal=causal, has_mask=has_mask, scale=scale,
-        block_q=block_q, block_kv=block_kv, num_kv=num_kv)
+        _fwd_kernel, causal=causal, has_mask=has_mask, has_segs=has_segs,
+        scale=scale, block_q=block_q, block_kv=block_kv, num_kv=num_kv)
 
     in_specs = [
         pl.BlockSpec((1, 1, block_q, D), qmap),
@@ -162,6 +174,10 @@ def _flash_fwd(q, k, v, mask, causal, scale, block_q, block_kv):
     if has_mask:
         in_specs.append(_mask_spec(block_kv, kvmap))
         operands.append(mask)
+    if has_segs:
+        in_specs.append(_qseg_spec(block_q, qmap))
+        in_specs.append(_mask_spec(block_kv, kvmap))   # kv-side segments
+        operands.extend([segs, segs])
 
     out_shape = [
         jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
@@ -192,13 +208,13 @@ def _flash_fwd(q, k, v, mask, causal, scale, block_q, block_kv):
 # ---------------------------------------------------------------------------
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    *rest, causal: bool, has_mask: bool, scale: float,
-                    block_q: int, block_kv: int, num_q: int):
-    if has_mask:
-        mask_ref, dk_ref, dv_ref, dk_scratch, dv_scratch = rest
-    else:
-        mask_ref = None
-        dk_ref, dv_ref, dk_scratch, dv_scratch = rest
+                    *rest, causal: bool, has_mask: bool, has_segs: bool,
+                    scale: float, block_q: int, block_kv: int, num_q: int):
+    rest = list(rest)
+    mask_ref = rest.pop(0) if has_mask else None
+    qseg_ref = rest.pop(0) if has_segs else None
+    kseg_ref = rest.pop(0) if has_segs else None
+    dk_ref, dv_ref, dk_scratch, dv_scratch = rest
     ki = pl.program_id(2)
     qi = pl.program_id(3)
 
@@ -228,6 +244,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jnp.where(rows >= cols, s, NEG_INF)
         if has_mask:
             s = jnp.where(mask_ref[0][None, :] > 0, s, NEG_INF)
+        if has_segs:
+            s = jnp.where(qseg_ref[0][:, None] == kseg_ref[0][None, :],
+                          s, NEG_INF)
         p = jnp.exp(s - lse)                               # [bq, bkv]
 
         # dv += p^T @ do
@@ -250,13 +269,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   *rest, causal: bool, has_mask: bool, scale: float,
-                   block_q: int, block_kv: int, num_kv: int):
-    if has_mask:
-        mask_ref, dq_ref, dq_scratch = rest
-    else:
-        mask_ref = None
-        dq_ref, dq_scratch = rest
+                   *rest, causal: bool, has_mask: bool, has_segs: bool,
+                   scale: float, block_q: int, block_kv: int, num_kv: int):
+    rest = list(rest)
+    mask_ref = rest.pop(0) if has_mask else None
+    qseg_ref = rest.pop(0) if has_segs else None
+    kseg_ref = rest.pop(0) if has_segs else None
+    dq_ref, dq_scratch = rest
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -285,6 +304,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jnp.where(rows >= cols, s, NEG_INF)
         if has_mask:
             s = jnp.where(mask_ref[0][None, :] > 0, s, NEG_INF)
+        if has_segs:
+            s = jnp.where(qseg_ref[0][:, None] == kseg_ref[0][None, :],
+                          s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -299,7 +321,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(causal, scale, block_q, block_kv, res, g):
-    q, k, v, mask, o, lse = res
+    q, k, v, mask, segs, o, lse = res
     do = g
     B, H, S, D = q.shape
     Skv = k.shape[2]
@@ -308,6 +330,7 @@ def _flash_bwd(causal, scale, block_q, block_kv, res, g):
     num_q = S // block_q
     num_kv = Skv // block_kv
     has_mask = mask is not None
+    has_segs = segs is not None
 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)                                  # [B,H,S]
@@ -336,8 +359,13 @@ def _flash_bwd(causal, scale, block_q, block_kv, res, g):
     if has_mask:
         in_specs.append(_mask_spec(block_kv, kvmap_q_outer))
         operands.append(mask)
+    if has_segs:
+        in_specs.append(_qseg_spec(block_q, qmap))
+        in_specs.append(_mask_spec(block_kv, kvmap_q_outer))
+        operands.extend([segs, segs])
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, has_mask=has_mask,
+                          has_segs=has_segs,
                           scale=scale, block_q=block_q, block_kv=block_kv,
                           num_kv=num_kv),
         grid=(B, H, num_q, num_kv),
@@ -379,8 +407,13 @@ def _flash_bwd(causal, scale, block_q, block_kv, res, g):
         # this call's kvmap, which resolves to (b, ki)
         in_specs.append(_mask_spec(block_kv, kvmap))
         operands.append(mask)
+    if has_segs:
+        in_specs.append(_qseg_spec(block_q, qmap_kv_outer))
+        in_specs.append(_mask_spec(block_kv, kvmap))
+        operands.extend([segs, segs])
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, causal=causal, has_mask=has_mask,
+                          has_segs=has_segs,
                           scale=scale, block_q=block_q, block_kv=block_kv,
                           num_q=num_q),
         grid=(B, H, num_kv, num_q),
@@ -408,14 +441,15 @@ def _flash_bwd(causal, scale, block_q, block_kv, res, g):
 # public op
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash(q, k, v, mask, causal, scale, block_q, block_kv):
-    o, _ = _flash_fwd(q, k, v, mask, causal, scale, block_q, block_kv)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash(q, k, v, mask, segs, causal, scale, block_q, block_kv):
+    o, _ = _flash_fwd(q, k, v, mask, segs, causal, scale, block_q, block_kv)
     return o
 
 
-def _flash_vjp_fwd(q, k, v, mask, causal, scale, block_q, block_kv):
-    o, lse = _flash_fwd(q, k, v, mask, causal, scale, block_q, block_kv)
+def _flash_vjp_fwd(q, k, v, mask, segs, causal, scale, block_q, block_kv):
+    o, lse = _flash_fwd(q, k, v, mask, segs, causal, scale, block_q,
+                        block_kv)
     # named so a selective remat policy can keep the residuals — without
     # these, jax.checkpoint re-runs the whole forward kernel in the backward
     # pass just to regenerate o/lse. The o residual is stored with (H, D)
@@ -426,16 +460,16 @@ def _flash_vjp_fwd(q, k, v, mask, causal, scale, block_q, block_kv):
     o_res = o.transpose(0, 2, 1, 3).reshape(B, S, H * D)
     o_res = checkpoint_name(o_res, "flash_out")
     lse = checkpoint_name(lse, "flash_lse")
-    return o, (q, k, v, mask, o_res, lse)
+    return o, (q, k, v, mask, segs, o_res, lse)
 
 
 def _flash_vjp_bwd(causal, scale, block_q, block_kv, res, g):
-    q, k, v, mask, o_res, lse = res
+    q, k, v, mask, segs, o_res, lse = res
     B, H, S, D = q.shape
     o = o_res.reshape(B, S, H, D).transpose(0, 2, 1, 3)
     dq, dk, dv = _flash_bwd(causal, scale, block_q, block_kv,
-                            (q, k, v, mask, o, lse), g)
-    return dq, dk, dv, None
+                            (q, k, v, mask, segs, o, lse), g)
+    return dq, dk, dv, None, None
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -444,7 +478,8 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     causal: bool = True, scale: Optional[float] = None,
                     block_q: int = 512, block_kv: int = 512,
-                    kv_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                    kv_mask: Optional[jnp.ndarray] = None,
+                    segment_ids: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Flash attention over [B, S, H, D] tensors.
 
     Head dims that are sublane-aligned (multiple of 8) run unpadded: Mosaic
@@ -457,12 +492,21 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     kv_mask: optional [B, Skv] key-validity mask (1 = attend, 0 = padding)
     — the encoder attention-mask path. Padded QUERY rows produce
     normalized-over-valid-keys outputs like the dense path; rows with NO
-    valid key emit zeros (their gradients are zero as long as the loss
-    masks them, which every masked loss here does).
+    valid key degenerate to a uniform average of v (identical to the
+    dense softmax-over-NEG_INF behavior) — garbage-by-contract, and
+    their gradients are zero as long as the loss masks them, which every
+    masked loss here does.
+
+    segment_ids: optional [B, S] int ids for PACKED sequences (requires
+    S == Skv): token i attends token j only when segment_ids match (and
+    causality holds) — block-diagonal attention, so several short
+    documents share one row with zero cross-contamination.
     """
     B, S, H, D = q.shape
     if scale is None:
         scale = 1.0 / np.sqrt(D)
+    if segment_ids is not None:
+        assert k.shape[1] == S, "segment_ids requires self-attention (Skv == S)"
     Dp = D if D % 8 == 0 else _ceil_to(D, 8)
     if Dp != D:
         pad = [(0, 0), (0, 0), (0, 0), (0, Dp - D)]
@@ -475,14 +519,18 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     v = v.transpose(0, 2, 1, 3)
     if kv_mask is not None:
         kv_mask = kv_mask.astype(jnp.float32)
-    out = _flash(q, k, v, kv_mask, causal, scale, block_q, block_kv)
+    if segment_ids is not None:
+        segment_ids = segment_ids.astype(jnp.int32)
+    out = _flash(q, k, v, kv_mask, segment_ids, causal, scale,
+                 block_q, block_kv)
     out = out.transpose(0, 2, 1, 3)
     if Dp != D:
         out = out[..., :D]
     return out
 
 
-def mha_reference(q, k, v, causal=True, scale=None, kv_mask=None):
+def mha_reference(q, k, v, causal=True, scale=None, kv_mask=None,
+                  segment_ids=None):
     """Pure-jnp reference for parity tests (analog of the python BERT
     baselines in ref tests/unit/test_cuda_forward.py)."""
     B, S, H, D = q.shape
@@ -494,5 +542,8 @@ def mha_reference(q, k, v, causal=True, scale=None, kv_mask=None):
         logits = jnp.where(mask[None, None], logits, NEG_INF)
     if kv_mask is not None:
         logits = jnp.where(kv_mask[:, None, None, :] > 0, logits, NEG_INF)
+    if segment_ids is not None:
+        same = segment_ids[:, :, None] == segment_ids[:, None, :]
+        logits = jnp.where(same[:, None], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

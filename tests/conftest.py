@@ -35,3 +35,16 @@ def devices():
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+@pytest.fixture
+def pallas_interpret(monkeypatch):
+    """Force pallas interpret mode on CPU (shared by the kernel parity
+    suites)."""
+    import functools
+
+    import jax.experimental.pallas as pl
+
+    monkeypatch.setattr(pl, "pallas_call",
+                        functools.partial(pl.pallas_call, interpret=True))
+    yield
