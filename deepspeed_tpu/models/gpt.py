@@ -222,7 +222,8 @@ def _attention(q, k, v, cfg: GPTConfig, segment_ids=None):
     segment_ids: optional [B, S] packed-sequence ids — attention stays
     inside each segment (block-diagonal x causal)."""
     scale = cfg.attn_scale  # None -> kernels default to 1/sqrt(Dh)
-    if segment_ids is not None and cfg.sequence_parallel:
+    if segment_ids is not None and cfg.sequence_parallel \
+            and cfg.mesh is not None:
         raise NotImplementedError(
             "packed segment_ids + sequence parallelism is not supported; "
             "pack within the local shard or disable one of the two")

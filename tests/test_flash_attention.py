@@ -23,12 +23,8 @@ def _rand_qkv(B=2, S=256, H=4, D=64, dtype=jnp.float32, seed=0):
 
 
 @pytest.fixture(autouse=True)
-def _interpret_mode(monkeypatch):
-    """Force pallas interpret mode on CPU."""
-    import jax.experimental.pallas as pl
-    orig = pl.pallas_call
-    monkeypatch.setattr(pl, "pallas_call",
-                        functools.partial(orig, interpret=True))
+def _interpret_mode(pallas_interpret):
+    """Force pallas interpret mode on CPU (shared conftest fixture)."""
     yield
 
 

@@ -27,10 +27,7 @@ def _qkv(seed=0, s=S, dtype=jnp.float32):
 
 
 @pytest.fixture(autouse=True)
-def _interpret_mode(monkeypatch):
-    import jax.experimental.pallas as pl
-    monkeypatch.setattr(pl, "pallas_call",
-                        functools.partial(pl.pallas_call, interpret=True))
+def _interpret_mode(pallas_interpret):
     yield
 
 
