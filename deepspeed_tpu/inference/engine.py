@@ -57,10 +57,11 @@ def _mlp(h, p):
         p["mlp_out"]["bias"].astype(h.dtype)
 
 
-def _block_prefill(x, p, cfg: GPTConfig):
+def _block_prefill(x, p, cfg: GPTConfig, kv_mask=None):
     """Forward one block over the full prompt, returning (y, k, v).
 
-    The cached k/v are post-rotary so decode never re-rotates history."""
+    The cached k/v are post-rotary so decode never re-rotates history.
+    kv_mask: [B, S] prompt validity (left-padded batched prompts)."""
     B, S, D = x.shape
     H, Dh = cfg.n_heads, cfg.head_dim
     h = _layernorm(x, p["ln1"]["scale"], p["ln1"]["bias"])
@@ -70,7 +71,7 @@ def _block_prefill(x, p, cfg: GPTConfig):
     if cfg.rotary_dim:
         from deepspeed_tpu.ops.attention.rotary import apply_rotary
         q, k = apply_rotary(q, k, jnp.arange(S), cfg.rotary_dim)
-    attn = gpt_lib._attention(q, k, v, cfg).reshape(B, S, D)
+    attn = gpt_lib._attention(q, k, v, cfg, kv_mask=kv_mask).reshape(B, S, D)
     attn = attn @ p["attn_out"]["kernel"].astype(attn.dtype) + \
         p["attn_out"]["bias"].astype(attn.dtype)
     if cfg.parallel_residual:
@@ -100,10 +101,12 @@ def _ffn(h, p, cfg):
     return y
 
 
-def _block_decode(x, k_cache, v_cache, pos, p, cfg: GPTConfig):
+def _block_decode(x, k_cache, v_cache, pos, p, cfg: GPTConfig,
+                  cache_mask=None):
     """One block for ONE new token. x: [B, 1, D]; caches [B, S_max, H, Dh].
     Fused decode attention with positional masking over the cache
-    (ref: softmax_context + KV-cache path, transformer_inference.py:113)."""
+    (ref: softmax_context + KV-cache path, transformer_inference.py:113).
+    cache_mask: optional [B, S_max] validity (0 = left-padding slot)."""
     B, _, D = x.shape
     H, Dh = cfg.n_heads, cfg.head_dim
     S_max = k_cache.shape[1]
@@ -128,6 +131,8 @@ def _block_decode(x, k_cache, v_cache, pos, p, cfg: GPTConfig):
         else 1.0 / np.sqrt(Dh)
     idx = jax.lax.broadcasted_iota(jnp.int32, (1, 1, S_max), 2)
     scores = jnp.where(idx <= pos, scores, -1e30)
+    if cache_mask is not None:
+        scores = jnp.where(cache_mask[:, None, :] > 0, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
     attn = jnp.einsum("bhs,bshd->bhd", probs, v_cache).reshape(B, 1, D)
     attn = attn @ p["attn_out"]["kernel"].astype(attn.dtype) + \
@@ -233,42 +238,69 @@ class InferenceEngine:
             logits = logits + params["lm_head"]["bias"]
         return logits
 
-    def _prefill_fn(self, params, tokens):
-        """Run the prompt, build the cache, return last-position logits."""
+    def _prefill_fn(self, params, tokens, attn_mask=None):
+        """Run the prompt, build the cache, return last-position logits.
+
+        attn_mask: optional [B, S] validity for LEFT-padded batched
+        prompts (1 = real token); positional embeddings restart per row
+        and padded keys never receive attention."""
         cfg = self.cfg
         B, S = tokens.shape
-        x = self._embed(params, tokens)
         S_max = self.max_seq_len
+        if attn_mask is None:
+            x = self._embed(params, tokens)
+        else:
+            # per-row positions restart after the left padding
+            x = params["wte"]["embedding"][tokens]
+            if cfg.use_wpe:
+                positions = jnp.clip(
+                    jnp.cumsum(attn_mask.astype(jnp.int32), axis=1) - 1,
+                    0, None)
+                x = x + params["wpe"]["embedding"][positions]
 
         def body(x, layer_p):
-            y, k, v = _block_prefill(x, layer_p, cfg)
+            y, k, v = _block_prefill(x, layer_p, cfg, kv_mask=attn_mask)
             return y, (k, v)
 
         x, (ks, vs) = jax.lax.scan(body, x, params["block"])
         # ks: [L, B, S, H, Dh] -> pad to S_max
         pad = [(0, 0), (0, 0), (0, S_max - S), (0, 0), (0, 0)]
-        k_cache = jnp.pad(ks, pad)
-        v_cache = jnp.pad(vs, pad)
+        cache = {"k": jnp.pad(ks, pad), "v": jnp.pad(vs, pad)}
+        if attn_mask is not None:
+            # decode slots (>= S) are always valid once written
+            cache["mask"] = jnp.concatenate(
+                [attn_mask.astype(jnp.float32),
+                 jnp.ones((B, S_max - S), jnp.float32)], axis=1)
         logits = self._logits(params, x[:, -1:])
-        return logits, {"k": k_cache, "v": v_cache}
+        return logits, cache
 
-    def _decode_fn(self, params, cache, token, pos):
-        """One token step. token: [B, 1]; pos: scalar int."""
+    def _decode_fn(self, params, cache, token, pos, row_pos=None):
+        """One token step. token: [B, 1]; pos: scalar cache index;
+        row_pos: optional [B] per-row LOGICAL positions (left-padded
+        batches, where real lengths differ from the cache index)."""
         cfg = self.cfg
         x = params["wte"]["embedding"][token]
         if cfg.use_wpe:
-            x = x + jax.lax.dynamic_slice_in_dim(
-                params["wpe"]["embedding"], pos, 1)[None]
+            wpe = params["wpe"]["embedding"]
+            if row_pos is not None:
+                x = x + wpe[row_pos][:, None]
+            else:
+                x = x + jax.lax.dynamic_slice_in_dim(wpe, pos, 1)[None]
+        cache_mask = cache.get("mask")
 
         def body(x, layer):
             layer_p, kc, vc = layer
-            y, kc, vc = _block_decode(x, kc, vc, pos, layer_p, cfg)
+            y, kc, vc = _block_decode(x, kc, vc, pos, layer_p, cfg,
+                                      cache_mask=cache_mask)
             return y, (kc, vc)
 
         x, (ks, vs) = jax.lax.scan(body, x,
                                    (params["block"], cache["k"], cache["v"]))
         logits = self._logits(params, x)
-        return logits, {"k": ks, "v": vs}
+        out = {"k": ks, "v": vs}
+        if cache_mask is not None:
+            out["mask"] = cache_mask
+        return logits, out
 
     def _forward_fn(self, params, tokens):
         x = self._embed(params, tokens)
@@ -304,10 +336,8 @@ class InferenceEngine:
     def __call__(self, tokens):
         return self.forward(tokens)
 
-    def generate(self, tokens, max_new_tokens: int = 32,
-                 temperature: float = 0.0, top_k: int = 0,
-                 seed: int = 0) -> np.ndarray:
-        """Greedy (temperature=0) or sampled generation."""
+    def _gen_setup(self, tokens, max_new_tokens, attention_mask):
+        """Shared generate() entry: prefill (+ optional left-pad mask)."""
         import time
         if self.is_encoder:
             raise NotImplementedError(
@@ -316,11 +346,32 @@ class InferenceEngine:
         tokens = jnp.asarray(tokens, jnp.int32)
         B, S = tokens.shape
         assert S + max_new_tokens <= self.max_seq_len
+        row_len = None
+        if attention_mask is not None:
+            if self.cfg.rotary_dim:
+                raise NotImplementedError(
+                    "left-padded generation with rotary positions is not "
+                    "supported yet (GPT-J style models)")
+            attention_mask = jnp.asarray(attention_mask, jnp.float32)
+            assert attention_mask.shape == (B, S)
+            row_len = attention_mask.sum(axis=1).astype(jnp.int32)  # [B]
 
         t0 = time.perf_counter()
-        logits, cache = self._prefill(self.params, tokens)
+        logits, cache = self._prefill(self.params, tokens, attention_mask)
         jax.block_until_ready(logits)
         self.latency_ms["prefill"] = (time.perf_counter() - t0) * 1e3
+        return tokens, S, logits, cache, row_len
+
+    def generate(self, tokens, max_new_tokens: int = 32,
+                 temperature: float = 0.0, top_k: int = 0,
+                 seed: int = 0, attention_mask=None) -> np.ndarray:
+        """Greedy (temperature=0) or sampled generation.
+
+        attention_mask: [B, S] for LEFT-padded variable-length prompts
+        (1 = real token) — rows generate as if run unpadded."""
+        import time
+        tokens, S, logits, cache, row_len = self._gen_setup(
+            tokens, max_new_tokens, attention_mask)
 
         rng = jax.random.PRNGKey(seed)
         out = [np.asarray(tokens)]
@@ -335,9 +386,10 @@ class InferenceEngine:
             if i == max_new_tokens - 1:
                 break
             rng, r = jax.random.split(rng)
-            logits, cache = self._decode(self.params, cache,
-                                         token[:, None],
-                                         jnp.asarray(S + i, jnp.int32))
+            logits, cache = self._decode(
+                self.params, cache, token[:, None],
+                jnp.asarray(S + i, jnp.int32),
+                None if row_len is None else row_len + i)
             token = pick(logits, r)
         self.latency_ms["decode_per_token"] = \
             (time.perf_counter() - t0) * 1e3 / max(1, max_new_tokens - 1)
@@ -359,34 +411,30 @@ class InferenceEngine:
             logits = jnp.where(logits < kth, -1e30, logits)
         return jax.random.categorical(rng, logits, axis=-1)
 
-    def _generate_scan_fn(self, params, cache, token, start_pos, rng,
-                          n_steps: int, temperature: float, top_k: int):
-        def step(carry, _):
+    def _generate_scan_fn(self, params, cache, token, start_pos, row_len,
+                          rng, n_steps: int, temperature: float,
+                          top_k: int):
+        def step(carry, i):
             tok, pos, cache, rng = carry
             rng, r = jax.random.split(rng)
-            logits, cache = self._decode_fn(params, cache, tok[:, None], pos)
+            logits, cache = self._decode_fn(
+                params, cache, tok[:, None], pos,
+                None if row_len is None else row_len + i)
             nxt = self._sample(logits, r, temperature, top_k)
             return (nxt, pos + 1, cache, rng), nxt
 
         (_, _, _, _), toks = jax.lax.scan(
-            step, (token, start_pos, cache, rng), None, length=n_steps)
+            step, (token, start_pos, cache, rng),
+            jnp.arange(n_steps), length=n_steps)
         return toks  # [n_steps, B]
 
     def generate_fused(self, tokens, max_new_tokens: int = 32,
                        temperature: float = 0.0, top_k: int = 0,
-                       seed: int = 0) -> np.ndarray:
+                       seed: int = 0, attention_mask=None) -> np.ndarray:
         """generate() semantics, decode loop fused into one XLA program."""
         import time
-        if self.is_encoder:
-            raise NotImplementedError("generate needs a causal decoder")
-        tokens = jnp.asarray(tokens, jnp.int32)
-        B, S = tokens.shape
-        assert S + max_new_tokens <= self.max_seq_len
-
-        t0 = time.perf_counter()
-        logits, cache = self._prefill(self.params, tokens)
-        jax.block_until_ready(logits)
-        self.latency_ms["prefill"] = (time.perf_counter() - t0) * 1e3
+        tokens, S, logits, cache, row_len = self._gen_setup(
+            tokens, max_new_tokens, attention_mask)
 
         rng = jax.random.PRNGKey(seed)
         first = self._sample(logits, rng, temperature, top_k)
@@ -397,8 +445,9 @@ class InferenceEngine:
 
         # same key stream as generate(): the scan carries the ORIGINAL key
         # and splits per step, so sampled outputs match token-for-token
-        args = (self.params, cache, first, jnp.asarray(S, jnp.int32), rng)
-        key = ("gen", n_steps, temperature, top_k)
+        args = (self.params, cache, first, jnp.asarray(S, jnp.int32),
+                row_len, rng)
+        key = ("gen", n_steps, temperature, top_k, row_len is not None)
         if not hasattr(self, "_gen_cache"):
             self._gen_cache = {}
         if key not in self._gen_cache:

@@ -216,17 +216,18 @@ def _flash_eligible(cfg: GPTConfig, seq_len: int) -> bool:
     return _flash_blocks(cfg, seq_len) is not None
 
 
-def _attention(q, k, v, cfg: GPTConfig, segment_ids=None):
+def _attention(q, k, v, cfg: GPTConfig, segment_ids=None, kv_mask=None):
     """Causal multi-head attention. q,k,v: [B, S, H, Dh].
 
     segment_ids: optional [B, S] packed-sequence ids — attention stays
-    inside each segment (block-diagonal x causal)."""
+    inside each segment (block-diagonal x causal).
+    kv_mask: optional [B, S] key-validity mask (left-padded prompts)."""
     scale = cfg.attn_scale  # None -> kernels default to 1/sqrt(Dh)
-    if segment_ids is not None and cfg.sequence_parallel \
-            and cfg.mesh is not None:
+    if (segment_ids is not None or kv_mask is not None) \
+            and cfg.sequence_parallel and cfg.mesh is not None:
         raise NotImplementedError(
-            "packed segment_ids + sequence parallelism is not supported; "
-            "pack within the local shard or disable one of the two")
+            "packed segment_ids / kv_mask + sequence parallelism is not "
+            "supported; mask within the local shard or disable one of the two")
     if cfg.sequence_parallel and cfg.mesh is not None:
         if cfg.sp_impl == "ulysses":
             from deepspeed_tpu.ops.attention.ulysses import ulysses_attention
@@ -246,10 +247,10 @@ def _attention(q, k, v, cfg: GPTConfig, segment_ids=None):
         from deepspeed_tpu.ops.attention.flash import flash_attention
         return flash_attention(q, k, v, causal=True, scale=scale,
                                block_q=blocks[0], block_kv=blocks[1],
-                               segment_ids=segment_ids)
+                               segment_ids=segment_ids, kv_mask=kv_mask)
     from deepspeed_tpu.ops.attention.flash import mha_reference
     return mha_reference(q, k, v, causal=True, scale=scale,
-                         segment_ids=segment_ids)
+                         segment_ids=segment_ids, kv_mask=kv_mask)
 
 
 def _block(x, layer_params, cfg: GPTConfig, dropout_rng=None,

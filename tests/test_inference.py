@@ -330,3 +330,44 @@ def test_tp_generate_fused_matches_single(devices):
                              mp_size=2)
     out = tp_eng.generate_fused(tokens, max_new_tokens=5)
     np.testing.assert_array_equal(ref, out)
+
+
+def test_left_padded_generation_matches_unpadded(devices):
+    """A left-padded variable-length batch generates exactly what each
+    prompt generates alone (greedy), for both the host loop and the
+    fused scan."""
+    cfg, params = tiny()
+    eng = InferenceEngine(config=cfg, params=params, dtype=jnp.float32)
+    r = np.random.default_rng(5)
+    p1 = r.integers(1, 128, 5).astype(np.int32)
+    p2 = r.integers(1, 128, 9).astype(np.int32)
+    n = 6
+
+    # reference: each prompt alone, no padding
+    ref1 = eng.generate(p1[None], max_new_tokens=n)[0, len(p1):]
+    ref2 = eng.generate(p2[None], max_new_tokens=n)[0, len(p2):]
+
+    # left-padded batch
+    S = 9
+    tokens = np.zeros((2, S), np.int32)
+    mask = np.zeros((2, S), np.float32)
+    tokens[0, S - 5:] = p1
+    mask[0, S - 5:] = 1
+    tokens[1, :] = p2
+    mask[1, :] = 1
+
+    for fn in (eng.generate, eng.generate_fused):
+        out = fn(tokens, max_new_tokens=n, attention_mask=mask)
+        np.testing.assert_array_equal(out[0, S:], ref1)
+        np.testing.assert_array_equal(out[1, S:], ref2)
+
+
+def test_left_padded_rotary_rejected(devices):
+    import dataclasses
+    cfg, params = tiny()
+    cfg = dataclasses.replace(cfg, rotary_dim=4, use_wpe=False)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    eng = InferenceEngine(config=cfg, params=params, dtype=jnp.float32)
+    with pytest.raises(NotImplementedError):
+        eng.generate(np.zeros((1, 4), np.int32), max_new_tokens=2,
+                     attention_mask=np.ones((1, 4), np.float32))
