@@ -57,11 +57,12 @@ def _mlp(h, p):
         p["mlp_out"]["bias"].astype(h.dtype)
 
 
-def _block_prefill(x, p, cfg: GPTConfig, kv_mask=None):
+def _block_prefill(x, p, cfg: GPTConfig, kv_mask=None, positions=None):
     """Forward one block over the full prompt, returning (y, k, v).
 
     The cached k/v are post-rotary so decode never re-rotates history.
-    kv_mask: [B, S] prompt validity (left-padded batched prompts)."""
+    kv_mask: [B, S] prompt validity (left-padded batched prompts);
+    positions: optional [B, S] per-row rotary positions."""
     B, S, D = x.shape
     H, Dh = cfg.n_heads, cfg.head_dim
     h = _layernorm(x, p["ln1"]["scale"], p["ln1"]["bias"])
@@ -70,7 +71,9 @@ def _block_prefill(x, p, cfg: GPTConfig, kv_mask=None):
     q, k, v = (_split_heads(t, B, S, H, Dh) for t in (q, k, v))
     if cfg.rotary_dim:
         from deepspeed_tpu.ops.attention.rotary import apply_rotary
-        q, k = apply_rotary(q, k, jnp.arange(S), cfg.rotary_dim)
+        q, k = apply_rotary(
+            q, k, positions if positions is not None else jnp.arange(S),
+            cfg.rotary_dim)
     attn = gpt_lib._attention(q, k, v, cfg, kv_mask=kv_mask).reshape(B, S, D)
     attn = attn @ p["attn_out"]["kernel"].astype(attn.dtype) + \
         p["attn_out"]["bias"].astype(attn.dtype)
@@ -102,11 +105,12 @@ def _ffn(h, p, cfg):
 
 
 def _block_decode(x, k_cache, v_cache, pos, p, cfg: GPTConfig,
-                  cache_mask=None):
+                  cache_mask=None, row_pos=None):
     """One block for ONE new token. x: [B, 1, D]; caches [B, S_max, H, Dh].
     Fused decode attention with positional masking over the cache
     (ref: softmax_context + KV-cache path, transformer_inference.py:113).
-    cache_mask: optional [B, S_max] validity (0 = left-padding slot)."""
+    cache_mask: optional [B, S_max] validity (0 = left-padding slot);
+    row_pos: optional [B] per-row logical positions for rotary."""
     B, _, D = x.shape
     H, Dh = cfg.n_heads, cfg.head_dim
     S_max = k_cache.shape[1]
@@ -116,8 +120,9 @@ def _block_decode(x, k_cache, v_cache, pos, p, cfg: GPTConfig,
     q, k, v = jnp.split(qkv, 3, axis=-1)
     if cfg.rotary_dim:
         from deepspeed_tpu.ops.attention.rotary import apply_rotary
+        rp = pos[None] if row_pos is None else row_pos[:, None]
         q, k = apply_rotary(q.reshape(B, 1, H, Dh), k.reshape(B, 1, H, Dh),
-                            pos[None], cfg.rotary_dim)
+                            rp, cfg.rotary_dim)
         q = q.reshape(B, 1, H, Dh)
         k = k.reshape(B, 1, H, Dh)
     q = q.reshape(B, H, Dh)
@@ -247,19 +252,21 @@ class InferenceEngine:
         cfg = self.cfg
         B, S = tokens.shape
         S_max = self.max_seq_len
+        positions = None
         if attn_mask is None:
             x = self._embed(params, tokens)
         else:
             # per-row positions restart after the left padding
+            positions = jnp.clip(
+                jnp.cumsum(attn_mask.astype(jnp.int32), axis=1) - 1,
+                0, None)
             x = params["wte"]["embedding"][tokens]
             if cfg.use_wpe:
-                positions = jnp.clip(
-                    jnp.cumsum(attn_mask.astype(jnp.int32), axis=1) - 1,
-                    0, None)
                 x = x + params["wpe"]["embedding"][positions]
 
         def body(x, layer_p):
-            y, k, v = _block_prefill(x, layer_p, cfg, kv_mask=attn_mask)
+            y, k, v = _block_prefill(x, layer_p, cfg, kv_mask=attn_mask,
+                                     positions=positions)
             return y, (k, v)
 
         x, (ks, vs) = jax.lax.scan(body, x, params["block"])
@@ -291,7 +298,8 @@ class InferenceEngine:
         def body(x, layer):
             layer_p, kc, vc = layer
             y, kc, vc = _block_decode(x, kc, vc, pos, layer_p, cfg,
-                                      cache_mask=cache_mask)
+                                      cache_mask=cache_mask,
+                                      row_pos=row_pos)
             return y, (kc, vc)
 
         x, (ks, vs) = jax.lax.scan(body, x,
@@ -348,10 +356,6 @@ class InferenceEngine:
         assert S + max_new_tokens <= self.max_seq_len
         row_len = None
         if attention_mask is not None:
-            if self.cfg.rotary_dim:
-                raise NotImplementedError(
-                    "left-padded generation with rotary positions is not "
-                    "supported yet (GPT-J style models)")
             attention_mask = jnp.asarray(attention_mask, jnp.float32)
             assert attention_mask.shape == (B, S)
             row_len = attention_mask.sum(axis=1).astype(jnp.int32)  # [B]

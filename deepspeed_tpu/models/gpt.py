@@ -254,8 +254,9 @@ def _attention(q, k, v, cfg: GPTConfig, segment_ids=None, kv_mask=None):
 
 
 def _block(x, layer_params, cfg: GPTConfig, dropout_rng=None,
-           deterministic=True, segment_ids=None):
-    """One transformer block. x: [B, S, D]."""
+           deterministic=True, segment_ids=None, positions=None):
+    """One transformer block. x: [B, S, D]. positions: optional [B, S]
+    per-row rotary positions (packed batches restart per document)."""
     B, S, D = x.shape
     H, Dh = cfg.n_heads, cfg.head_dim
     p = layer_params
@@ -274,7 +275,9 @@ def _block(x, layer_params, cfg: GPTConfig, dropout_rng=None,
     v = v.reshape(B, S, H, Dh)
     if cfg.rotary_dim:
         from deepspeed_tpu.ops.attention.rotary import apply_rotary
-        q, k = apply_rotary(q, k, jnp.arange(S), cfg.rotary_dim)
+        q, k = apply_rotary(
+            q, k, positions if positions is not None else jnp.arange(S),
+            cfg.rotary_dim)
     attn = _attention(q, k, v, cfg, segment_ids=segment_ids).reshape(B, S, D)
     attn = checkpoint_name(attn, "attn")
     attn = attn @ p["attn_out"]["kernel"].astype(attn.dtype) + \
@@ -360,7 +363,7 @@ def forward(params: Dict, tokens: jnp.ndarray, cfg: GPTConfig,
         x = _pin(x)
         r, dr = jax.random.split(r) if r is not None else (None, None)
         y = _block(x, layer, cfg, dropout_rng=dr, deterministic=deterministic,
-                   segment_ids=segment_ids)
+                   segment_ids=segment_ids, positions=positions)
         if pld_theta is not None and not deterministic:
             kr = jax.random.fold_in(dr, jnp.int32(7))
             keep_p = 1.0 - (lidx.astype(jnp.float32) / L) * \

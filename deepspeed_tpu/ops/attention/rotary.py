@@ -23,10 +23,11 @@ def _rotate_every_two(x: jnp.ndarray) -> jnp.ndarray:
 
 def rotary_sin_cos(positions: jnp.ndarray, rotary_dim: int,
                    base: float = 10000.0) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """positions [S] -> (sin, cos) each [S, rotary_dim] (interleaved pairs)."""
+    """positions [S] or [B, S] -> (sin, cos), each
+    ``positions.shape + (rotary_dim,)`` (interleaved pairs)."""
     inv_freq = 1.0 / (base ** (jnp.arange(0, rotary_dim, 2,
                                           dtype=jnp.float32) / rotary_dim))
-    ang = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
+    ang = positions.astype(jnp.float32)[..., None] * inv_freq
     sin = jnp.repeat(jnp.sin(ang), 2, axis=-1)
     cos = jnp.repeat(jnp.cos(ang), 2, axis=-1)
     return sin, cos
@@ -36,12 +37,15 @@ def apply_rotary(q: jnp.ndarray, k: jnp.ndarray,
                  positions: jnp.ndarray,
                  rotary_dim: Optional[int] = None,
                  base: float = 10000.0) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Rotate q, k ([B, S, H, D]) by position; positions is [S] absolute."""
+    """Rotate q, k ([B, S, H, D]) by position; positions is [S] absolute,
+    or [B, S] for per-row positions (left-padded / packed batches)."""
     D = q.shape[-1]
     rd = D if rotary_dim is None else rotary_dim
     sin, cos = rotary_sin_cos(positions, rd, base)
-    sin = sin[None, :, None, :].astype(q.dtype)
-    cos = cos[None, :, None, :].astype(q.dtype)
+    if positions.ndim == 1:            # [S, rd] -> [1, S, 1, rd]
+        sin, cos = sin[None], cos[None]
+    sin = sin[:, :, None, :].astype(q.dtype)
+    cos = cos[:, :, None, :].astype(q.dtype)
 
     def rot(t):
         t_rot = t[..., :rd] * cos + _rotate_every_two(t[..., :rd]) * sin

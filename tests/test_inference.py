@@ -362,12 +362,29 @@ def test_left_padded_generation_matches_unpadded(devices):
         np.testing.assert_array_equal(out[1, S:], ref2)
 
 
-def test_left_padded_rotary_rejected(devices):
+def test_left_padded_rotary_matches_unpadded(devices):
+    """Left-padded batches work for rotary (GPT-J style) models too —
+    per-row rotary positions restart after the padding."""
     import dataclasses
     cfg, params = tiny()
     cfg = dataclasses.replace(cfg, rotary_dim=4, use_wpe=False)
     params = gpt.init_params(jax.random.PRNGKey(0), cfg)
     eng = InferenceEngine(config=cfg, params=params, dtype=jnp.float32)
-    with pytest.raises(NotImplementedError):
-        eng.generate(np.zeros((1, 4), np.int32), max_new_tokens=2,
-                     attention_mask=np.ones((1, 4), np.float32))
+    r = np.random.default_rng(6)
+    p1 = r.integers(1, 128, 4).astype(np.int32)
+    p2 = r.integers(1, 128, 7).astype(np.int32)
+    n = 5
+    ref1 = eng.generate(p1[None], max_new_tokens=n)[0, len(p1):]
+    ref2 = eng.generate(p2[None], max_new_tokens=n)[0, len(p2):]
+
+    S = 7
+    tokens = np.zeros((2, S), np.int32)
+    mask = np.zeros((2, S), np.float32)
+    tokens[0, S - 4:] = p1
+    mask[0, S - 4:] = 1
+    tokens[1, :] = p2
+    mask[1, :] = 1
+    for fn in (eng.generate, eng.generate_fused):
+        out = fn(tokens, max_new_tokens=n, attention_mask=mask)
+        np.testing.assert_array_equal(out[0, S:], ref1)
+        np.testing.assert_array_equal(out[1, S:], ref2)
