@@ -196,3 +196,42 @@ def test_packed_rotary_equals_separate(devices):
                                     deterministic=True))
     np.testing.assert_allclose(packed_mean * mask.sum(), total_sep,
                                rtol=1e-5)
+
+
+def test_flash_mask_and_segments_combined(devices, pallas_interpret):
+    """kv_mask and segment_ids together (packed rows that also carry
+    padding): both mask operands thread through every kernel."""
+    B, S, H, D = 1, 256, 2, 32
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.float32)
+               for kk in ks)
+    segs = jnp.asarray(np.repeat([0, 1], 128)[None], jnp.int32)
+    r = np.random.default_rng(4)
+    kv_mask = jnp.asarray((r.random((B, S)) > 0.2).astype(np.float32))
+
+    out = F.flash_attention(q, k, v, causal=True, block_q=128,
+                            block_kv=128, kv_mask=kv_mask,
+                            segment_ids=segs)
+    ref = F.mha_reference(q, k, v, causal=True, kv_mask=kv_mask,
+                          segment_ids=segs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+    row_w = kv_mask[..., None, None]
+
+    def loss_f(q, k, v):
+        o = F.flash_attention(q, k, v, causal=True, block_q=128,
+                              block_kv=128, kv_mask=kv_mask,
+                              segment_ids=segs)
+        return ((o * row_w) ** 2).sum()
+
+    def loss_r(q, k, v):
+        o = F.mha_reference(q, k, v, causal=True, kv_mask=kv_mask,
+                            segment_ids=segs)
+        return ((o * row_w) ** 2).sum()
+
+    gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-3, atol=5e-3)
