@@ -139,10 +139,24 @@ def _qseg_spec(block_q, qmap):
     return pl.BlockSpec((1, block_q), smap)
 
 
+def _group_head(map_fn, group: int):
+    """Wrap a (b, h, i, j) block index map so the head index addresses a
+    GROUPED kv array (GQA: kv head = q head // group)."""
+    if group == 1:
+        return map_fn
+
+    def wrapped(b, h, i, j):
+        bb, _, blk, z = map_fn(b, h, i, j)
+        return (bb, h // group, blk, z)
+
+    return wrapped
+
+
 def _flash_fwd(q, k, v, mask, segs, causal, scale, block_q, block_kv):
     # arrays are [B, H, S, D] inside the op (wrapper transposes)
     B, H, S, D = q.shape
     Skv = k.shape[2]
+    group = H // k.shape[1]          # GQA: q heads per kv head
     block_q = min(block_q, S)
     block_kv = min(block_kv, Skv)
     assert S % block_q == 0 and Skv % block_kv == 0, (S, Skv, block_q, block_kv)
@@ -157,6 +171,7 @@ def _flash_fwd(q, k, v, mask, segs, causal, scale, block_q, block_kv):
     else:
         def kvmap(b, h, qi, ki):
             return (b, h, ki, 0)
+    kvmap_h = _group_head(kvmap, group)
 
     grid = (B, H, num_q, num_kv)
     has_mask = mask is not None
@@ -167,8 +182,8 @@ def _flash_fwd(q, k, v, mask, segs, causal, scale, block_q, block_kv):
 
     in_specs = [
         pl.BlockSpec((1, 1, block_q, D), qmap),
-        pl.BlockSpec((1, 1, block_kv, D), kvmap),
-        pl.BlockSpec((1, 1, block_kv, D), kvmap),
+        pl.BlockSpec((1, 1, block_kv, D), kvmap_h),
+        pl.BlockSpec((1, 1, block_kv, D), kvmap_h),
     ]
     operands = [q, k, v]
     if has_mask:
@@ -325,6 +340,7 @@ def _flash_bwd(causal, scale, block_q, block_kv, res, g):
     do = g
     B, H, S, D = q.shape
     Skv = k.shape[2]
+    group = H // k.shape[1]          # GQA: q heads per kv head
     block_q = min(block_q, S)
     block_kv = min(block_kv, Skv)
     num_q = S // block_q
@@ -347,10 +363,11 @@ def _flash_bwd(causal, scale, block_q, block_kv, res, g):
             return (b, h, j, 0)
 
     # ---- dq ----
+    kvmap_q_outer_h = _group_head(kvmap_q_outer, group)
     in_specs = [
         pl.BlockSpec((1, 1, block_q, D), qmap),
-        pl.BlockSpec((1, 1, block_kv, D), kvmap_q_outer),
-        pl.BlockSpec((1, 1, block_kv, D), kvmap_q_outer),
+        pl.BlockSpec((1, 1, block_kv, D), kvmap_q_outer_h),
+        pl.BlockSpec((1, 1, block_kv, D), kvmap_q_outer_h),
         pl.BlockSpec((1, 1, block_q, D), qmap),
         pl.BlockSpec((1, 1, block_q, STATS), qmap),
         pl.BlockSpec((1, 1, block_q, STATS), qmap),
@@ -393,10 +410,11 @@ def _flash_bwd(causal, scale, block_q, block_kv, res, g):
         def qmap_kv_outer(b, h, ki, qi):
             return (b, h, qi, 0)
 
+    kvmap_in_h = _group_head(kvmap, group)
     in_specs = [
         pl.BlockSpec((1, 1, block_q, D), qmap_kv_outer),
-        pl.BlockSpec((1, 1, block_kv, D), kvmap),
-        pl.BlockSpec((1, 1, block_kv, D), kvmap),
+        pl.BlockSpec((1, 1, block_kv, D), kvmap_in_h),
+        pl.BlockSpec((1, 1, block_kv, D), kvmap_in_h),
         pl.BlockSpec((1, 1, block_q, D), qmap_kv_outer),
         pl.BlockSpec((1, 1, block_q, STATS), qmap_kv_outer),
         pl.BlockSpec((1, 1, block_q, STATS), qmap_kv_outer),
@@ -427,13 +445,24 @@ def _flash_bwd(causal, scale, block_q, block_kv, res, g):
             pltpu.VMEM((block_kv, D), jnp.float32),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, Skv, D), k.dtype),
-            jax.ShapeDtypeStruct((B, H, Skv, D), v.dtype),
+            # GQA partials stay fp32 so the cross-head reduction below
+            # accumulates at full precision (cast once after the sum)
+            jax.ShapeDtypeStruct((B, H, Skv, D),
+                                 jnp.float32 if group > 1 else k.dtype),
+            jax.ShapeDtypeStruct((B, H, Skv, D),
+                                 jnp.float32 if group > 1 else v.dtype),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
     )(*operands)
 
+    if group > 1:
+        # per-q-head partials -> per-kv-head grads (GQA): accumulation
+        # across q heads can't happen inside the kernel (h is a parallel
+        # grid dim), so reduce the group outside
+        Hkv = H // group
+        dk = dk.reshape(B, Hkv, group, Skv, D).sum(2).astype(k.dtype)
+        dv = dv.reshape(B, Hkv, group, Skv, D).sum(2).astype(v.dtype)
     return dq, dk, dv
 
 
@@ -501,8 +530,16 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     S == Skv): token i attends token j only when segment_ids match (and
     causality holds) — block-diagonal attention, so several short
     documents share one row with zero cross-contamination.
+
+    Grouped-query attention: k/v may carry FEWER heads than q
+    (``H % Hkv == 0``); each group of ``H // Hkv`` query heads shares one
+    kv head, shrinking the KV cache by the group factor.
     """
     B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    assert H % Hkv == 0, f"q heads {H} not a multiple of kv heads {Hkv}"
+    assert v.shape[2] == Hkv, \
+        f"k has {Hkv} heads but v has {v.shape[2]} — kv head counts must match"
     if scale is None:
         scale = 1.0 / np.sqrt(D)
     if segment_ids is not None:
@@ -534,6 +571,9 @@ def mha_reference(q, k, v, causal=True, scale=None, kv_mask=None,
     """Pure-jnp reference for parity tests (analog of the python BERT
     baselines in ref tests/unit/test_cuda_forward.py)."""
     B, S, H, D = q.shape
+    if k.shape[2] != H:              # GQA: repeat kv heads per group
+        k = jnp.repeat(k, H // k.shape[2], axis=2)
+        v = jnp.repeat(v, H // v.shape[2], axis=2)
     if scale is None:
         scale = 1.0 / np.sqrt(D)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
