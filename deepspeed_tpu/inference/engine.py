@@ -14,7 +14,8 @@ KV-cache management via the global Context workspace). TPU-native design:
 - tensor parallelism = the same Megatron partition rules as training; the
   attn/MLP output allreduces the reference issues by hand
   (LinearAllreduce, transformer_inference.py MP allreduce) come from XLA;
-- the KV cache is a preallocated [L, B, S_max, H, D] pytree threaded
+- the KV cache is a preallocated [L, B, S_max, Hkv, D] pytree (Hkv =
+  cfg.kv_heads; smaller than H under grouped-query attention) threaded
   functionally through a jitted, cache-donating decode step; generation is
   a host loop over compiled prefill + decode programs.
 """
@@ -65,10 +66,13 @@ def _block_prefill(x, p, cfg: GPTConfig, kv_mask=None, positions=None):
     positions: optional [B, S] per-row rotary positions."""
     B, S, D = x.shape
     H, Dh = cfg.n_heads, cfg.head_dim
+    Hkv = cfg.kv_heads
     h = _layernorm(x, p["ln1"]["scale"], p["ln1"]["bias"])
     qkv = h @ p["qkv"]["kernel"].astype(h.dtype) + p["qkv"]["bias"].astype(h.dtype)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    q, k, v = (_split_heads(t, B, S, H, Dh) for t in (q, k, v))
+    q, k, v = jnp.split(qkv, [H * Dh, (H + Hkv) * Dh], axis=-1)
+    q = _split_heads(q, B, S, H, Dh)
+    k = _split_heads(k, B, S, Hkv, Dh)
+    v = _split_heads(v, B, S, Hkv, Dh)
     if cfg.rotary_dim:
         from deepspeed_tpu.ops.attention.rotary import apply_rotary
         q, k = apply_rotary(
@@ -106,7 +110,7 @@ def _ffn(h, p, cfg):
 
 def _block_decode(x, k_cache, v_cache, pos, p, cfg: GPTConfig,
                   cache_mask=None, row_pos=None):
-    """One block for ONE new token. x: [B, 1, D]; caches [B, S_max, H, Dh].
+    """One block for ONE new token. x: [B, 1, D]; caches [B, S_max, Hkv, Dh].
     Fused decode attention with positional masking over the cache
     (ref: softmax_context + KV-cache path, transformer_inference.py:113).
     cache_mask: optional [B, S_max] validity (0 = left-padding slot);
@@ -115,31 +119,34 @@ def _block_decode(x, k_cache, v_cache, pos, p, cfg: GPTConfig,
     H, Dh = cfg.n_heads, cfg.head_dim
     S_max = k_cache.shape[1]
 
+    Hkv = cfg.kv_heads
+    group = H // Hkv
     h = _layernorm(x, p["ln1"]["scale"], p["ln1"]["bias"])
     qkv = h @ p["qkv"]["kernel"].astype(h.dtype) + p["qkv"]["bias"].astype(h.dtype)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q, k, v = jnp.split(qkv, [H * Dh, (H + Hkv) * Dh], axis=-1)
     if cfg.rotary_dim:
         from deepspeed_tpu.ops.attention.rotary import apply_rotary
         rp = pos[None] if row_pos is None else row_pos[:, None]
-        q, k = apply_rotary(q.reshape(B, 1, H, Dh), k.reshape(B, 1, H, Dh),
+        q, k = apply_rotary(q.reshape(B, 1, H, Dh), k.reshape(B, 1, Hkv, Dh),
                             rp, cfg.rotary_dim)
         q = q.reshape(B, 1, H, Dh)
-        k = k.reshape(B, 1, H, Dh)
-    q = q.reshape(B, H, Dh)
+        k = k.reshape(B, 1, Hkv, Dh)
+    q = q.reshape(B, Hkv, group, Dh)
     k_cache = jax.lax.dynamic_update_slice_in_dim(
-        k_cache, k.reshape(B, 1, H, Dh), pos, axis=1)
+        k_cache, k.reshape(B, 1, Hkv, Dh), pos, axis=1)
     v_cache = jax.lax.dynamic_update_slice_in_dim(
-        v_cache, v.reshape(B, 1, H, Dh), pos, axis=1)
+        v_cache, v.reshape(B, 1, Hkv, Dh), pos, axis=1)
 
-    scores = jnp.einsum("bhd,bshd->bhs", q, k_cache).astype(jnp.float32)
+    # grouped decode attention: q heads grouped per shared kv head
+    scores = jnp.einsum("bkgd,bskd->bkgs", q, k_cache).astype(jnp.float32)
     scores *= cfg.attn_scale if cfg.attn_scale is not None \
         else 1.0 / np.sqrt(Dh)
-    idx = jax.lax.broadcasted_iota(jnp.int32, (1, 1, S_max), 2)
+    idx = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, S_max), 3)
     scores = jnp.where(idx <= pos, scores, -1e30)
     if cache_mask is not None:
-        scores = jnp.where(cache_mask[:, None, :] > 0, scores, -1e30)
+        scores = jnp.where(cache_mask[:, None, None, :] > 0, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-    attn = jnp.einsum("bhs,bshd->bhd", probs, v_cache).reshape(B, 1, D)
+    attn = jnp.einsum("bkgs,bskd->bkgd", probs, v_cache).reshape(B, 1, D)
     attn = attn @ p["attn_out"]["kernel"].astype(attn.dtype) + \
         p["attn_out"]["bias"].astype(attn.dtype)
     if cfg.parallel_residual:

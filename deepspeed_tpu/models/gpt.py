@@ -74,11 +74,26 @@ class GPTConfig:
     rotary_dim: Optional[int] = None       # GPT-J rotary channels (0/None=off)
     parallel_residual: bool = False        # x + attn(h) + mlp(h), h=ln1(x)
     use_wpe: bool = True                   # learned absolute positions
+    # grouped-query attention: fewer kv heads than q heads (None = MHA).
+    # Shrinks the inference KV cache by n_heads/n_kv_heads; the flash
+    # kernel groups kv blocks natively
+    n_kv_heads: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
         assert self.d_model % self.n_heads == 0
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        h = self.n_kv_heads or self.n_heads
+        assert self.n_heads % h == 0, (self.n_heads, h)
+        return h
+
+    @property
+    def qkv_dim(self) -> int:
+        """Fused qkv projection width: H*Dh + 2*Hkv*Dh."""
+        return (self.n_heads + 2 * self.kv_heads) * self.head_dim
 
     @property
     def ffn_dim(self) -> int:
@@ -126,8 +141,8 @@ def init_params(rng: jax.Array, cfg: GPTConfig) -> Dict:
         "wpe": {"embedding": init(k_pos, (cfg.max_seq_len, d), jnp.float32)},
         "block": {
             "ln1": {"scale": jnp.ones((L, d)), "bias": jnp.zeros((L, d))},
-            "qkv": {"kernel": stacked(ks[0], (d, 3 * d)),
-                    "bias": jnp.zeros((L, 3 * d))},
+            "qkv": {"kernel": stacked(ks[0], (d, cfg.qkv_dim)),
+                    "bias": jnp.zeros((L, cfg.qkv_dim))},
             "attn_out": {"kernel": stacked(ks[1], (d, d), resid_init),
                          "bias": jnp.zeros((L, d))},
             "ln2": {"scale": jnp.ones((L, d)), "bias": jnp.zeros((L, d))},
@@ -229,6 +244,10 @@ def _attention(q, k, v, cfg: GPTConfig, segment_ids=None, kv_mask=None):
             "packed segment_ids / kv_mask + sequence parallelism is not "
             "supported; mask within the local shard or disable one of the two")
     if cfg.sequence_parallel and cfg.mesh is not None:
+        if k.shape[2] != q.shape[2]:
+            raise NotImplementedError(
+                "grouped-query attention + sequence parallelism is not "
+                "supported (ring/Ulysses assume equal head counts)")
         if cfg.sp_impl == "ulysses":
             from deepspeed_tpu.ops.attention.ulysses import ulysses_attention
             blocks = _flash_blocks(cfg, q.shape[1])
@@ -269,10 +288,12 @@ def _block(x, layer_params, cfg: GPTConfig, dropout_rng=None,
     h = _layernorm(x, p["ln1"]["scale"], p["ln1"]["bias"])
     qkv = h @ p["qkv"]["kernel"].astype(h.dtype) + p["qkv"]["bias"].astype(h.dtype)
     qkv = checkpoint_name(qkv, "qkv")
-    q, k, v = jnp.split(qkv, 3, axis=-1)
+    Hkv = cfg.kv_heads
+    q, k, v = jnp.split(
+        qkv, [H * Dh, (H + Hkv) * Dh], axis=-1)
     q = q.reshape(B, S, H, Dh)
-    k = k.reshape(B, S, H, Dh)
-    v = v.reshape(B, S, H, Dh)
+    k = k.reshape(B, S, Hkv, Dh)
+    v = v.reshape(B, S, Hkv, Dh)
     if cfg.rotary_dim:
         from deepspeed_tpu.ops.attention.rotary import apply_rotary
         q, k = apply_rotary(
@@ -640,9 +661,9 @@ def host_param_factory(seed: int, cfg: GPTConfig):
         return {
             "ln1": {"scale": np.ones((d,), np.float32),
                     "bias": np.zeros((d,), np.float32)},
-            "qkv": {"kernel": (r.standard_normal((d, 3 * d), np.float32)
-                               * 0.02),
-                    "bias": np.zeros((3 * d,), np.float32)},
+            "qkv": {"kernel": (r.standard_normal(
+                        (d, cfg.qkv_dim), np.float32) * 0.02),
+                    "bias": np.zeros((cfg.qkv_dim,), np.float32)},
             "attn_out": {"kernel": (r.standard_normal((d, d), np.float32)
                                     * resid),
                          "bias": np.zeros((d,), np.float32)},
@@ -661,7 +682,8 @@ def host_param_factory(seed: int, cfg: GPTConfig):
 
 def num_params(cfg: GPTConfig) -> int:
     d, L, ff, V = cfg.d_model, cfg.n_layers, cfg.ffn_dim, cfg.vocab_size
-    per_layer = 3 * d * d + 3 * d + d * d + d + 2 * d * ff + ff + d + 4 * d
+    qkv = cfg.qkv_dim                  # (H + 2*Hkv) * Dh — GQA-aware
+    per_layer = d * qkv + qkv + d * d + d + 2 * d * ff + ff + d + 4 * d
     n = V * d + cfg.max_seq_len * d + L * per_layer + 2 * d
     if not cfg.tie_embeddings:
         n += d * V

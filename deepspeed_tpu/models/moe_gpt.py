@@ -61,11 +61,12 @@ def _moe_block(x, layer_params, cfg: MoEGPTConfig, rng, train: bool):
     H, Dh = cfg.n_heads, cfg.head_dim
     p = layer_params
 
+    Hkv = cfg.kv_heads
     h = _layernorm(x, p["ln1"]["scale"], p["ln1"]["bias"])
     qkv = h @ p["qkv"]["kernel"].astype(h.dtype) + p["qkv"]["bias"].astype(h.dtype)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    attn = _attention(q.reshape(B, S, H, Dh), k.reshape(B, S, H, Dh),
-                      v.reshape(B, S, H, Dh), cfg).reshape(B, S, D)
+    q, k, v = jnp.split(qkv, [H * Dh, (H + Hkv) * Dh], axis=-1)
+    attn = _attention(q.reshape(B, S, H, Dh), k.reshape(B, S, Hkv, Dh),
+                      v.reshape(B, S, Hkv, Dh), cfg).reshape(B, S, D)
     attn = attn @ p["attn_out"]["kernel"].astype(attn.dtype) + \
         p["attn_out"]["bias"].astype(attn.dtype)
     x = x + attn

@@ -89,3 +89,24 @@ def test_tp_gpt_matches_dp(devices):
     out = jax.jit(lambda p, t: gpt.forward(p, t, cfg))(params_tp, tokens)
     np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_gqa_model_trains_and_matches_reference_shapes(devices):
+    """n_kv_heads < n_heads: fused qkv carries H + 2*Hkv heads, the model
+    trains, and the loss is finite."""
+    import deepspeed_tpu
+    cfg = gpt.GPTConfig(vocab_size=128, n_layers=2, n_heads=4, d_model=32,
+                        max_seq_len=32, dtype=jnp.float32,
+                        use_flash_attention=False, remat=False,
+                        n_kv_heads=2)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    assert params["block"]["qkv"]["kernel"].shape == (2, 32, (4 + 4) * 8)
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt.make_loss_fn(cfg), model_parameters=params,
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "steps_per_print": 1000})
+    toks = {"tokens": np.random.default_rng(0).integers(
+        0, 128, (8, 17)).astype(np.int32)}
+    losses = [float(eng.train_batch(toks)["loss"]) for _ in range(5)]
+    assert losses[-1] < losses[0]
