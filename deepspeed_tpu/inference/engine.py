@@ -143,6 +143,11 @@ def _block_decode(x, k_cache, v_cache, pos, p, cfg: GPTConfig,
         else 1.0 / np.sqrt(Dh)
     idx = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, S_max), 3)
     scores = jnp.where(idx <= pos, scores, -1e30)
+    if cfg.attn_window is not None:
+        # logical distance == cache-index distance even under left
+        # padding (both the query and every cached slot shift by the
+        # same per-row pad)
+        scores = jnp.where(idx > pos - cfg.attn_window, scores, -1e30)
     if cache_mask is not None:
         scores = jnp.where(cache_mask[:, None, None, :] > 0, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)

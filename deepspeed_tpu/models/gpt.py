@@ -78,6 +78,9 @@ class GPTConfig:
     # Shrinks the inference KV cache by n_heads/n_kv_heads; the flash
     # kernel groups kv blocks natively
     n_kv_heads: Optional[int] = None
+    # sliding-window (local) attention: token i attends (i-window, i]
+    # only — O(S*window) compute and HBM reads in the flash kernel
+    attn_window: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
@@ -238,11 +241,12 @@ def _attention(q, k, v, cfg: GPTConfig, segment_ids=None, kv_mask=None):
     inside each segment (block-diagonal x causal).
     kv_mask: optional [B, S] key-validity mask (left-padded prompts)."""
     scale = cfg.attn_scale  # None -> kernels default to 1/sqrt(Dh)
-    if (segment_ids is not None or kv_mask is not None) \
+    if (segment_ids is not None or kv_mask is not None
+            or cfg.attn_window is not None) \
             and cfg.sequence_parallel and cfg.mesh is not None:
         raise NotImplementedError(
-            "packed segment_ids / kv_mask + sequence parallelism is not "
-            "supported; mask within the local shard or disable one of the two")
+            "segment_ids / kv_mask / attn_window + sequence parallelism is "
+            "not supported; disable one of the two")
     if cfg.sequence_parallel and cfg.mesh is not None:
         if k.shape[2] != q.shape[2]:
             raise NotImplementedError(
@@ -266,10 +270,12 @@ def _attention(q, k, v, cfg: GPTConfig, segment_ids=None, kv_mask=None):
         from deepspeed_tpu.ops.attention.flash import flash_attention
         return flash_attention(q, k, v, causal=True, scale=scale,
                                block_q=blocks[0], block_kv=blocks[1],
-                               segment_ids=segment_ids, kv_mask=kv_mask)
+                               segment_ids=segment_ids, kv_mask=kv_mask,
+                               window=cfg.attn_window)
     from deepspeed_tpu.ops.attention.flash import mha_reference
     return mha_reference(q, k, v, causal=True, scale=scale,
-                         segment_ids=segment_ids, kv_mask=kv_mask)
+                         segment_ids=segment_ids, kv_mask=kv_mask,
+                         window=cfg.attn_window)
 
 
 def _block(x, layer_params, cfg: GPTConfig, dropout_rng=None,

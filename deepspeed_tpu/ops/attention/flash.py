@@ -37,19 +37,45 @@ def _ceil_to(x, m):
     return (x + m - 1) // m * m
 
 
-def _causal_kv_index_map(block_q, block_kv, num_kv):
+def _causal_kv_index_map(block_q, block_kv, num_kv, window=None):
     """Block index map for KV-blocked inputs when the grid is
     (b, h, q-block, kv-block) and causal skipping applies: skipped
     above-diagonal steps re-map to the last valid KV block, so the index
     equals the previous step's and Mosaic elides the DMA (the compute is
-    already skipped by pl.when). Clamped into range for Skv != S callers."""
+    already skipped by pl.when). Clamped into range for Skv != S callers.
+
+    With a sliding ``window``, blocks fully BELOW the band (ki too small)
+    clamp up to the first in-band block — their fetches elide the same
+    way, making windowed attention O(S*W) in HBM reads as well."""
 
     def kvmap(b, h, qi, ki):
         limit = jnp.minimum((qi * block_q + block_q - 1) // block_kv,
                             num_kv - 1)
-        return (b, h, jnp.minimum(ki, limit), 0)
+        ki = jnp.minimum(ki, limit)
+        if window is not None:
+            lo = jnp.clip((qi * block_q - window + 1) // block_kv,
+                          0, num_kv - 1)
+            ki = jnp.maximum(ki, lo)
+        return (b, h, ki, 0)
 
     return kvmap
+
+
+def _band_run(qi, ki, block_q, block_kv, causal, window):
+    """Whether grid step (qi, ki) intersects the attention band."""
+    run = True
+    if causal:
+        run = qi * block_q + block_q - 1 >= ki * block_kv
+    if window is not None:
+        # lowest q row of the block must still reach the block's last col
+        run = jnp.logical_and(
+            run, ki * block_kv + block_kv - 1 >= qi * block_q - window + 1)
+    return run
+
+
+def _window_mask(s, rows, cols, window):
+    """cols within (rows - window, rows]: Mistral-style local attention."""
+    return jnp.where(rows - cols < window, s, NEG_INF)
 
 
 # ---------------------------------------------------------------------------
@@ -58,7 +84,7 @@ def _causal_kv_index_map(block_q, block_kv, num_kv):
 
 def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
                 causal: bool, has_mask: bool, has_segs: bool, scale: float,
-                block_q: int, block_kv: int, num_kv: int):
+                block_q: int, block_kv: int, num_kv: int, window=None):
     rest = list(rest)
     mask_ref = rest.pop(0) if has_mask else None
     qseg_ref = rest.pop(0) if has_segs else None
@@ -73,12 +99,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
         l_scratch[:] = jnp.zeros_like(l_scratch)
         acc_scratch[:] = jnp.zeros_like(acc_scratch)
 
-    run = True
-    if causal:
-        # whole block above the diagonal -> skip
-        run = qi * block_q + block_q - 1 >= ki * block_kv
+    run = _band_run(qi, ki, block_q, block_kv, causal, window)
 
-    @pl.when(run if causal else True)
+    @pl.when(run)
     def _body():
         q = q_ref[0, 0]                  # [block_q, d]
         k = k_ref[0, 0]                  # [block_kv, d]
@@ -91,6 +114,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
             rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + qi * block_q
             cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ki * block_kv
             s = jnp.where(rows >= cols, s, NEG_INF)
+            if window is not None:
+                s = _window_mask(s, rows, cols, window)
         if has_mask:
             s = jnp.where(mask_ref[0][None, :] > 0, s, NEG_INF)
         if has_segs:
@@ -152,7 +177,8 @@ def _group_head(map_fn, group: int):
     return wrapped
 
 
-def _flash_fwd(q, k, v, mask, segs, causal, scale, block_q, block_kv):
+def _flash_fwd(q, k, v, mask, segs, causal, scale, block_q, block_kv,
+               window=None):
     # arrays are [B, H, S, D] inside the op (wrapper transposes)
     B, H, S, D = q.shape
     Skv = k.shape[2]
@@ -167,7 +193,7 @@ def _flash_fwd(q, k, v, mask, segs, causal, scale, block_q, block_kv):
         return (b, h, qi, 0)
 
     if causal:
-        kvmap = _causal_kv_index_map(block_q, block_kv, num_kv)
+        kvmap = _causal_kv_index_map(block_q, block_kv, num_kv, window)
     else:
         def kvmap(b, h, qi, ki):
             return (b, h, ki, 0)
@@ -178,7 +204,8 @@ def _flash_fwd(q, k, v, mask, segs, causal, scale, block_q, block_kv):
     has_segs = segs is not None
     kernel = functools.partial(
         _fwd_kernel, causal=causal, has_mask=has_mask, has_segs=has_segs,
-        scale=scale, block_q=block_q, block_kv=block_kv, num_kv=num_kv)
+        scale=scale, block_q=block_q, block_kv=block_kv, num_kv=num_kv,
+        window=window)
 
     in_specs = [
         pl.BlockSpec((1, 1, block_q, D), qmap),
@@ -224,7 +251,8 @@ def _flash_fwd(q, k, v, mask, segs, causal, scale, block_q, block_kv):
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     *rest, causal: bool, has_mask: bool, has_segs: bool,
-                    scale: float, block_q: int, block_kv: int, num_q: int):
+                    scale: float, block_q: int, block_kv: int, num_q: int,
+                    window=None):
     rest = list(rest)
     mask_ref = rest.pop(0) if has_mask else None
     qseg_ref = rest.pop(0) if has_segs else None
@@ -238,11 +266,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scratch[:] = jnp.zeros_like(dk_scratch)
         dv_scratch[:] = jnp.zeros_like(dv_scratch)
 
-    run = True
-    if causal:
-        run = qi * block_q + block_q - 1 >= ki * block_kv
+    run = _band_run(qi, ki, block_q, block_kv, causal, window)
 
-    @pl.when(run if causal else True)
+    @pl.when(run)
     def _body():
         q = q_ref[0, 0]                # [bq, d]
         k = k_ref[0, 0]                # [bkv, d]
@@ -257,6 +283,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + qi * block_q
             cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ki * block_kv
             s = jnp.where(rows >= cols, s, NEG_INF)
+            if window is not None:
+                s = _window_mask(s, rows, cols, window)
         if has_mask:
             s = jnp.where(mask_ref[0][None, :] > 0, s, NEG_INF)
         if has_segs:
@@ -285,7 +313,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    *rest, causal: bool, has_mask: bool, has_segs: bool,
-                   scale: float, block_q: int, block_kv: int, num_kv: int):
+                   scale: float, block_q: int, block_kv: int, num_kv: int,
+                   window=None):
     rest = list(rest)
     mask_ref = rest.pop(0) if has_mask else None
     qseg_ref = rest.pop(0) if has_segs else None
@@ -298,11 +327,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_scratch[:] = jnp.zeros_like(dq_scratch)
 
-    run = True
-    if causal:
-        run = qi * block_q + block_q - 1 >= ki * block_kv
+    run = _band_run(qi, ki, block_q, block_kv, causal, window)
 
-    @pl.when(run if causal else True)
+    @pl.when(run)
     def _body():
         q = q_ref[0, 0]
         k = k_ref[0, 0]
@@ -317,6 +344,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + qi * block_q
             cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ki * block_kv
             s = jnp.where(rows >= cols, s, NEG_INF)
+            if window is not None:
+                s = _window_mask(s, rows, cols, window)
         if has_mask:
             s = jnp.where(mask_ref[0][None, :] > 0, s, NEG_INF)
         if has_segs:
@@ -335,7 +364,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0, 0] = dq_scratch[:].astype(dq_ref.dtype)
 
 
-def _flash_bwd(causal, scale, block_q, block_kv, res, g):
+def _flash_bwd(causal, scale, block_q, block_kv, window, res, g):
     q, k, v, mask, segs, o, lse = res
     do = g
     B, H, S, D = q.shape
@@ -357,7 +386,8 @@ def _flash_bwd(causal, scale, block_q, block_kv, res, g):
         return (b, h, i, 0)
 
     if causal:
-        kvmap_q_outer = _causal_kv_index_map(block_q, block_kv, num_kv)
+        kvmap_q_outer = _causal_kv_index_map(block_q, block_kv, num_kv,
+                                             window)
     else:
         def kvmap_q_outer(b, h, i, j):
             return (b, h, j, 0)
@@ -384,7 +414,7 @@ def _flash_bwd(causal, scale, block_q, block_kv, res, g):
         functools.partial(_bwd_dq_kernel, causal=causal, has_mask=has_mask,
                           has_segs=has_segs,
                           scale=scale, block_q=block_q, block_kv=block_kv,
-                          num_kv=num_kv),
+                          num_kv=num_kv, window=window),
         grid=(B, H, num_q, num_kv),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, block_q, D), qmap),
@@ -402,10 +432,18 @@ def _flash_bwd(causal, scale, block_q, block_kv, res, g):
         # early q blocks are above the diagonal for this kv block: clamp
         # to the first valid q block so the skipped steps' fetches elide
         # (min'd into range for Skv > S callers, where no q block may be
-        # valid for the last kv blocks)
+        # valid for the last kv blocks). With a sliding window the LAST
+        # valid q block is bounded too — late steps clamp down the same
+        # way.
         def qmap_kv_outer(b, h, ki, qi):
             first = jnp.minimum((ki * block_kv) // block_q, num_q - 1)
-            return (b, h, jnp.maximum(qi, first), 0)
+            qi = jnp.maximum(qi, first)
+            if window is not None:
+                last = jnp.minimum(
+                    (ki * block_kv + block_kv - 1 + window - 1) // block_q,
+                    num_q - 1)
+                qi = jnp.minimum(qi, last)
+            return (b, h, qi, 0)
     else:
         def qmap_kv_outer(b, h, ki, qi):
             return (b, h, qi, 0)
@@ -433,7 +471,7 @@ def _flash_bwd(causal, scale, block_q, block_kv, res, g):
         functools.partial(_bwd_dkv_kernel, causal=causal, has_mask=has_mask,
                           has_segs=has_segs,
                           scale=scale, block_q=block_q, block_kv=block_kv,
-                          num_q=num_q),
+                          num_q=num_q, window=window),
         grid=(B, H, num_kv, num_q),
         in_specs=in_specs,
         out_specs=[
@@ -470,15 +508,18 @@ def _flash_bwd(causal, scale, block_q, block_kv, res, g):
 # public op
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
-def _flash(q, k, v, mask, segs, causal, scale, block_q, block_kv):
-    o, _ = _flash_fwd(q, k, v, mask, segs, causal, scale, block_q, block_kv)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash(q, k, v, mask, segs, causal, scale, block_q, block_kv,
+           window=None):
+    o, _ = _flash_fwd(q, k, v, mask, segs, causal, scale, block_q, block_kv,
+                      window)
     return o
 
 
-def _flash_vjp_fwd(q, k, v, mask, segs, causal, scale, block_q, block_kv):
+def _flash_vjp_fwd(q, k, v, mask, segs, causal, scale, block_q, block_kv,
+                   window=None):
     o, lse = _flash_fwd(q, k, v, mask, segs, causal, scale, block_q,
-                        block_kv)
+                        block_kv, window)
     # named so a selective remat policy can keep the residuals — without
     # these, jax.checkpoint re-runs the whole forward kernel in the backward
     # pass just to regenerate o/lse. The o residual is stored with (H, D)
@@ -492,11 +533,11 @@ def _flash_vjp_fwd(q, k, v, mask, segs, causal, scale, block_q, block_kv):
     return o, (q, k, v, mask, segs, o_res, lse)
 
 
-def _flash_vjp_bwd(causal, scale, block_q, block_kv, res, g):
+def _flash_vjp_bwd(causal, scale, block_q, block_kv, window, res, g):
     q, k, v, mask, segs, o_res, lse = res
     B, H, S, D = q.shape
     o = o_res.reshape(B, S, H, D).transpose(0, 2, 1, 3)
-    dq, dk, dv = _flash_bwd(causal, scale, block_q, block_kv,
+    dq, dk, dv = _flash_bwd(causal, scale, block_q, block_kv, window,
                             (q, k, v, mask, segs, o, lse), g)
     return dq, dk, dv, None, None
 
@@ -508,7 +549,8 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     causal: bool = True, scale: Optional[float] = None,
                     block_q: int = 512, block_kv: int = 512,
                     kv_mask: Optional[jnp.ndarray] = None,
-                    segment_ids: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                    segment_ids: Optional[jnp.ndarray] = None,
+                    window: Optional[int] = None) -> jnp.ndarray:
     """Flash attention over [B, S, H, D] tensors.
 
     Head dims that are sublane-aligned (multiple of 8) run unpadded: Mosaic
@@ -534,6 +576,10 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     Grouped-query attention: k/v may carry FEWER heads than q
     (``H % Hkv == 0``); each group of ``H // Hkv`` query heads shares one
     kv head, shrinking the KV cache by the group factor.
+
+    window: optional sliding-window size (requires causal): token i
+    attends tokens (i-window, i] only — O(S*window) compute AND HBM
+    reads (out-of-band blocks' fetches are elided via index-map clamps).
     """
     B, S, H, D = q.shape
     Hkv = k.shape[2]
@@ -544,6 +590,9 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         scale = 1.0 / np.sqrt(D)
     if segment_ids is not None:
         assert k.shape[1] == S, "segment_ids requires self-attention (Skv == S)"
+    if window is not None:
+        assert causal, "sliding window attention requires causal=True"
+        assert window >= 1
     Dp = D if D % 8 == 0 else _ceil_to(D, 8)
     if Dp != D:
         pad = [(0, 0), (0, 0), (0, 0), (0, Dp - D)]
@@ -559,7 +608,7 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if segment_ids is not None:
         segment_ids = segment_ids.astype(jnp.int32)
     out = _flash(q, k, v, kv_mask, segment_ids, causal, scale,
-                 block_q, block_kv)
+                 block_q, block_kv, window)
     out = out.transpose(0, 2, 1, 3)
     if Dp != D:
         out = out[..., :D]
@@ -567,7 +616,7 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
 
 def mha_reference(q, k, v, causal=True, scale=None, kv_mask=None,
-                  segment_ids=None):
+                  segment_ids=None, window=None):
     """Pure-jnp reference for parity tests (analog of the python BERT
     baselines in ref tests/unit/test_cuda_forward.py)."""
     B, S, H, D = q.shape
@@ -579,6 +628,9 @@ def mha_reference(q, k, v, causal=True, scale=None, kv_mask=None,
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
         mask = jnp.tril(jnp.ones((S, k.shape[1]), bool))
+        if window is not None:
+            mask = mask & ~jnp.tril(jnp.ones((S, k.shape[1]), bool),
+                                    -window)
         logits = jnp.where(mask[None, None], logits, NEG_INF)
     if kv_mask is not None:
         logits = jnp.where(kv_mask[:, None, None, :] > 0, logits, NEG_INF)

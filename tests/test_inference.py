@@ -412,3 +412,21 @@ def test_gqa_decode_matches_prefill(devices):
     # fused path agrees too
     fused = eng.generate_fused(tokens, max_new_tokens=5, temperature=0.0)
     np.testing.assert_array_equal(fused, gen)
+
+
+def test_windowed_decode_matches_prefill(devices):
+    """attn_window model: KV-cache decode masks the cache to the same
+    sliding window the forward pass uses."""
+    import dataclasses
+    cfg, _ = tiny()
+    cfg = dataclasses.replace(cfg, attn_window=6)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    eng = InferenceEngine(config=cfg, params=params, dtype=jnp.float32)
+    tokens = np.random.default_rng(12).integers(0, 128, (1, 10)).astype(np.int32)
+    gen = eng.generate(tokens, max_new_tokens=8, temperature=0.0)
+    cur = tokens.copy()
+    for _ in range(8):
+        logits = np.asarray(eng.forward(cur))
+        nxt = logits[:, -1].argmax(-1)[:, None].astype(np.int32)
+        cur = np.concatenate([cur, nxt], axis=1)
+    np.testing.assert_array_equal(gen, cur)
