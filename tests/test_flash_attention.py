@@ -230,3 +230,19 @@ def test_sliding_window_model_matches_reference(devices):
     ref = F.mha_reference(q, q, q, causal=True, window=8)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_window_gqa_segments_compose(devices):
+    """window + GQA + segment_ids in one call — all masks and the
+    grouped kv maps compose."""
+    q, _, _ = _rand_qkv(B=1, S=256, H=4, D=32, seed=13)
+    ks = jax.random.split(jax.random.PRNGKey(14), 2)
+    k = jax.random.normal(ks[0], (1, 256, 2, 32), jnp.float32)
+    v = jax.random.normal(ks[1], (1, 256, 2, 32), jnp.float32)
+    segs = jnp.asarray(np.repeat([0, 1], 128)[None], jnp.int32)
+    out = F.flash_attention(q, k, v, causal=True, block_q=128,
+                            block_kv=128, window=64, segment_ids=segs)
+    ref = F.mha_reference(q, k, v, causal=True, window=64,
+                          segment_ids=segs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
