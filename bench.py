@@ -19,6 +19,7 @@ as round 1, for cross-round comparability.
 """
 
 import json
+import os
 import sys
 import time
 
@@ -161,9 +162,38 @@ def _run_one(which):
     raise ValueError(which)
 
 
+def _backend_reachable(timeout=240) -> bool:
+    """Probe the accelerator backend in a SUBPROCESS: a wedged TPU tunnel
+    hangs jax.devices() forever (observed on this rig, PERF.md), and a
+    hang inside the driver's bench run would record nothing at all."""
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        return True          # a local CPU backend cannot be unreachable
+    import subprocess
+    probe = ("import sys; sys.path.insert(0, '.')\n"
+             "from deepspeed_tpu.utils import honor_platform_request\n"
+             "honor_platform_request()\n"
+             "import jax; print(jax.devices())\n")
+    try:
+        r = subprocess.run([sys.executable, "-c", probe],
+                           capture_output=True, timeout=timeout)
+        return r.returncode == 0
+    except Exception:
+        return False
+
+
 def main():
     if len(sys.argv) > 2 and sys.argv[1] == "--one":
         print(json.dumps(_run_one(sys.argv[2])))
+        return
+
+    if not _backend_reachable():
+        print(json.dumps({
+            "metric": "gpt2_1.5b_seq1024_train_tokens_per_sec_per_chip",
+            "value": 0, "unit": "tokens/s/chip", "vs_baseline": 0,
+            "detail": {"error": "accelerator backend unreachable (device "
+                                "probe hung/failed); see PERF.md for the "
+                                "last measured on-chip numbers (1.5B "
+                                "headline table + chunked-CE section)"}}))
         return
 
     on_tpu = _on_tpu()
