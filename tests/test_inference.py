@@ -430,3 +430,35 @@ def test_windowed_decode_matches_prefill(devices):
         nxt = logits[:, -1].argmax(-1)[:, None].astype(np.int32)
         cur = np.concatenate([cur, nxt], axis=1)
     np.testing.assert_array_equal(gen, cur)
+
+
+def test_mqa_and_composed_generation(devices):
+    """n_kv_heads=1 (MQA) composed with attn_window and a left-padded
+    batch: the full serving stack (grouped cache + windowed decode +
+    per-row positions) reproduces the per-prompt solo runs."""
+    import dataclasses
+    cfg, _ = tiny()
+    cfg = dataclasses.replace(cfg, n_kv_heads=1, attn_window=6)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    eng = InferenceEngine(config=cfg, params=params, dtype=jnp.float32)
+    r = np.random.default_rng(15)
+    p1 = r.integers(1, 128, 5).astype(np.int32)
+    p2 = r.integers(1, 128, 9).astype(np.int32)
+    n = 6
+    ref1 = eng.generate(p1[None], max_new_tokens=n)[0, len(p1):]
+    ref2 = eng.generate(p2[None], max_new_tokens=n)[0, len(p2):]
+
+    S = 9
+    tokens = np.zeros((2, S), np.int32)
+    mask = np.zeros((2, S), np.float32)
+    tokens[0, S - 5:] = p1
+    mask[0, S - 5:] = 1
+    tokens[1] = p2
+    mask[1] = 1
+    for fn in (eng.generate, eng.generate_fused):
+        out = fn(tokens, max_new_tokens=n, attention_mask=mask)
+        np.testing.assert_array_equal(out[0, S:], ref1)
+        np.testing.assert_array_equal(out[1, S:], ref2)
+    # MQA cache: single kv head
+    _, cache = eng._prefill(eng.params, jnp.asarray(tokens), None)
+    assert cache["k"].shape[3] == 1
