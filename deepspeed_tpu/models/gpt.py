@@ -248,10 +248,11 @@ def _attention(q, k, v, cfg: GPTConfig, segment_ids=None, kv_mask=None):
             "segment_ids / kv_mask / attn_window + sequence parallelism is "
             "not supported; disable one of the two")
     if cfg.sequence_parallel and cfg.mesh is not None:
-        if k.shape[2] != q.shape[2]:
+        if k.shape[2] != q.shape[2] and cfg.sp_impl != "ulysses":
             raise NotImplementedError(
-                "grouped-query attention + sequence parallelism is not "
-                "supported (ring/Ulysses assume equal head counts)")
+                "grouped-query attention + ring sequence parallelism is "
+                "not supported (use sp_impl='ulysses'; the sp degree must "
+                "divide both head counts)")
         if cfg.sp_impl == "ulysses":
             from deepspeed_tpu.ops.attention.ulysses import ulysses_attention
             blocks = _flash_blocks(cfg, q.shape[1])

@@ -18,7 +18,9 @@ Trade-off vs ring: 2 all-to-alls of activation size per attention call
 (O(B·S·d/sp) bytes each, constant in sp) instead of sp ppermute hops of
 K/V; attention compute is perfectly balanced even for causal masks
 (ring's lower-triangle causes stage imbalance), and the unmodified
-single-device kernel runs inside. Requires n_heads % sp == 0.
+single-device kernel runs inside. Requires the sp degree to divide the
+head count — for GQA, BOTH head counts (the local kernel keeps the
+global q/kv group ratio).
 """
 
 from functools import partial
@@ -32,10 +34,15 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 def _ulysses_local(q, k, v, *, axis: str, causal: bool, scale: float,
                    use_flash: bool, block_q: int, block_kv: int):
-    """Inside shard_map: q,k,v local [B, S_loc, H, D] -> [B, S_loc, H, D]."""
+    """Inside shard_map: q local [B, S_loc, H, D]; k/v may carry Hkv < H
+    heads (GQA) -> out [B, S_loc, H, D]."""
     sp = jax.lax.axis_size(axis)
     B, S_loc, H, D = q.shape
+    Hkv = k.shape[2]
     assert H % sp == 0, f"n_heads {H} not divisible by sp degree {sp}"
+    assert Hkv % sp == 0, \
+        f"kv heads {Hkv} not divisible by sp degree {sp} (GQA + Ulysses " \
+        "needs both head counts divisible)"
 
     # seq-sharded -> head-sharded: [B, S_loc, H, D] -> [B, S, H/sp, D]
     def seq2head(x):
