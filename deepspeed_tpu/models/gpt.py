@@ -248,11 +248,8 @@ def _attention(q, k, v, cfg: GPTConfig, segment_ids=None, kv_mask=None):
             "segment_ids / kv_mask / attn_window + sequence parallelism is "
             "not supported; disable one of the two")
     if cfg.sequence_parallel and cfg.mesh is not None:
-        if k.shape[2] != q.shape[2] and cfg.sp_impl != "ulysses":
-            raise NotImplementedError(
-                "grouped-query attention + ring sequence parallelism is "
-                "not supported (use sp_impl='ulysses'; the sp degree must "
-                "divide both head counts)")
+        # GQA works under both SP impls: ring rotates the small grouped
+        # k/v; Ulysses needs the sp degree to divide both head counts
         if cfg.sp_impl == "ulysses":
             from deepspeed_tpu.ops.attention.ulysses import ulysses_attention
             blocks = _flash_blocks(cfg, q.shape[1])

@@ -28,10 +28,16 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 
 def _ring_attention_local(q, k, v, *, axis: str, causal: bool, scale: float):
-    """Inside shard_map: q,k,v local [B, S_loc, H, D]; returns [B,S_loc,H,D]."""
+    """Inside shard_map: q local [B, S_loc, H, D]; k/v may carry Hkv < H
+    heads (GQA) — the SMALL grouped k/v rotate around the ring (the
+    ICI-traffic win scales with the group factor) and are repeated
+    locally per step for the einsum. Returns [B, S_loc, H, D]."""
     n = jax.lax.axis_size(axis)
     idx = jax.lax.axis_index(axis)
     B, S_loc, H, D = q.shape
+    Hkv = k.shape[2]
+    assert H % Hkv == 0, f"q heads {H} not a multiple of kv heads {Hkv}"
+    group = H // Hkv
     qf = q.astype(jnp.float32)
 
     q_pos = idx * S_loc + jax.lax.broadcasted_iota(
@@ -43,7 +49,11 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool, scale: float):
         k_cur, v_cur, m, l, acc = carry
         # the block currently held originated at ring position (idx - i) % n
         src = (idx - i) % n
-        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_cur.astype(jnp.float32)) * scale
+        # repeat LOCALLY for the einsum; the carry (and the ppermute
+        # below) stays at the small grouped width
+        k_use = jnp.repeat(k_cur, group, axis=2) if group > 1 else k_cur
+        v_use = jnp.repeat(v_cur, group, axis=2) if group > 1 else v_cur
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_use.astype(jnp.float32)) * scale
         if causal:
             k_pos = src * S_loc + jax.lax.broadcasted_iota(
                 jnp.int32, (S_loc, S_loc), 1)
@@ -54,7 +64,7 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool, scale: float):
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        pv = jnp.einsum("bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32))
+        pv = jnp.einsum("bhqk,bkhd->bhqd", p, v_use.astype(jnp.float32))
         acc_new = acc * alpha.transpose(0, 1, 2, 3) + pv
         k_nxt = jax.lax.ppermute(k_cur, axis, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis, perm)
