@@ -23,6 +23,9 @@ HEALTH = (
 )
 
 QUEUE = [
+    # compile/parity-check the new flash kernel features through the REAL
+    # Mosaic lowering before any measurement relies on them
+    ("flash-smoke", [sys.executable, "tools/flash_chip_smoke.py"], 1800),
     ("probe", [sys.executable, "tools/headline_probe.py",
                "med-b8-noremat", "med-b16-noremat", "med-b16-ce"], 7400),
     ("trace-1.5b", [sys.executable, "tools/trace_analyze.py", "run",

@@ -1,0 +1,78 @@
+"""On-chip Mosaic compile/parity smoke for the flash kernel feature matrix.
+
+The kv_mask / segment_ids / GQA / sliding-window operand plumbing is
+interpret-mode tested on CPU; this script compiles and runs each feature
+(and their composition) through the REAL Mosaic lowering on the local
+TPU and checks parity vs the jnp reference — run it (via chip_queue)
+before trusting the new kernel paths on hardware.
+
+Usage: python tools/flash_chip_smoke.py
+Prints one JSON line per case.
+"""
+
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from deepspeed_tpu.ops.attention import flash as F  # noqa: E402
+
+
+def run_case(name, make):
+    try:
+        q, k, v, kwargs = make()
+        out = jax.jit(lambda q, k, v: F.flash_attention(
+            q, k, v, causal=True, block_q=256, block_kv=256,
+            **kwargs))(q, k, v)
+        ref = F.mha_reference(q, k, v, causal=True, **kwargs)
+        err = float(jnp.max(jnp.abs(out.astype(jnp.float32) -
+                                    ref.astype(jnp.float32))))
+        # backward too: grads through the custom VJP
+        g = jax.grad(lambda q: (F.flash_attention(
+            q, k, v, causal=True, block_q=256, block_kv=256,
+            **kwargs) ** 2).sum())(q)
+        gref = jax.grad(lambda q: (F.mha_reference(
+            q, k, v, causal=True, **kwargs) ** 2).sum())(q)
+        gerr = float(jnp.max(jnp.abs(g - gref)))
+        ok = err < 5e-2 and gerr < 5e-1   # bf16 tolerances
+        print(json.dumps({"case": name, "ok": bool(ok),
+                          "fwd_err": round(err, 5),
+                          "dq_err": round(gerr, 5)}), flush=True)
+    except Exception as e:
+        print(json.dumps({"case": name, "ok": False,
+                          "error": repr(e)[:300]}), flush=True)
+
+
+def main():
+    r = np.random.default_rng(0)
+    B, S, H, D = 2, 1024, 8, 64
+
+    def qkv(hkv=H):
+        q = jnp.asarray(r.standard_normal((B, S, H, D)), jnp.bfloat16)
+        k = jnp.asarray(r.standard_normal((B, S, hkv, D)), jnp.bfloat16)
+        v = jnp.asarray(r.standard_normal((B, S, hkv, D)), jnp.bfloat16)
+        return q, k, v
+
+    mask = jnp.asarray((r.random((B, S)) > 0.2).astype(np.float32))
+    segs = jnp.asarray(np.repeat(np.arange(4), S // 4)[None].repeat(B, 0),
+                       jnp.int32)
+
+    cases = [
+        ("plain", lambda: (*qkv(), {})),
+        ("kv_mask", lambda: (*qkv(), {"kv_mask": mask})),
+        ("segments", lambda: (*qkv(), {"segment_ids": segs})),
+        ("gqa", lambda: (*qkv(hkv=2), {})),
+        ("window", lambda: (*qkv(), {"window": 256})),
+        ("window+gqa+segs", lambda: (*qkv(hkv=2),
+                                     {"window": 256, "segment_ids": segs})),
+    ]
+    for name, make in cases:
+        run_case(name, make)
+
+
+if __name__ == "__main__":
+    main()
