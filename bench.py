@@ -61,15 +61,29 @@ def _on_tpu() -> bool:
 
 def run_config(preset, batch, seq, steps, ds_overrides, on_tpu,
                flash_block=1024, remat_pol="selective", loss_chunk=0,
-               remat=True):
+               remat=True, flash_block_kv=None,
+               bwd_block_q=None, bwd_block_kv=None):
     import deepspeed_tpu
     from deepspeed_tpu.models import gpt
 
     cfg = gpt.preset(preset, max_seq_len=seq, dtype=jnp.bfloat16,
                      remat=remat, remat_policy=remat_pol,
                      use_flash_attention=on_tpu,
-                     flash_block_q=flash_block, flash_block_kv=flash_block,
+                     flash_block_q=flash_block,
+                     flash_block_kv=flash_block_kv or flash_block,
+                     flash_block_bwd_q=bwd_block_q,
+                     flash_block_bwd_kv=bwd_block_kv,
                      loss_chunk=loss_chunk)
+    if on_tpu:
+        # refuse borderline-HBM compiles — they wedge this backend's
+        # remote compile service (utils/hbm.py, PERF.md incident log)
+        from deepspeed_tpu.utils import hbm as hbm_guard
+        hbm_guard.guard_gpt_config(
+            cfg, batch, seq,
+            precision="bf16" if ds_overrides.get("bf16", {}).get(
+                "enabled", True) else "fp32",
+            memory_efficient=ds_overrides.get("bf16", {}).get(
+                "memory_efficient", False))
     params = gpt.init_params(jax.random.PRNGKey(0), cfg)
     ds_config = {
         "train_batch_size": batch,

@@ -52,6 +52,11 @@ class GPTConfig:
     # kernel clamps to the sequence length for shorter inputs
     flash_block_q: int = 1024
     flash_block_kv: int = 1024
+    # backward-kernel tiles (None = same as forward). The dq/dkv kernels
+    # stream the full opposite operand per block, so their best tile can
+    # differ from the forward's
+    flash_block_bwd_q: Optional[int] = None
+    flash_block_bwd_kv: Optional[int] = None
     tie_embeddings: bool = True
     # tokens per chunk for the fused chunked cross-entropy (0 = off, use
     # the dense log_softmax path). At large vocab×batch×seq the dense path
@@ -266,10 +271,19 @@ def _attention(q, k, v, cfg: GPTConfig, segment_ids=None, kv_mask=None):
     blocks = _flash_blocks(cfg, q.shape[1])
     if blocks is not None:
         from deepspeed_tpu.ops.attention.flash import flash_attention
+        # bwd overrides pass through the same divisibility normalization
+        # as the fwd blocks (a non-dividing block would truncate the
+        # backward grid); fall back to the fwd block when none divides
+        S = q.shape[1]
+        bwd_q = (_effective_block(cfg.flash_block_bwd_q, S)
+                 if cfg.flash_block_bwd_q else None)
+        bwd_kv = (_effective_block(cfg.flash_block_bwd_kv, S)
+                  if cfg.flash_block_bwd_kv else None)
         return flash_attention(q, k, v, causal=True, scale=scale,
                                block_q=blocks[0], block_kv=blocks[1],
                                segment_ids=segment_ids, kv_mask=kv_mask,
-                               window=cfg.attn_window)
+                               window=cfg.attn_window,
+                               bwd_block_q=bwd_q, bwd_block_kv=bwd_kv)
     from deepspeed_tpu.ops.attention.flash import mha_reference
     return mha_reference(q, k, v, causal=True, scale=scale,
                          segment_ids=segment_ids, kv_mask=kv_mask,

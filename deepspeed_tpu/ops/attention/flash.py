@@ -372,6 +372,8 @@ def _flash_bwd(causal, scale, block_q, block_kv, window, res, g):
     group = H // k.shape[1]          # GQA: q heads per kv head
     block_q = min(block_q, S)
     block_kv = min(block_kv, Skv)
+    assert S % block_q == 0 and Skv % block_kv == 0, \
+        (S, Skv, block_q, block_kv)
     num_q = S // block_q
     num_kv = Skv // block_kv
     has_mask = mask is not None
@@ -508,16 +510,16 @@ def _flash_bwd(causal, scale, block_q, block_kv, window, res, g):
 # public op
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
 def _flash(q, k, v, mask, segs, causal, scale, block_q, block_kv,
-           window=None):
+           window=None, bwd_block_q=None, bwd_block_kv=None):
     o, _ = _flash_fwd(q, k, v, mask, segs, causal, scale, block_q, block_kv,
                       window)
     return o
 
 
 def _flash_vjp_fwd(q, k, v, mask, segs, causal, scale, block_q, block_kv,
-                   window=None):
+                   window=None, bwd_block_q=None, bwd_block_kv=None):
     o, lse = _flash_fwd(q, k, v, mask, segs, causal, scale, block_q,
                         block_kv, window)
     # named so a selective remat policy can keep the residuals — without
@@ -533,11 +535,16 @@ def _flash_vjp_fwd(q, k, v, mask, segs, causal, scale, block_q, block_kv,
     return o, (q, k, v, mask, segs, o_res, lse)
 
 
-def _flash_vjp_bwd(causal, scale, block_q, block_kv, window, res, g):
+def _flash_vjp_bwd(causal, scale, block_q, block_kv, window, bwd_block_q,
+                   bwd_block_kv, res, g):
     q, k, v, mask, segs, o_res, lse = res
     B, H, S, D = q.shape
     o = o_res.reshape(B, S, H, D).transpose(0, 2, 1, 3)
-    dq, dk, dv = _flash_bwd(causal, scale, block_q, block_kv, window,
+    # the dq/dkv kernels have different reuse patterns than the forward
+    # (both stream the FULL opposite operand per block) — let callers tune
+    # their tiles independently of the fwd blocks
+    dq, dk, dv = _flash_bwd(causal, scale, bwd_block_q or block_q,
+                            bwd_block_kv or block_kv, window,
                             (q, k, v, mask, segs, o, lse), g)
     return dq, dk, dv, None, None
 
@@ -550,7 +557,9 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     block_q: int = 512, block_kv: int = 512,
                     kv_mask: Optional[jnp.ndarray] = None,
                     segment_ids: Optional[jnp.ndarray] = None,
-                    window: Optional[int] = None) -> jnp.ndarray:
+                    window: Optional[int] = None,
+                    bwd_block_q: Optional[int] = None,
+                    bwd_block_kv: Optional[int] = None) -> jnp.ndarray:
     """Flash attention over [B, S, H, D] tensors.
 
     Head dims that are sublane-aligned (multiple of 8) run unpadded: Mosaic
@@ -608,7 +617,7 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if segment_ids is not None:
         segment_ids = segment_ids.astype(jnp.int32)
     out = _flash(q, k, v, kv_mask, segment_ids, causal, scale,
-                 block_q, block_kv, window)
+                 block_q, block_kv, window, bwd_block_q, bwd_block_kv)
     out = out.transpose(0, 2, 1, 3)
     if Dp != D:
         out = out[..., :D]

@@ -337,6 +337,53 @@ class DeepSpeedEngine:
             f"dp={self.dp_world_size}, tp={self.mp_world_size}, "
             f"micro_bs={config.train_micro_batch_size_per_gpu}, "
             f"gas={config.gradient_accumulation_steps}", ranks=[0])
+        self._warn_hbm_headroom(n_params)
+
+    def _warn_hbm_headroom(self, n_params: int) -> None:
+        """Best-effort warning when the per-device TRAINING STATE alone
+        (params + optimizer moments [+ masters] + a gradient buffer) sits
+        within the compile-headroom of device HBM — borderline-HBM
+        programs put this backend's compiler into a multi-minute fitting
+        grind (see utils/hbm.py and PERF.md). State is the part the
+        engine can compute without knowing the model architecture;
+        activations come on top, so a warning here means near-certain
+        trouble. Never raises: the user may know better."""
+        if (self.offload_enabled or self.config.zero.offload_param.enabled):
+            return  # moments/params live on host — state model doesn't apply
+        from deepspeed_tpu.utils import hbm as hbm_guard
+        try:
+            cap = hbm_guard.device_hbm_bytes(self.mesh.devices.flat[0]
+                                             if self.mesh is not None
+                                             else None)
+        except Exception:
+            cap = None
+        if cap is None:
+            return
+        sb = hbm_guard.state_bytes(
+            n_params, self.config.precision_name,
+            self.config.bf16.memory_efficient,
+            (self.config.optimizer.type or "").lower())
+        # TP shards every tensor over 'model'; ZeRO shards optimizer
+        # (stage>=1), grads (>=2) and params (>=3) over data/fsdp
+        tp = max(1, self.mp_world_size)
+        shards = max(1, self.dp_world_size)
+        pb = 4 if self.config.precision_name == "fp32" else 2
+        state = sb["params"] // tp
+        if self.config.zero.stage >= 3:
+            state //= shards
+        state += sb["optimizer"] // tp // (shards if self.config.zero.stage
+                                           >= 1 else 1)
+        state += n_params * pb // tp // (shards if self.config.zero.stage
+                                         >= 2 else 1)  # gradient buffer
+        limit = cap - int(hbm_guard.DEFAULT_HEADROOM_GIB * hbm_guard.GiB)
+        if state > limit:
+            logger.warning(
+                f"training state alone is ~{state / hbm_guard.GiB:.1f}GiB "
+                f"per device vs {cap / hbm_guard.GiB:.0f}GiB HBM "
+                f"(compile-safe limit {limit / hbm_guard.GiB:.1f}GiB, "
+                f"before activations) — expect OOM or a pathological "
+                f"borderline-HBM compile. Consider zero stage 3 over more "
+                f"devices, bf16.memory_efficient, or offload.")
 
     # ------------------------------------------------------------------
     # configuration

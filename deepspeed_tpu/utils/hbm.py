@@ -1,0 +1,232 @@
+"""Analytic HBM estimator + compile-memory guard.
+
+Why analytic, not XLA cost analysis: on this rig the *compile itself* is
+the hazard — borderline-HBM programs (est. within ~1GB of the 16GB v5e)
+send the remote compile service into a multi-ten-minute memory-fitting
+grind that has twice wedged the whole backend (PERF.md "variants probed
+and REJECTED"). A guard that needs to compile to measure would trigger
+the failure it exists to prevent, so we estimate peak bytes from the
+model/config shape alone and refuse to compile anything too close to
+device HBM.
+
+Reference analog: the autotuner prunes configs by an activation+state
+memory model *before* launching them
+(ref: deepspeed/autotuning/autotuner.py:396 mem-per-GPU pruning;
+ref: deepspeed/runtime/zero/stage3.py memory estimators
+``estimate_zero3_model_states_mem_needs``).
+
+Calibration (measured on the 16GB v5e, PERF.md):
+- gpt2-1.5B b16 full-remat + chunked CE: compiles ~2min, runs (the
+  headline). Estimate must stay SAFE.
+- same + flash_only remat (saves ~2.6GB flash residuals), or b24/b32, or
+  selective remat at b4+ (5.9GB saved acts at b4): compile grind / OOM.
+  Estimates must be REFUSED.
+- gpt2-medium selective b8/b16 + chunked CE: comfortable. SAFE.
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+GiB = 1024 ** 3
+
+# default distance-to-HBM below which we refuse to compile (GiB). The
+# known-good 1.5B headline estimates ~14.4GB on 16GB — refusing anything
+# estimated past (HBM - 1.2GiB) keeps it runnable while rejecting every
+# config that has actually wedged the rig.
+DEFAULT_HEADROOM_GIB = 1.2
+
+# allocator/fragmentation + small-buffer slack added to every estimate
+FUDGE_BYTES = int(0.25 * GiB)
+
+KNOWN_HBM = {  # by device_kind substring (lowercased)
+    "v5 lite": 16 * GiB,
+    "v5e": 16 * GiB,
+    "v5p": 95 * GiB,
+    "v4": 32 * GiB,
+    "v6": 32 * GiB,
+}
+
+
+class MemoryGuardError(RuntimeError):
+    """Raised when a config's estimated peak HBM is too close to device
+    capacity to compile safely."""
+
+
+@dataclass
+class MemoryEstimate:
+    contributions: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def total(self) -> int:
+        return sum(self.contributions.values())
+
+    def summary(self) -> str:
+        parts = ", ".join(f"{k}={v / GiB:.2f}GiB"
+                          for k, v in self.contributions.items())
+        return f"{self.total / GiB:.2f}GiB ({parts})"
+
+
+def _dtype_bytes(precision: str) -> int:
+    return {"bf16": 2, "fp16": 2, "fp32": 4}[precision]
+
+
+def state_bytes(n_params: int, precision: str = "bf16",
+                memory_efficient: bool = False,
+                optimizer: str = "adamw") -> Dict[str, int]:
+    """Persistent training-state bytes: params + optimizer moments
+    [+ fp32 masters]. Shared by the full estimator and the engine's
+    HBM-headroom warning so the two can't drift."""
+    pb = _dtype_bytes(precision)
+    if precision == "fp32":
+        opt = 8 * n_params                       # fp32 m+v
+    elif memory_efficient:
+        opt = 4 * n_params                       # bf16 m+v (SR updates)
+    else:
+        opt = 12 * n_params                      # fp32 master + m + v
+    if optimizer == "adagrad":
+        opt = opt * 2 // 3                       # single moment
+    return {"params": n_params * pb, "optimizer": opt}
+
+
+def estimate_train_bytes(
+    *,
+    n_params: int,
+    n_layers: int,
+    d_model: int,
+    ffn_dim: int,
+    qkv_dim: int,
+    n_heads: int,
+    vocab_size: int,
+    batch: int,
+    seq: int,
+    precision: str = "bf16",
+    memory_efficient: bool = False,
+    remat: bool = True,
+    remat_policy: str = "full",
+    loss_chunk: int = 0,
+    optimizer: str = "adamw",
+) -> MemoryEstimate:
+    """Peak training HBM for one data-parallel shard of a GPT-style model.
+
+    Peak model: persistent state (params + optimizer moments [+ masters])
+    plus max(gradients, live activations) — under reverse-mode scan the
+    gradient buffer fills as the saved activations drain, so they mostly
+    don't coexist at full size — plus the loss-path working set and an
+    allocator fudge.
+
+    Activation widths (units of d_model per token per layer, bf16) by
+    remat policy, counted from what each policy saves for backward:
+    - none:       ln1+ln2 (2) + qkv + flash o (1) + attn out (1) +
+                  gelu in+out (2*ffn/d) + mlp out (1)
+    - selective:  qkv + flash o (1) + gelu in (ffn/d) + mlp out (1)
+                  [measured 9.38*d at 1.5B — PERF.md b4-selective 5.9GB]
+    - full:       layer-boundary hidden only (1)
+    - flash_only: boundary (1) + packed flash o residual (1)
+                  [measured +2.6GB at 1.5B b16 — PERF.md]
+    full/flash_only additionally pay ONE layer's un-rematted working set
+    (transient, not *L) during the per-layer recompute.
+    """
+    est = MemoryEstimate()
+    pb = _dtype_bytes(precision)
+
+    # --- persistent training state -----------------------------------
+    est.contributions.update(state_bytes(n_params, precision,
+                                         memory_efficient, optimizer))
+
+    grad_bytes = n_params * pb                   # accumulator or transient
+
+    # --- activations --------------------------------------------------
+    tokens = batch * seq
+    ffn_w = ffn_dim / d_model
+    qkv_w = qkv_dim / d_model
+    none_width = 2 + qkv_w + 1 + 1 + 2 * ffn_w + 1
+    if not remat:
+        width, transient = none_width, 0.0
+    elif remat_policy == "selective":
+        width, transient = qkv_w + 1 + ffn_w + 1, 0.0
+    elif remat_policy == "flash_only":
+        width, transient = 2.0, none_width
+    else:                                        # 'full'
+        width, transient = 1.0, none_width
+    act_bytes = int(tokens * n_layers * width * d_model * 2)
+    act_bytes += int(tokens * transient * d_model * 2)   # one-layer recompute
+    act_bytes += tokens * n_layers * n_heads * 4         # flash lse (fp32)
+    # grads fill while saved activations drain: peak is the larger one
+    est.contributions["grads_or_acts"] = max(grad_bytes, act_bytes)
+
+    # --- loss path ----------------------------------------------------
+    if loss_chunk:
+        # chunked CE: fp32 chunk logits + softmax + bwd residual
+        est.contributions["loss"] = loss_chunk * vocab_size * 12
+    else:
+        # dense: fp32 logits + log-probs
+        est.contributions["loss"] = tokens * vocab_size * 8
+
+    est.contributions["fudge"] = FUDGE_BYTES
+    return est
+
+
+def estimate_gpt_train_bytes(cfg, batch: int, seq: Optional[int] = None,
+                             **kw) -> MemoryEstimate:
+    """Convenience wrapper mapping a models.gpt.GPTConfig."""
+    from deepspeed_tpu.models import gpt
+    return estimate_train_bytes(
+        n_params=gpt.num_params(cfg), n_layers=cfg.n_layers,
+        d_model=cfg.d_model, ffn_dim=cfg.ffn_dim, qkv_dim=cfg.qkv_dim,
+        n_heads=cfg.n_heads, vocab_size=cfg.vocab_size,
+        batch=batch, seq=seq or cfg.max_seq_len,
+        remat=cfg.remat, remat_policy=cfg.remat_policy,
+        loss_chunk=cfg.loss_chunk, **kw)
+
+
+def device_hbm_bytes(device: Any = None) -> Optional[int]:
+    """Device HBM capacity, via memory_stats when the backend exposes it,
+    else the known-capacity table. None for CPU/unknown (no guard)."""
+    if device is None:
+        import jax
+        devices = jax.devices()
+        if not devices:
+            return None
+        device = devices[0]
+    if device.platform == "cpu":
+        return None
+    try:
+        stats = device.memory_stats() or {}
+        if stats.get("bytes_limit"):
+            return int(stats["bytes_limit"])
+    except Exception:
+        pass
+    kind = (device.device_kind or "").lower()
+    for k, v in KNOWN_HBM.items():
+        if k in kind:
+            return v
+    return None
+
+
+def check_compile_safe(est: MemoryEstimate, hbm_bytes: Optional[int],
+                       headroom_gib: float = DEFAULT_HEADROOM_GIB):
+    """Returns (ok, message). ok=True when the estimate clears the
+    headroom or HBM capacity is unknown (nothing to guard against)."""
+    if hbm_bytes is None:
+        return True, "device HBM unknown — guard inactive"
+    limit = hbm_bytes - int(headroom_gib * GiB)
+    msg = (f"estimated peak {est.total / GiB:.2f}GiB vs limit "
+           f"{limit / GiB:.2f}GiB (HBM {hbm_bytes / GiB:.0f}GiB - "
+           f"{headroom_gib}GiB compile headroom): {est.summary()}")
+    return est.total <= limit, msg
+
+
+def guard_gpt_config(cfg, batch: int, seq: Optional[int] = None,
+                     device: Any = None,
+                     headroom_gib: float = DEFAULT_HEADROOM_GIB,
+                     **estimate_kw) -> str:
+    """Raise MemoryGuardError if compiling this training config risks the
+    borderline-HBM compile grind; returns the decision message otherwise."""
+    est = estimate_gpt_train_bytes(cfg, batch, seq, **estimate_kw)
+    ok, msg = check_compile_safe(est, device_hbm_bytes(device), headroom_gib)
+    if not ok:
+        raise MemoryGuardError(
+            f"refusing to compile: {msg}. Borderline-HBM compiles wedge "
+            f"this backend (PERF.md); shrink batch/model or use "
+            f"remat_policy='full' + loss_chunk.")
+    return msg

@@ -246,3 +246,23 @@ def test_window_gqa_segments_compose(devices):
                           segment_ids=segs)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-3, atol=2e-3)
+
+
+def test_bwd_block_override_parity(devices):
+    """Separate backward tiles (bwd_block_q/kv != fwd blocks) must not
+    change gradients — only the dq/dkv kernel tiling."""
+    q, k, v = _rand_qkv(S=512)
+
+    def loss(fn):
+        return lambda q, k, v: (fn(q, k, v) ** 2).sum()
+
+    base = functools.partial(F.flash_attention, causal=True,
+                             block_q=256, block_kv=256)
+    tuned = functools.partial(F.flash_attention, causal=True,
+                              block_q=256, block_kv=256,
+                              bwd_block_q=128, bwd_block_kv=128)
+    g0 = jax.grad(loss(base), argnums=(0, 1, 2))(q, k, v)
+    g1 = jax.grad(loss(tuned), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g0, g1):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)

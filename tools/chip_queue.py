@@ -26,8 +26,13 @@ QUEUE = [
     # compile/parity-check the new flash kernel features through the REAL
     # Mosaic lowering before any measurement relies on them
     ("flash-smoke", [sys.executable, "tools/flash_chip_smoke.py"], 1800),
+    # variants pass the analytic memory guard inside headline_probe —
+    # unsafe configs (the rig-wedging borderline-HBM compiles) are
+    # skipped with a JSON line, never attempted
+    # outer budget covers 7 variants x the probe's 2400s per-config cap
     ("probe", [sys.executable, "tools/headline_probe.py",
-               "med-b8-noremat", "med-b16-noremat", "med-b16-ce"], 7400),
+               "b16-full-ce", "b20-full-ce", "b16-bwd512", "b16-bwdq512",
+               "b16-bwdkv512", "med-b8-noremat", "med-b16-ce"], 17000),
     ("trace-1.5b", [sys.executable, "tools/trace_analyze.py", "run",
                     "gpt2-1.5b", "16", "full", "2048"], 1500),
     # outer budgets cover each tool's own per-config 1500s timeouts
@@ -58,7 +63,8 @@ def main():
                 break
             print(json.dumps({"item": name, "unhealthy_attempt": attempt}),
                   flush=True)
-            time.sleep(120)
+            if attempt < 3:          # no point sleeping after the last probe
+                time.sleep(120)
         else:
             print(json.dumps({"item": name, "skipped": "chip unhealthy"}),
                   flush=True)

@@ -4,28 +4,62 @@ A thin wrapper over ``bench.run_config`` (same engine path, warmup,
 per-step-synced median timing and MFU accounting as the driver bench)
 run once per variant in a fresh subprocess (the rig's remote compile
 helper can 500 on repeat compiles in one process). Prints one JSON line
-per variant. Usage: python tools/headline_probe.py [variant ...]
+per variant.
+
+Every variant passes through the analytic compile-memory guard
+(deepspeed_tpu/utils/hbm.py) BEFORE any backend contact: borderline-HBM
+compiles wedge this rig's remote compile service (PERF.md incident log),
+so unsafe variants are skipped with an explanatory JSON line instead of
+being attempted. Reference analog: the autotuner prunes configs by
+memory model before running them (ref: autotuning/autotuner.py:396).
+
+Usage: python tools/headline_probe.py [variant ...]
 """
 
+import json
 import sys
 
 sys.path.insert(0, ".")
 
 from tools._subproc import run_json  # noqa: E402
 
-# name: (preset, batch, remat(True/False), remat_policy, loss_chunk, stage,
-#        memory_efficient)
+_D = dict(preset="gpt2-1.5b", batch=16, remat=True, pol="full",
+          lc=2048, stage=3, me=True, fb=1024, fbkv=None,
+          bwdq=None, bwdkv=None)
+
+
+def _v(**kw):
+    d = dict(_D)
+    d.update(kw)
+    return d
+
+
 VARIANTS = {
-    "b16-full": ("gpt2-1.5b", 16, True, "full", 0, 3, True),
-    "b16-full-ce": ("gpt2-1.5b", 16, True, "full", 2048, 3, True),
-    "b16-flashonly-ce": ("gpt2-1.5b", 16, True, "flash_only", 2048, 3, True),
-    "b24-full-ce": ("gpt2-1.5b", 24, True, "full", 2048, 3, True),
-    "b32-full-ce": ("gpt2-1.5b", 32, True, "full", 2048, 3, True),
-    "b16-sel-ce": ("gpt2-1.5b", 16, True, "selective", 2048, 3, True),
-    "med-b8": ("gpt2-medium", 8, True, "selective", 0, 1, False),
-    "med-b8-noremat": ("gpt2-medium", 8, False, "selective", 2048, 1, False),
-    "med-b16-noremat": ("gpt2-medium", 16, False, "selective", 2048, 1, False),
-    "med-b16-ce": ("gpt2-medium", 16, True, "selective", 2048, 1, False),
+    # --- 1.5B headline family ---------------------------------------
+    "b16-full": _v(lc=0),
+    "b16-full-ce": _v(),
+    "b16-flashonly-ce": _v(pol="flash_only"),   # guard: refused (grind)
+    "b20-full-ce": _v(batch=20),
+    "b24-full-ce": _v(batch=24),                # guard: refused
+    "b32-full-ce": _v(batch=32),                # guard: refused
+    "b16-sel-ce": _v(pol="selective"),          # guard: refused
+    # backward-tile tuning at the headline config (fwd stays 1024)
+    "b16-bwd512": _v(bwdq=512, bwdkv=512),
+    "b16-bwdq512": _v(bwdq=512),
+    "b16-bwdkv512": _v(bwdkv=512),
+    "b16-bwd256": _v(bwdq=256, bwdkv=256),
+    # fwd-tile asymmetry
+    "b16-fbq512": _v(fb=512, fbkv=1024),
+    "b16-fbkv512": _v(fb=1024, fbkv=512),
+    # --- medium secondary family ------------------------------------
+    "med-b8": _v(preset="gpt2-medium", batch=8, pol="selective", lc=0,
+                 stage=1, me=False),
+    "med-b8-noremat": _v(preset="gpt2-medium", batch=8, remat=False,
+                         pol="selective", stage=1, me=False),
+    "med-b16-noremat": _v(preset="gpt2-medium", batch=16, remat=False,
+                          pol="selective", stage=1, me=False),  # refused
+    "med-b16-ce": _v(preset="gpt2-medium", batch=16, pol="selective",
+                     stage=1, me=False),
 }
 
 CODE = """
@@ -33,25 +67,49 @@ import sys, json
 sys.path.insert(0, '.')
 from bench import run_config, MFU_BAR
 
-preset, batch, remat, pol, lc, stage, me = {spec!r}
-overrides = {{"zero_optimization": {{"stage": stage}}}}
-if me:
+s = {spec!r}
+overrides = {{"zero_optimization": {{"stage": s["stage"]}}}}
+if s["me"]:
     overrides["bf16"] = {{"enabled": True, "memory_efficient": True}}
-dt, tps, mfu = run_config(preset, batch, 1024, 8, overrides, True,
-                          flash_block=1024, remat_pol=pol, loss_chunk=lc,
-                          remat=remat)
-print(json.dumps({{"variant": {name!r}, "preset": preset, "batch": batch,
-    "remat": (pol if remat else "none"), "loss_chunk": lc,
+dt, tps, mfu = run_config(s["preset"], s["batch"], 1024, 8, overrides, True,
+                          flash_block=s["fb"], flash_block_kv=s["fbkv"],
+                          remat_pol=s["pol"], loss_chunk=s["lc"],
+                          remat=s["remat"], bwd_block_q=s["bwdq"],
+                          bwd_block_kv=s["bwdkv"])
+print(json.dumps({{"variant": {name!r}, "preset": s["preset"],
+    "batch": s["batch"], "remat": (s["pol"] if s["remat"] else "none"),
+    "loss_chunk": s["lc"], "bwd_blocks": [s["bwdq"], s["bwdkv"]],
+    "fwd_blocks": [s["fb"], s["fbkv"] or s["fb"]],
     "step_ms": round(dt*1e3, 1), "tokens_per_s": round(tps, 1),
     "mfu": round(mfu, 4), "vs_bar": round(mfu/MFU_BAR, 3)}}))
 """
 
 
+def guard_variant(name, s, hbm_gib=16):
+    """Analytic safety decision — NO backend contact (a wedged tunnel
+    hangs jax.devices(); the v5e capacity is known)."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.models import gpt
+    from deepspeed_tpu.utils import hbm
+    cfg = gpt.preset(s["preset"], max_seq_len=1024, dtype=jnp.bfloat16,
+                     remat=s["remat"], remat_policy=s["pol"],
+                     loss_chunk=s["lc"])
+    est = hbm.estimate_gpt_train_bytes(
+        cfg, s["batch"], 1024, memory_efficient=s["me"],
+        precision="bf16")
+    return hbm.check_compile_safe(est, hbm_gib * hbm.GiB)
+
+
 def main():
     names = sys.argv[1:] or list(VARIANTS)
     for n in names:
-        run_json([sys.executable, "-c",
-                  CODE.format(spec=VARIANTS[n], name=n)],
+        spec = VARIANTS[n]
+        ok, msg = guard_variant(n, spec)
+        if not ok:
+            print(json.dumps({"variant": n, "skipped": "memory guard",
+                              "why": msg}), flush=True)
+            continue
+        run_json([sys.executable, "-c", CODE.format(spec=spec, name=n)],
                  2400, {"variant": n})
 
 

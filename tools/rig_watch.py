@@ -1,0 +1,67 @@
+"""Background rig watcher: poll the TPU tunnel until it recovers, then
+fire the phase-1 on-chip measurement queue once and exit.
+
+The round-2 outage (STATUS.md) showed a wedged tunnel can eat a whole
+round: every recovery minute matters, and a human (or the main build
+session) shouldn't have to poll. Run this with output redirected to a
+log; it exits 0 after the queue completes, 2 on deadline with the rig
+still down — either way the exit itself is the notification.
+
+Usage: python tools/rig_watch.py [--deadline-hours H] [item ...]
+Items are chip_queue names; default is the phase-1 set (smoke + probes +
+trace) — fast enough to leave chip time for targeted follow-ups.
+"""
+
+import argparse
+import json
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from tools.chip_queue import healthy  # noqa: E402
+
+PHASE1 = ["flash-smoke", "probe", "trace-1.5b"]
+POLL_S = 300          # probe cadence while down
+CONFIRM_S = 45        # gap between the two confirming probes
+
+
+def log(**kw):
+    print(json.dumps({"t": round(time.time()), **kw}), flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--deadline-hours", type=float, default=10.0)
+    ap.add_argument("items", nargs="*", default=None)
+    args = ap.parse_args()
+    items = args.items or PHASE1
+    deadline = time.time() + args.deadline_hours * 3600
+
+    n = 0
+    while time.time() < deadline:
+        n += 1
+        if healthy(timeout=150):
+            # require a second green probe: the tunnel flaps on the way
+            # back up, and a half-recovered backend wedges mid-queue
+            time.sleep(CONFIRM_S)
+            if healthy(timeout=150):
+                log(event="rig healthy", probes=n)
+                break
+            log(event="flapped", probes=n)
+        else:
+            log(event="still down", probes=n)
+        time.sleep(POLL_S)
+    else:
+        log(event="deadline, rig never recovered", probes=n)
+        sys.exit(2)
+
+    t0 = time.time()
+    log(event="queue start", items=items)
+    r = subprocess.run([sys.executable, "tools/chip_queue.py"] + items)
+    log(event="queue done", rc=r.returncode, minutes=round((time.time() - t0) / 60, 1))
+
+
+if __name__ == "__main__":
+    main()
