@@ -463,7 +463,15 @@ class InferenceEngine:
         # and splits per step, so sampled outputs match token-for-token
         args = (self.params, cache, first, jnp.asarray(S, jnp.int32),
                 row_len, rng)
-        key = ("gen", n_steps, temperature, top_k, row_len is not None)
+        # the compiled executable is shape-specialized: key on the abstract
+        # shapes/dtypes of every traced arg (batch size, cache length, ...)
+        # or a later call with a different batch hits a stale executable
+        # and fails with an aval mismatch instead of recompiling
+        avals = jax.tree_util.tree_map(
+            lambda x: (x.shape, str(x.dtype)) if hasattr(x, "shape") else x,
+            (cache, first, row_len))
+        key = ("gen", n_steps, temperature, top_k,
+               jax.tree_util.tree_structure(avals), str(avals))
         if not hasattr(self, "_gen_cache"):
             self._gen_cache = {}
         if key not in self._gen_cache:

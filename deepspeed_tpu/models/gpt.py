@@ -482,6 +482,15 @@ def loss_fn(params: Dict, batch: Dict, rng: jax.Array, cfg: GPTConfig,
         segs = None if segs is None else segs[:, :-1]
         poss = None if poss is None else poss[:, :-1]
     mask = batch.get("loss_mask")
+    if mask is not None and mask.shape[-1] != targets.shape[-1]:
+        # pack_documents emits a (S-1)-wide mask aligned with the
+        # implicit-targets slice above; pairing it with an explicit
+        # seq-wide "targets" key would silently misalign mask/segments
+        raise ValueError(
+            f"loss_mask width {mask.shape[-1]} != target width "
+            f"{targets.shape[-1]} — packed batches (loss_mask/segment_ids "
+            f"from pack_documents) must not carry an explicit 'targets' "
+            f"key; let loss_fn derive next-token targets")
     if cfg.loss_chunk:
         # fused vocab-projection + loss: never materializes [B, S, V]
         # (ops/cross_entropy.py — frees ~3GB+ at GPT-2-1.5B scale)
