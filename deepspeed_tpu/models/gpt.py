@@ -248,10 +248,13 @@ def _attention(q, k, v, cfg: GPTConfig, segment_ids=None, kv_mask=None):
     scale = cfg.attn_scale  # None -> kernels default to 1/sqrt(Dh)
     if (segment_ids is not None or kv_mask is not None
             or cfg.attn_window is not None) \
-            and cfg.sequence_parallel and cfg.mesh is not None:
+            and cfg.sequence_parallel and cfg.mesh is not None \
+            and cfg.sp_impl != "ulysses":
         raise NotImplementedError(
-            "segment_ids / kv_mask / attn_window + sequence parallelism is "
-            "not supported; disable one of the two")
+            "segment_ids / kv_mask / attn_window + RING sequence "
+            "parallelism is not supported (rotating K/V blocks never "
+            "co-reside with the full row) — use sp_impl='ulysses', whose "
+            "head-sharded layout keeps full rows local")
     if cfg.sequence_parallel and cfg.mesh is not None:
         # GQA works under both SP impls: ring rotates the small grouped
         # k/v; Ulysses needs the sp degree to divide both head counts
@@ -262,7 +265,9 @@ def _attention(q, k, v, cfg: GPTConfig, segment_ids=None, kv_mask=None):
                 q, k, v, cfg.mesh, causal=True, scale=scale,
                 use_flash=blocks is not None,
                 block_q=blocks[0] if blocks else cfg.flash_block_q,
-                block_kv=blocks[1] if blocks else cfg.flash_block_kv)
+                block_kv=blocks[1] if blocks else cfg.flash_block_kv,
+                segment_ids=segment_ids, kv_mask=kv_mask,
+                window=cfg.attn_window)
         if cfg.sp_impl != "ring":
             raise ValueError(f"unknown sp_impl {cfg.sp_impl!r} "
                              "(expected 'ring' or 'ulysses')")

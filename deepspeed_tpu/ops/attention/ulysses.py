@@ -32,10 +32,12 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def _ulysses_local(q, k, v, *, axis: str, causal: bool, scale: float,
-                   use_flash: bool, block_q: int, block_kv: int):
+def _ulysses_local(q, k, v, segs, mask, *, axis: str, causal: bool,
+                   scale: float, use_flash: bool, block_q: int,
+                   block_kv: int, window: Optional[int],
+                   bwd_block_q: Optional[int], bwd_block_kv: Optional[int]):
     """Inside shard_map: q local [B, S_loc, H, D]; k/v may carry Hkv < H
-    heads (GQA) -> out [B, S_loc, H, D]."""
+    heads (GQA) -> out [B, S_loc, H, D]. segs/mask: [B, S_loc] or None."""
     sp = jax.lax.axis_size(axis)
     B, S_loc, H, D = q.shape
     Hkv = k.shape[2]
@@ -54,14 +56,27 @@ def _ulysses_local(q, k, v, *, axis: str, causal: bool, scale: float,
                                   tiled=True)
 
     qh, kh, vh = seq2head(q), seq2head(k), seq2head(v)
+    # per-token metadata (packed segment ids, kv validity) must cover the
+    # FULL sequence the local kernel now sees — an all-gather of [B, S]
+    # ints is noise next to the qkv all-to-alls (reference capability
+    # analog: block-sparse long-seq, ref ops/sparse_attention/matmul.py)
+    full_segs = (None if segs is None else
+                 jax.lax.all_gather(segs, axis, axis=1, tiled=True))
+    full_mask = (None if mask is None else
+                 jax.lax.all_gather(mask, axis, axis=1, tiled=True))
 
     if use_flash:
         from deepspeed_tpu.ops.attention.flash import flash_attention
         out = flash_attention(qh, kh, vh, causal=causal, scale=scale,
-                              block_q=block_q, block_kv=block_kv)
+                              block_q=block_q, block_kv=block_kv,
+                              segment_ids=full_segs, kv_mask=full_mask,
+                              window=window, bwd_block_q=bwd_block_q,
+                              bwd_block_kv=bwd_block_kv)
     else:
         from deepspeed_tpu.ops.attention.flash import mha_reference
-        out = mha_reference(qh, kh, vh, causal=causal, scale=scale)
+        out = mha_reference(qh, kh, vh, causal=causal, scale=scale,
+                            segment_ids=full_segs, kv_mask=full_mask,
+                            window=window)
 
     return head2seq(out)
 
@@ -72,20 +87,39 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                       axis: str = "sequence",
                       use_flash: bool = False,
                       block_q: int = 512,
-                      block_kv: int = 512) -> jnp.ndarray:
+                      block_kv: int = 512,
+                      segment_ids: Optional[jnp.ndarray] = None,
+                      kv_mask: Optional[jnp.ndarray] = None,
+                      window: Optional[int] = None,
+                      bwd_block_q: Optional[int] = None,
+                      bwd_block_kv: Optional[int] = None) -> jnp.ndarray:
     """Exact (causal) attention with the sequence dim sharded over ``axis``
     via head<->sequence all-to-alls. q,k,v: [B, S, H, D] global arrays.
+
+    Packed sequences (segment_ids), key-validity masks (kv_mask) and
+    sliding windows compose with the sequence sharding: heads stay whole
+    per rank, so after the seq->head all-to-all the local flash kernel
+    sees full rows and applies the masks exactly as in the unsharded
+    case (ring SP cannot do this — its K/V blocks never co-reside).
     """
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
     inner = partial(_ulysses_local, axis=axis, causal=causal, scale=scale,
-                    use_flash=use_flash, block_q=block_q, block_kv=block_kv)
+                    use_flash=use_flash, block_q=block_q, block_kv=block_kv,
+                    window=window, bwd_block_q=bwd_block_q,
+                    bwd_block_kv=bwd_block_kv)
     spec = P(None, axis, None, None)
+    tok_spec = P(None, axis)
+    args = [q, k, v]
+    in_specs = [spec, spec, spec]
+    for extra in (segment_ids, kv_mask):
+        args.append(extra)
+        in_specs.append(None if extra is None else tok_spec)
     mapped = jax.shard_map(
         inner, mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=tuple(in_specs),
         out_specs=spec,
         axis_names={axis},
         check_vma=False)
     # same eager-canonicalization workaround as ring_attention
-    return jax.jit(mapped)(q, k, v)
+    return jax.jit(mapped)(*args)
