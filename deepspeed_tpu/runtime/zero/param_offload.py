@@ -103,11 +103,28 @@ class InfinityParamEngine:
         self.layered = layered
         self.config = config
         self.lr_schedule = lr_schedule
-        if config.fp16.enabled:
-            raise NotImplementedError(
-                "param offload runs bf16 (fp16 loss-scaling would need "
-                "host-side overflow checks before every update)")
-        self.compute_dtype = jnp.bfloat16
+        # fp16 runs the reference's loss-scaled scheme host-side: the
+        # backward seed is scaled on device, the per-group grad pulls
+        # land scaled fp32 on host, and the update phase unscales + folds
+        # the overflow check into the global-norm pass it already does
+        # (a non-finite norm IS the overflow signal — no extra sweep).
+        # (ref: partitioned_param_swapper.py:37 stages fp16 partitions;
+        #  ref runtime/fp16/loss_scaler.py DynamicLossScaler semantics)
+        self.fp16 = bool(config.fp16.enabled)
+        self.compute_dtype = jnp.float16 if self.fp16 else jnp.bfloat16
+        fp = config.fp16
+        if self.fp16 and fp.loss_scale == 0:          # dynamic
+            self.cur_scale = 2.0 ** fp.initial_scale_power
+            self._dynamic_scale = True
+        else:
+            self.cur_scale = fp.loss_scale if self.fp16 else 1.0
+            self._dynamic_scale = False
+        self.scale_window = fp.loss_scale_window
+        self.min_scale = fp.min_loss_scale
+        self._hyst_left = fp.hysteresis
+        self._hysteresis = fp.hysteresis
+        self._good_steps = 0
+        self.skipped_steps = 0
         self.clip = config.gradient_clipping
         self.gas = config.gradient_accumulation_steps
 
@@ -184,7 +201,7 @@ class InfinityParamEngine:
             self.master.append([np.ascontiguousarray(a.ravel())
                                 for a in stacked])
             self.host_bf16.append(
-                [m.astype(jnp.bfloat16.dtype).reshape(s)
+                [self._host_compute(m, s)
                  for m, s in zip(self.master[-1], self.shapes[-1])])
             self.staging.append(
                 [np.empty(m.size, np.uint16) for m in self.master[-1]])
@@ -256,11 +273,13 @@ class InfinityParamEngine:
             dgp, dx = vjp(dy)
             return dx, dgp
 
-        def head_grad(other, x, aux):
+        def head_grad(other, x, aux, scale):
+            # `scale` seeds the backward with the fp16 loss scale (1.0
+            # for bf16) — every downstream group grad arrives pre-scaled
             def f(o, xx):
                 return head_fn(o, xx, aux)
             loss, vjp = jax.vjp(f, other, x)
-            dother, dx = vjp(jnp.ones_like(loss))
+            dother, dx = vjp(jnp.ones_like(loss) * scale.astype(loss.dtype))
             return loss, dx, dother
 
         def embed_grad(other, batch, dx0):
@@ -280,6 +299,11 @@ class InfinityParamEngine:
     # ------------------------------------------------------------------
     # host <-> device staging
     # ------------------------------------------------------------------
+    def _host_compute(self, m: np.ndarray, s: tuple) -> np.ndarray:
+        """fp32 master -> host copy in the device compute dtype."""
+        dt = np.float16 if self.fp16 else jnp.bfloat16.dtype
+        return m.astype(dt).reshape(s)
+
     def _other_to_device(self) -> PyTree:
         leaves = [jnp.asarray(m.reshape(s), jnp.float32)
                   .astype(self.compute_dtype)
@@ -329,7 +353,8 @@ class InfinityParamEngine:
             cur = nxt
             nxt = self._group_to_device(gi + 2) if gi + 2 < G else None
 
-        loss, dx, dother = self._j_head_grad(self.other_dev, x, aux)
+        loss, dx, dother = self._j_head_grad(
+            self.other_dev, x, aux, jnp.float32(self.cur_scale))
 
         # backward, reverse streaming
         pulls = []
@@ -363,20 +388,46 @@ class InfinityParamEngine:
     # ------------------------------------------------------------------
     def _apply_update(self):
         lr = float(self.lr_schedule(self.step_count))
-        self.step_count += 1
-        inv_gas = 1.0 / self.gas
+        # unscale (fp16 loss scale; 1.0 under bf16) + grad-accum mean in
+        # the same host pass that squares for the global norm
+        inv = (1.0 / self.gas) / self.cur_scale
 
         sq = 0.0
         for gi in range(self.n_groups):
             for g in self.grad_acc[gi]:
-                if inv_gas != 1.0:
-                    g *= inv_gas
+                if inv != 1.0:
+                    g *= inv
                 sq += float(g @ g)
         for g in self.other_grad_acc:
-            if inv_gas != 1.0:
-                g *= inv_gas
+            if inv != 1.0:
+                g *= inv
             sq += float(g @ g)
-        gnorm = math.sqrt(sq)
+        gnorm = math.sqrt(sq) if sq >= 0.0 else float("nan")
+        if not math.isfinite(gnorm):
+            # overflow: drop the step and back the scale off — the
+            # non-finite global norm IS the overflow check, no extra
+            # sweep over the grads (ref DynamicLossScaler.update_scale)
+            for gi in range(self.n_groups):
+                self.grad_acc[gi] = None
+            self.other_grad_acc = None
+            self.skipped_steps += 1
+            if self._dynamic_scale:
+                self._hyst_left -= 1
+                if self._hyst_left <= 0:
+                    self.cur_scale = max(self.cur_scale / 2.0,
+                                         self.min_scale)
+                    self._hyst_left = self._hysteresis
+                self._good_steps = 0
+                log_dist(f"fp16 overflow, loss scale -> "
+                         f"{self.cur_scale:.0f}", ranks=[0])
+            return gnorm, lr, True
+        self.step_count += 1
+        if self.fp16 and self._dynamic_scale:
+            self._good_steps += 1
+            if self._good_steps >= self.scale_window:
+                self.cur_scale *= 2.0
+                self._good_steps = 0
+                self._hyst_left = self._hysteresis
         scale = 1.0
         if self.clip > 0.0 and gnorm > self.clip:
             scale = self.clip / (gnorm + 1e-6)
@@ -401,11 +452,18 @@ class InfinityParamEngine:
                 if scale != 1.0:
                     g *= scale
                 self.adam.step(f"{key}.{j}", mst, g, lr=lr,
-                               params_bf16_out=stg)
-            for j, (stg, s) in enumerate(zip(self.staging[gi],
-                                             self.shapes[gi])):
-                self.host_bf16[gi][j] = stg.view(jnp.bfloat16.dtype) \
-                    .reshape(s).copy()
+                               params_bf16_out=None if self.fp16 else stg)
+            if self.fp16:
+                # no fused fp16 copy-back in the AVX kernel — one extra
+                # host pass converts the stepped master to fp16
+                for j, (mst, s) in enumerate(zip(master_leaves,
+                                                 self.shapes[gi])):
+                    self.host_bf16[gi][j] = self._host_compute(mst, s)
+            else:
+                for j, (stg, s) in enumerate(zip(self.staging[gi],
+                                                 self.shapes[gi])):
+                    self.host_bf16[gi][j] = stg.view(jnp.bfloat16.dtype) \
+                        .reshape(s).copy()
             if self.swapper is not None:
                 ms, vs = [], []
                 for j in range(len(master_leaves)):
@@ -425,14 +483,19 @@ class InfinityParamEngine:
             if scale != 1.0:
                 g *= scale
             self.adam.step(f"other.{j}", mst, g, lr=lr,
-                           params_bf16_out=stg)
+                           params_bf16_out=None if self.fp16 else stg)
         self.other_grad_acc = None
-        leaves = [s.view(jnp.bfloat16.dtype).reshape(shape)
-                  for s, shape in zip(self.other_staging,
-                                      self.other_shapes)]
+        if self.fp16:
+            leaves = [self._host_compute(m, shape)
+                      for m, shape in zip(self.other_master,
+                                          self.other_shapes)]
+        else:
+            leaves = [s.view(jnp.bfloat16.dtype).reshape(shape)
+                      for s, shape in zip(self.other_staging,
+                                          self.other_shapes)]
         self.other_dev = jax.device_put(
             jax.tree_util.tree_unflatten(self.other_treedef, leaves))
-        return gnorm, lr
+        return gnorm, lr, False
 
     # ------------------------------------------------------------------
     # public API
@@ -454,10 +517,10 @@ class InfinityParamEngine:
             loss = float(np.mean([float(l) for l in losses]))
         else:
             loss = float(self._micro_step(batch))
-        gnorm, lr = self._apply_update()
+        gnorm, lr, overflow = self._apply_update()
         self.global_steps += 1
         return {"loss": loss, "grad_norm": gnorm, "lr": lr,
-                "overflow": False,
+                "overflow": overflow, "loss_scale": self.cur_scale,
                 "step_time_s": time.perf_counter() - t0}
 
     def device_memory_bytes(self) -> int:
@@ -478,7 +541,7 @@ class InfinityParamEngine:
         block = jax.tree_util.tree_unflatten(self.block_treedef, stacked)
         other = jax.tree_util.tree_unflatten(
             self.other_treedef,
-            [m.astype(jnp.bfloat16.dtype).reshape(s)
+            [self._host_compute(m, s)
              for m, s in zip(self.other_master, self.other_shapes)])
         if self.layered.join_params is not None:
             return self.layered.join_params(block, other)
@@ -510,15 +573,26 @@ class InfinityParamEngine:
         return {"step": self.step_count,
                 "master": [list(m) for m in self.master],
                 "other_master": list(self.other_master),
-                "adam": states}
+                "adam": states,
+                "loss_scaler": {"cur_scale": self.cur_scale,
+                                "good_steps": self._good_steps,
+                                "hyst_left": self._hyst_left,
+                                "skipped": self.skipped_steps}}
 
     def load_state_dict(self, sd: Dict):
         self.step_count = int(sd["step"])
+        scaler = sd.get("loss_scaler")
+        if scaler is not None:
+            self.cur_scale = (float(scaler["cur_scale"]) if self.fp16
+                              else 1.0)
+            self._good_steps = int(scaler["good_steps"])
+            self._hyst_left = int(scaler["hyst_left"])
+            self.skipped_steps = int(scaler.get("skipped", 0))
         for gi, flat in enumerate(sd["master"]):
             self.master[gi] = [np.ascontiguousarray(f, np.float32)
                                for f in flat]
             self.host_bf16[gi] = [
-                f.astype(jnp.bfloat16.dtype).reshape(s)
+                self._host_compute(f, s)
                 for f, s in zip(self.master[gi], self.shapes[gi])]
         self.other_master = [np.ascontiguousarray(f, np.float32)
                              for f in sd["other_master"]]
