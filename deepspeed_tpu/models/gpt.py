@@ -395,11 +395,28 @@ def forward(params: Dict, tokens: jnp.ndarray, cfg: GPTConfig,
                    "sequence" if cfg.sequence_parallel else None, None)
 
     def _pin(t):
-        from jax.sharding import get_abstract_mesh
+        from jax.sharding import AxisType, get_abstract_mesh
         m = get_abstract_mesh()
         if m is None or m.empty or not {"data", "fsdp"} <= set(m.axis_names):
             return t  # no engine mesh in context (e.g. raw single-device)
-        return jax.lax.with_sharding_constraint(t, carry_spec)
+        # inside a shard_map region (e.g. the compressed-collective wire
+        # path maps the loss over 'data') manual axes are already local —
+        # a constraint naming them is both meaningless and rejected
+        manual = {n for n, ty in zip(m.axis_names, m.axis_types)
+                  if ty == AxisType.Manual}
+        if not manual:
+            return jax.lax.with_sharding_constraint(t, carry_spec)
+
+        def keep(entry):
+            if entry is None:
+                return None
+            names = entry if isinstance(entry, tuple) else (entry,)
+            left = tuple(n for n in names if n not in manual)
+            return left if left else None
+        spec = P(*(keep(e) for e in carry_spec))
+        if all(e is None for e in spec):
+            return t
+        return jax.lax.with_sharding_constraint(t, spec)
 
     def body(carry, scanned):
         layer, lidx = scanned
