@@ -43,6 +43,19 @@ from deepspeed_tpu.utils.logging import logger
 
 PyTree = Any
 
+# --- test/benchmark seams -------------------------------------------------
+# _pipeline_probe(event, leaf_idx, shard_key): called at "d2h_enqueue"
+# (stage 1, transfer launched), "adam_done" (stage 2) and "h2d_enqueue"
+# (stage 3) — lets tests assert the overlap schedule (all d2h enqueued
+# before the first Adam; shard k's h2d in flight before k+1's Adam ends)
+# without patching jax internals, and lets the loopback benchmark
+# (tools/offload_loopback.py) timestamp the real schedule under a
+# synthetic link. _read_shard(leaf_idx, shard_key, raw) gates the
+# stage-2 materialization of a d2h transfer — the loopback benchmark
+# substitutes a rate-limited wait to emulate a PCIe-speed link.
+_pipeline_probe: Optional[Callable[[str, int, str], None]] = None
+_read_shard: Optional[Callable[[int, str, Any], Any]] = None
+
 
 def _index_key(idx: Tuple) -> str:
     """Stable string key for a shard's global index (tuple of slices)."""
@@ -158,6 +171,8 @@ class HostOffloadOptimizer:
             piece = per_key_np[k].reshape(ent["shape"])
             for dev in ent["devices"]:
                 arrs.append(jax.device_put(piece, dev))
+            if _pipeline_probe is not None:
+                _pipeline_probe("h2d_enqueue", i, k)
         return jax.make_array_from_single_device_arrays(
             self.shapes[i], table.sharding, arrs)
 
@@ -186,7 +201,7 @@ class HostOffloadOptimizer:
 
         # stage 1: launch every shard's d2h copy (non-blocking)
         shard_data: List[Dict[str, Any]] = []
-        for g, table in zip(g_leaves, self.tables):
+        for li, (g, table) in enumerate(zip(g_leaves, self.tables)):
             d: Dict[str, Any] = {}
             if isinstance(g, jax.Array):
                 for sh in g.addressable_shards:
@@ -200,6 +215,8 @@ class HostOffloadOptimizer:
                             sh.data.copy_to_host_async()
                         except Exception:
                             pass
+                        if _pipeline_probe is not None:
+                            _pipeline_probe("d2h_enqueue", li, k)
                         d[k] = sh.data
                 if len(d) != len(table.by_key):
                     # grad sharding does not line up with the param shard
@@ -225,8 +242,11 @@ class HostOffloadOptimizer:
             for k in table.by_key:
                 skey = f"{i}:{k}"
                 mst = self.master[i][k]
+                raw = shard_data[i][k]
+                if _read_shard is not None:
+                    raw = _read_shard(i, k, raw)
                 g_np = np.ascontiguousarray(
-                    np.asarray(shard_data[i][k], np.float32).ravel())
+                    np.asarray(raw, np.float32).ravel())
                 assert g_np.size == mst.size, (
                     f"grad shard {skey}: {g_np.size} elems vs master "
                     f"{mst.size} — grad/param sharding mismatch")
@@ -243,6 +263,8 @@ class HostOffloadOptimizer:
                     self.opt.step(skey, mst, g_np, lr=lr,
                                   params_bf16_out=self.staging[i][k])
                     stg = self.staging[i][k].view(bf16)
+                if _pipeline_probe is not None:
+                    _pipeline_probe("adam_done", i, k)
                 if self.param_dtype == jnp.bfloat16:
                     staged_np[k] = stg
                 else:
