@@ -267,11 +267,6 @@ class DeepSpeedEngine:
         # --- MoQ quantize-aware training (ref: engine.py:1789-1800) ---
         qt = config.quantize_training
         if qt.enabled:
-            if self.offload_enabled:
-                raise NotImplementedError(
-                    "quantize_training with offload_optimizer is not "
-                    "supported (host masters + in-jit fake-quant don't "
-                    "compose yet)")
             from deepspeed_tpu.runtime.quantize import Quantizer
             self.quantizer = Quantizer.from_config(qt)
             if qt.eigenvalue.enabled:
@@ -310,10 +305,6 @@ class DeepSpeedEngine:
 
         # --- progressive layer drop (ref: engine.py:1542) -------------
         if config.pld.enabled:
-            if self.offload_enabled:
-                raise NotImplementedError(
-                    "progressive_layer_drop with offload_optimizer is "
-                    "not supported")
             from deepspeed_tpu.runtime.progressive_layer_drop import (
                 ProgressiveLayerDrop)
             self.progressive_layer_drop = ProgressiveLayerDrop(
@@ -761,9 +752,28 @@ class DeepSpeedEngine:
         prescale = cfg.prescale_gradients
         predivide = cfg.gradient_predivide_factor
 
-        def micro_loss(params, micro_batch, rng, scale_state):
+        # MoQ + PLD compose with offload exactly as with the fused step:
+        # both only transform the in-jit FORWARD (fake-quantized compute
+        # params / theta-scheduled layer drop) — the host optimizer never
+        # sees them (ref: engine.py:1789-1800 + :1542 compose with
+        # cpu_offload the same way)
+        quant_fn = self.quantizer.make_transform(
+            step_at_build=self.global_steps - self.skipped_steps) \
+            if (self.quantizer is not None and self.quantizer.active) else None
+        pld_cfg = cfg.pld if cfg.pld.enabled else None
+
+        def micro_loss(params, micro_batch, rng, scale_state, step):
             cparams = _cast_tree(params, compute_dtype)
+            if quant_fn is not None:
+                rng, qr = jax.random.split(rng)
+                cparams = quant_fn(cparams, qr, step)
             micro_batch = _cast_tree(micro_batch, compute_dtype)
+            if pld_cfg is not None and isinstance(micro_batch, dict):
+                from deepspeed_tpu.runtime.progressive_layer_drop import (
+                    PLD_THETA_KEY, theta_schedule)
+                micro_batch = dict(micro_batch)
+                micro_batch[PLD_THETA_KEY] = theta_schedule(
+                    step, pld_cfg.theta, pld_cfg.gamma)
             out = loss_fn(cparams, micro_batch, rng)
             loss, aux = out if has_aux else (out, {})
             scaled = ls.scale_loss(loss.astype(jnp.float32), scale_state) \
@@ -772,13 +782,14 @@ class DeepSpeedEngine:
 
         grad_fn = jax.grad(micro_loss, has_aux=True)
 
-        def gstep(params, batch, rng, scale_state):
+        def gstep(params, batch, rng, scale_state, step):
             rng, step_rng = jax.random.split(rng)
 
             def micro_body(carry, micro):
                 grads_acc, loss_acc, r = carry
                 r, mr = jax.random.split(r)
-                g, (loss, _aux) = grad_fn(params, micro, mr, scale_state)
+                g, (loss, _aux) = grad_fn(params, micro, mr, scale_state,
+                                          step)
                 if prescale and predivide != 1.0:
                     g = jax.tree_util.tree_map(lambda x: x / predivide, g)
                 grads_acc = jax.tree_util.tree_map(
@@ -827,12 +838,13 @@ class DeepSpeedEngine:
         self._batch_shard_leaf = mesh_lib.batch_sharding(self.mesh)
         return jax.jit(
             gstep,
-            in_shardings=(self.param_shardings, None, rep, scale_sh),
+            in_shardings=(self.param_shardings, None, rep, scale_sh, rep),
             out_shardings=(self.param_shardings, rep, scale_sh, rep))
 
     def _offload_train_batch(self, batch: PyTree) -> Dict[str, jnp.ndarray]:
         grads, rng, new_scale, metrics = self._grad_step(
-            self.state.params, batch, self.state.rng, self.state.scale_state)
+            self.state.params, batch, self.state.rng, self.state.scale_state,
+            jnp.asarray(int(self.state.step), jnp.int32))
         self.state.rng = rng
         self.state.scale_state = new_scale
         if self.dpu_enabled:
@@ -1108,7 +1120,10 @@ class DeepSpeedEngine:
             eigenvalue_enabled=self.eigenvalue is not None,
             block_eigenvalue=self.block_eigenvalue)
         if switched:
-            self._train_step = self._build_train_step(self._donate_state)
+            if self.offload_enabled:
+                self._grad_step = self._build_grad_step()
+            else:
+                self._train_step = self._build_train_step(self._donate_state)
 
     def destroy(self) -> None:
         """Flush and release engine-owned sinks (monitor/TB writer) and
