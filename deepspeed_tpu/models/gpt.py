@@ -589,7 +589,7 @@ def gpt_pipeline_partition_rules(tp: bool = False) -> list:
 
 
 def make_pipeline_loss_fn(cfg: GPTConfig, mesh, num_stages: int,
-                          num_micro: int, schedule: str = "gpipe"):
+                          num_micro: int, schedule: str = "1f1b"):
     """Engine-contract loss running the transformer stack as a shard_map
     pipeline over the 'pipe' mesh axis (1 stage = n_layers/pp layers).
     Embedding + LM head run replicated over pipe (tied-weight grads are

@@ -886,7 +886,9 @@ class DeepSpeedEngine:
 
     def _build_eval_step(self):
         compute_dtype = self.compute_dtype
-        loss_fn = self.loss_fn
+        # 1F1B pipeline losses run fwd+bwd eagerly inside their forward
+        # (custom_vjp) — they attach an eval-safe GPipe companion
+        loss_fn = getattr(self.loss_fn, "eval_fn", None) or self.loss_fn
         has_aux = self.has_aux
 
         def eval_fn(params, batch, rng):

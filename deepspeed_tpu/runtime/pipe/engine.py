@@ -273,7 +273,7 @@ def make_pipelined_loss_fn(embed_fn: Callable,
                            stage_params_specs: PyTree,
                            *,
                            remat_stage: bool = True,
-                           schedule: str = "gpipe",
+                           schedule: str = "1f1b",
                            axis: str = "pipe") -> Callable:
     """Build an engine-compatible loss fn (params, batch, rng) -> loss.
 
@@ -284,23 +284,30 @@ def make_pipelined_loss_fn(embed_fn: Callable,
       on that dim by the caller's partition rules.
     - stage_params_specs: PartitionSpec pytree for the stacked params
       (leading 'pipe' axis); other axes stay auto.
-    - schedule: 'gpipe' (fill-drain via scan+autodiff; activation memory
-      O(microbatches)) or '1f1b' (memory-bounded, ref TrainSchedule
-      pipe/schedule.py:189; activation memory O(stages)).
+    - schedule: '1f1b' (DEFAULT — memory-bounded, ref TrainSchedule
+      pipe/schedule.py:189; activation memory O(stages), which is what
+      matters at depth) or 'gpipe' (fill-drain via scan+autodiff;
+      activation memory O(microbatches)).
+
+    Under '1f1b' the returned loss_fn carries an ``eval_fn`` attribute
+    running the GPipe forward — the 1F1B custom_vjp computes gradients
+    eagerly inside its forward, which eval must not pay for; the engine
+    picks ``eval_fn`` up automatically.
     """
-    if remat_stage and schedule != "1f1b":
-        # 1f1b checkpoints at stage granularity by construction
-        stage_fn = jax.checkpoint(stage_fn,
-                                  policy=jax.checkpoint_policies.nothing_saveable)
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    gpipe_stage_fn = stage_fn
+    if remat_stage:
+        # 1f1b checkpoints at stage granularity by construction; the
+        # gpipe path (training or the eval companion) gets explicit remat
+        gpipe_stage_fn = jax.checkpoint(
+            stage_fn, policy=jax.checkpoint_policies.nothing_saveable)
 
     if schedule == "1f1b":
         loss_1f1b = make_1f1b_loss_fn(stage_fn, head_loss_fn, num_stages,
                                       mesh, stage_params_specs, axis=axis)
-    elif schedule != "gpipe":
-        raise ValueError(f"unknown pipeline schedule {schedule!r}")
 
-    def loss_fn(params, batch, rng):
-        del rng
+    def _micro_split(params, batch):
         stage_params, other_params = split_params(params)
         x, targets = embed_fn(other_params, batch)
         B = x.shape[0]
@@ -309,14 +316,13 @@ def make_pipelined_loss_fn(embed_fn: Callable,
         x_micro = x.reshape((num_micro, mb) + x.shape[1:])
         target_micro = jax.tree_util.tree_map(
             lambda t: t.reshape((num_micro, mb) + t.shape[1:]), targets)
+        return stage_params, other_params, x_micro, target_micro
 
-        if schedule == "1f1b":
-            return loss_1f1b(stage_params, other_params, x_micro,
-                             target_micro)
-
-        inner = partial(pipeline_loss, stage_fn, head_loss_fn,
+    def _gpipe(params, batch):
+        stage_params, other_params, x_micro, target_micro = \
+            _micro_split(params, batch)
+        inner = partial(pipeline_loss, gpipe_stage_fn, head_loss_fn,
                         num_stages=num_stages, axis=axis)
-
         sharded = jax.shard_map(
             inner,
             mesh=mesh,
@@ -328,5 +334,20 @@ def make_pipelined_loss_fn(embed_fn: Callable,
             axis_names={axis},
             check_vma=False)
         return sharded(stage_params, other_params, x_micro, target_micro)
+
+    def loss_fn(params, batch, rng):
+        del rng
+        if schedule == "1f1b":
+            stage_params, other_params, x_micro, target_micro = \
+                _micro_split(params, batch)
+            return loss_1f1b(stage_params, other_params, x_micro,
+                             target_micro)
+        return _gpipe(params, batch)
+
+    if schedule == "1f1b":
+        def eval_fn(params, batch, rng):
+            del rng
+            return _gpipe(params, batch)
+        loss_fn.eval_fn = eval_fn
 
     return loss_fn
