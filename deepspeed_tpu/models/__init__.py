@@ -1,5 +1,5 @@
 """Model families (loss-function + param-pytree contract for the engine)."""
 
-from deepspeed_tpu.models import bert, gpt, moe_gpt  # noqa: F401
+from deepspeed_tpu.models import bert, gpt, moe_gpt, resnet  # noqa: F401
 
-__all__ = ["bert", "gpt", "moe_gpt"]
+__all__ = ["bert", "gpt", "moe_gpt", "resnet"]

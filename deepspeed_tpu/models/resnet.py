@@ -1,0 +1,166 @@
+"""Compact ResNet for CIFAR-class vision workloads.
+
+Workload analog of the reference's first example config
+(ref: BASELINE.json config #1 — DeepSpeedExamples/cifar trains a small
+conv net under ZeRO stage 1; the reference tutorial at
+docs/_tutorials/cifar-10.md drives it through deepspeed.initialize).
+
+TPU-first design decisions:
+- **NHWC layout + HWIO kernels**: the native TPU convolution layout —
+  XLA maps these convs straight onto the MXU without transposes
+  (torch's NCHW would insert layout conversions around every conv).
+- **GroupNorm instead of BatchNorm**: BatchNorm's running stats are
+  mutable state (breaks the stateless loss_fn contract) and its batch
+  statistics need a cross-device sync under data parallelism (the
+  reference leans on NCCL SyncBN). GroupNorm is per-sample: zero
+  cross-device traffic, identical semantics at any dp degree, and jits
+  into the surrounding program. fp32 statistics, bf16 everything else.
+- **Stacked residual blocks under lax.scan** per stage (same compile-
+  once-per-depth trick as the GPT stack) — constant compile time in
+  depth, with per-block remat available through jax.checkpoint.
+"""
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PyTree = Any
+
+
+@dataclass
+class ResNetConfig:
+    num_classes: int = 10
+    # CIFAR-style stem (3x3, no max-pool); stage widths double
+    widths: Tuple[int, ...] = (64, 128, 256)
+    # residual blocks per stage (2, 2, 2) ~ ResNet-20-class capacity
+    depths: Tuple[int, ...] = (2, 2, 2)
+    groups: int = 8                    # GroupNorm groups
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+    image_size: int = 32
+    in_channels: int = 3
+
+
+def _conv_init(key, h, w, cin, cout):
+    fan_in = h * w * cin
+    return (jax.random.normal(key, (h, w, cin, cout), jnp.float32)
+            * np.sqrt(2.0 / fan_in))
+
+
+def init_params(key: jax.Array, cfg: ResNetConfig) -> PyTree:
+    keys = iter(jax.random.split(key, 4 + 4 * sum(cfg.depths)))
+    params: Dict[str, Any] = {
+        "stem": {"kernel": _conv_init(next(keys), 3, 3, cfg.in_channels,
+                                      cfg.widths[0]),
+                 "gn_scale": jnp.ones((cfg.widths[0],), jnp.float32),
+                 "gn_bias": jnp.zeros((cfg.widths[0],), jnp.float32)},
+        "head": {"kernel": jax.random.normal(
+            next(keys), (cfg.widths[-1], cfg.num_classes), jnp.float32)
+            / np.sqrt(cfg.widths[-1]),
+            "bias": jnp.zeros((cfg.num_classes,), jnp.float32)},
+    }
+    for si, (w, d) in enumerate(zip(cfg.widths, cfg.depths)):
+        cin = cfg.widths[max(si - 1, 0)]
+        # stage entry: strided projection when width/resolution changes
+        stage: Dict[str, Any] = {}
+        if si > 0:
+            stage["proj"] = {"kernel": _conv_init(next(keys), 1, 1, cin, w)}
+        # stacked block weights: leading axis = block index (lax.scan)
+        stage["conv1"] = jnp.stack([_conv_init(next(keys), 3, 3, w, w)
+                                    for _ in range(d)])
+        stage["conv2"] = jnp.stack([_conv_init(next(keys), 3, 3, w, w)
+                                    for _ in range(d)])
+        stage["gn1_scale"] = jnp.ones((d, w), jnp.float32)
+        stage["gn1_bias"] = jnp.zeros((d, w), jnp.float32)
+        stage["gn2_scale"] = jnp.ones((d, w), jnp.float32)
+        stage["gn2_bias"] = jnp.zeros((d, w), jnp.float32)
+        params[f"stage{si}"] = stage
+    return params
+
+
+def _conv(x, kernel, stride=1, dtype=jnp.bfloat16):
+    # no preferred_element_type: a widened output dtype breaks the conv
+    # transpose rule under AD (fp32 cotangent vs bf16 operands), and the
+    # MXU accumulates bf16 convs in fp32 internally regardless
+    return jax.lax.conv_general_dilated(
+        x.astype(dtype), kernel.astype(dtype),
+        window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _groupnorm(x, scale, bias, groups):
+    """Per-sample GroupNorm over NHWC; fp32 statistics."""
+    B, H, W, C = x.shape
+    g = min(groups, C)
+    xf = x.astype(jnp.float32).reshape(B, H, W, g, C // g)
+    mean = xf.mean(axis=(1, 2, 4), keepdims=True)
+    var = xf.var(axis=(1, 2, 4), keepdims=True)
+    xn = ((xf - mean) * jax.lax.rsqrt(var + 1e-5)).reshape(B, H, W, C)
+    return (xn * scale + bias).astype(x.dtype)
+
+
+def forward(params: PyTree, images: jnp.ndarray,
+            cfg: ResNetConfig) -> jnp.ndarray:
+    """images: [B, H, W, C] float (any range; caller normalizes) ->
+    logits [B, num_classes] (fp32)."""
+    x = images.astype(cfg.dtype)
+    stem = params["stem"]
+    x = _conv(x, stem["kernel"], dtype=cfg.dtype)
+    x = _groupnorm(x, stem["gn_scale"], stem["gn_bias"], cfg.groups)
+    x = jax.nn.relu(x)
+
+    for si in range(len(cfg.widths)):
+        stage = params[f"stage{si}"]
+        if si > 0:
+            # downsample: strided 1x1 projection into the wider stage
+            x = _conv(x, stage["proj"]["kernel"], stride=2, dtype=cfg.dtype)
+
+        def block(h, wts):
+            c1, c2, s1, b1, s2, b2 = wts
+            y = _groupnorm(_conv(h, c1, dtype=cfg.dtype), s1, b1, cfg.groups)
+            y = jax.nn.relu(y)
+            y = _groupnorm(_conv(y, c2, dtype=cfg.dtype), s2, b2, cfg.groups)
+            return jax.nn.relu(h + y), None
+
+        body = block
+        if cfg.remat:
+            body = jax.checkpoint(block)
+        x, _ = jax.lax.scan(
+            body, x, (stage["conv1"], stage["conv2"],
+                      stage["gn1_scale"], stage["gn1_bias"],
+                      stage["gn2_scale"], stage["gn2_bias"]))
+
+    x = x.astype(jnp.float32).mean(axis=(1, 2))        # global avg pool
+    head = params["head"]
+    return x @ head["kernel"] + head["bias"]
+
+
+def loss_fn(params: PyTree, batch: Dict[str, jnp.ndarray], rng: jax.Array,
+            cfg: ResNetConfig) -> jnp.ndarray:
+    """batch: {"images": [B,H,W,C], "labels": [B]} -> mean CE loss."""
+    del rng
+    logits = forward(params, batch["images"], cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, batch["labels"][:, None],
+                               axis=-1).squeeze(-1)
+    return nll.mean()
+
+
+def make_loss_fn(cfg: ResNetConfig):
+    return partial(loss_fn, cfg=cfg)
+
+
+def accuracy(params: PyTree, batch: Dict[str, jnp.ndarray],
+             cfg: ResNetConfig) -> jnp.ndarray:
+    logits = forward(params, batch["images"], cfg)
+    return (jnp.argmax(logits, -1) == batch["labels"]).mean()
+
+
+def num_params(cfg: ResNetConfig) -> int:
+    k = jax.random.PRNGKey(0)
+    return sum(int(np.prod(l.shape))
+               for l in jax.tree_util.tree_leaves(init_params(k, cfg)))
