@@ -132,13 +132,16 @@ class Autotuner:
         return opt + grad + master_and_copy
 
     def max_micro_batch_size(self, zero_stage: int) -> int:
-        """Largest micro batch the memory model admits."""
+        """Largest micro batch the memory model admits — bounded by BOTH
+        the 0.85 occupancy slack and the compile headroom (borderline-HBM
+        programs grind this backend's compiler; utils/hbm.py)."""
+        from deepspeed_tpu.utils.hbm import DEFAULT_HEADROOM_GIB, GiB
         hbm = self.get_gpu_memory_info()
         inst = self.get_instantiation_memory_required_per_gpu(zero_stage)
         act = self.model_info.get("activation_mem_per_gpu") or 0.0
         if act <= 0:
             return 64  # no estimate: bounded default sweep
-        avail = hbm * 0.85 - inst
+        avail = min(hbm * 0.85, hbm - DEFAULT_HEADROOM_GIB * GiB) - inst
         return max(1, int(avail // act))
 
     # -- experiment generation ----------------------------------------
@@ -213,11 +216,14 @@ class Autotuner:
         hbm = self.get_gpu_memory_info()
         rm = ResourceManager(self.run_ds_config, results_dir=self.results_dir)
 
+        from deepspeed_tpu.utils.hbm import DEFAULT_HEADROOM_GIB, GiB
+        limit = hbm - DEFAULT_HEADROOM_GIB * GiB
         for stage in self.zero_stages:
             inst = self.get_instantiation_memory_required_per_gpu(stage)
-            if inst > hbm:
+            if inst > limit:
                 logger.info(f"pruned zero stage {stage}: needs "
-                            f"{inst / 1e9:.1f} GB > {hbm / 1e9:.1f} GB HBM")
+                            f"{inst / 1e9:.1f} GB > {limit / 1e9:.1f} GB "
+                            f"compile-safe HBM")
                 continue
             exps = self._generate_experiments(stage)
             if not exps:

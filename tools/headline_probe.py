@@ -39,7 +39,11 @@ VARIANTS = {
     "b16-full": _v(lc=0),
     "b16-full-ce": _v(),
     "b16-flashonly-ce": _v(pol="flash_only"),   # guard: refused (grind)
+    # flash_only FITS at b12 (guard: 14.26GiB) — skips the flash-fwd
+    # recompute the b16 variant died trying to buy
+    "b12-flashonly-ce": _v(batch=12, pol="flash_only"),
     "b20-full-ce": _v(batch=20),
+    "b22-full-ce": _v(batch=22),
     "b24-full-ce": _v(batch=24),                # guard: refused
     "b32-full-ce": _v(batch=32),                # guard: refused
     "b16-sel-ce": _v(pol="selective"),          # guard: refused
