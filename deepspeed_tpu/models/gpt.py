@@ -45,7 +45,10 @@ class GPTConfig:
     remat: bool = True                # activation checkpointing per layer
     # 'full': recompute everything (nothing_saveable — min memory);
     # 'selective': save matmul/attention outputs, recompute layernorm/gelu/
-    # elementwise only (~25% less recompute for ~8*d bytes/token/layer)
+    # elementwise only (~25% less recompute for ~8*d bytes/token/layer);
+    # 'flash_only': save just the flash residuals; 'offload_flash': flash
+    # residuals stream to pinned host memory — full-remat HBM footprint
+    # without the flash-fwd recompute (cpu_checkpointing analog)
     remat_policy: str = "selective"
     use_flash_attention: bool = True
     # 1024-blocks measured fastest at seq>=1024 on v5e (PERF.md); the
@@ -193,10 +196,23 @@ def remat_policy(name: str, flash: bool = False):
     if name == "flash_only":
         return jax.checkpoint_policies.save_only_these_names(
             "flash_out", "flash_lse")
+    if name == "offload_flash":
+        # flash residuals move to PINNED HOST memory instead of either
+        # living in HBM (flash_only) or being recomputed (full): HBM cost
+        # ~0 like 'full', backward skips the flash-fwd recompute like
+        # 'flash_only'. The d2h/h2d rides the same async DMA path XLA
+        # schedules around compute. TPU-native analog of the reference's
+        # cpu_checkpointing (ref: runtime/activation_checkpointing/
+        # checkpointing.py:28 PartitionedActivations/cpu_checkpointing).
+        return jax.checkpoint_policies.save_and_offload_only_these_names(
+            names_which_can_be_saved=[],
+            names_which_can_be_offloaded=["flash_out", "flash_lse"],
+            offload_src="device", offload_dst="pinned_host")
     if name == "full":
         return jax.checkpoint_policies.nothing_saveable
-    raise ValueError(f"unknown remat_policy {name!r} "
-                     "(expected 'selective', 'flash_only' or 'full')")
+    raise ValueError(f"unknown remat_policy {name!r} (expected "
+                     "'selective', 'flash_only', 'offload_flash' or "
+                     "'full')")
 
 
 def _layernorm(x, scale, bias, eps=1e-5):

@@ -146,7 +146,9 @@ def estimate_train_bytes(
         width, transient = qkv_w + 1 + ffn_w + 1, 0.0
     elif remat_policy == "flash_only":
         width, transient = 2.0, none_width
-    else:                                        # 'full'
+    else:
+        # 'full' — and 'offload_flash', whose saved residuals live in
+        # pinned HOST memory, so device HBM matches full remat
         width, transient = 1.0, none_width
     act_bytes = int(tokens * n_layers * width * d_model * 2)
     act_bytes += int(tokens * transient * d_model * 2)   # one-layer recompute

@@ -110,3 +110,36 @@ def test_gqa_model_trains_and_matches_reference_shapes(devices):
         0, 128, (8, 17)).astype(np.int32)}
     losses = [float(eng.train_batch(toks)["loss"]) for _ in range(5)]
     assert losses[-1] < losses[0]
+
+
+def test_offload_flash_remat_matches_full(devices, pallas_interpret):
+    """remat_policy='offload_flash' (flash residuals stream to pinned
+    host — the cpu_checkpointing analog, ref activation_checkpointing/
+    checkpointing.py:28) must produce identical grads to full remat;
+    only memory placement differs. Uses the real flash kernel (interpret
+    mode) so the "flash_out"/"flash_lse" tags actually exist and the
+    offload policy engages."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.gpt import remat_policy
+    from deepspeed_tpu.ops.attention import flash as F
+
+    ks = jax.random.split(jax.random.PRNGKey(0), 4)
+    q, k, v = (jax.random.normal(kk, (1, 256, 4, 64), jnp.float32)
+               for kk in ks[:3])
+    w = jax.random.normal(ks[3], (256, 256), jnp.float32) * 0.05
+
+    def block(q, w):
+        o = F.flash_attention(q, k, v, causal=True, block_q=128,
+                              block_kv=128)
+        h = o.reshape(1, 256, 256) @ w
+        return (h ** 2).sum()
+
+    def loss(pol):
+        f = jax.checkpoint(block, policy=remat_policy(pol, flash=True))
+        return jax.jit(jax.grad(f, argnums=(0, 1)))(q, w)
+
+    gf = loss("full")
+    go = loss("offload_flash")
+    for a, b in zip(gf, go):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)

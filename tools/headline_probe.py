@@ -42,6 +42,9 @@ VARIANTS = {
     # flash_only FITS at b12 (guard: 14.26GiB) — skips the flash-fwd
     # recompute the b16 variant died trying to buy
     "b12-flashonly-ce": _v(batch=12, pol="flash_only"),
+    # offload_flash: flash residuals stream to pinned host — full-remat
+    # HBM footprint WITH the recompute skip, at full batch 16
+    "b16-offloadflash-ce": _v(pol="offload_flash"),
     "b20-full-ce": _v(batch=20),
     "b22-full-ce": _v(batch=22),
     "b24-full-ce": _v(batch=24),                # guard: refused
