@@ -42,6 +42,7 @@ QUEUE = [
     ("moe", [sys.executable, "tools/moe_bench.py", "8"], 6200),
     ("longcontext", [sys.executable, "tools/longcontext_bench.py", "chip"],
      4800),
+    ("infer", [sys.executable, "tools/infer_bench.py"], 3600),
 ]
 
 

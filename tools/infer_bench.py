@@ -1,0 +1,102 @@
+"""On-chip inference benchmark: prefill + decode throughput/latency.
+
+The reference's inference headline is kernel-injection latency speedups
+(ref: deepspeed/inference/engine.py + docs/_tutorials/inference-tutorial.md
+"2.3x faster GPT-2 latency on 1 GPU"). TPU analog measured here:
+
+- prefill: tokens/s through the fused flash-prefill program;
+- decode (host loop): per-token latency of the compiled, cache-donating
+  decode step — pays one host round-trip per token;
+- decode (fused): per-token latency inside `generate_fused` (the whole
+  loop is ONE lax.scan program — the host round-trip amortizes away,
+  which is the TPU-native answer to the reference's fused-kernel claim);
+- feature matrix timings: GQA cache, sliding-window cache.
+
+One JSON line per (config, mode). Guarded by the same per-item pattern
+as chip_queue (fresh subprocess per config via tools/_subproc).
+
+Usage: python tools/infer_bench.py [steps]
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from deepspeed_tpu.utils import honor_platform_request  # noqa: E402
+
+honor_platform_request()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def bench_config(name, preset, batch, prompt_len, new_tokens,
+                 n_kv_heads=None, attn_window=None):
+    from deepspeed_tpu.models import gpt
+    import deepspeed_tpu
+
+    on_tpu = "tpu" in (jax.devices()[0].platform +
+                       jax.devices()[0].device_kind).lower()
+    cfg = gpt.preset(preset, max_seq_len=prompt_len + new_tokens + 8,
+                     dtype=jnp.bfloat16, use_flash_attention=on_tpu,
+                     n_kv_heads=n_kv_heads, attn_window=attn_window)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    eng = deepspeed_tpu.init_inference(model=(cfg, params),
+                                       dtype=jnp.bfloat16)
+
+    toks = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (batch, prompt_len)).astype(np.int32)
+
+    # warmup both paths (compiles)
+    eng.generate(toks, max_new_tokens=4)
+    eng.generate_fused(toks, max_new_tokens=4)
+
+    t0 = time.perf_counter()
+    eng.generate(toks, max_new_tokens=new_tokens)
+    host_ms = (time.perf_counter() - t0) * 1e3 / new_tokens
+
+    t0 = time.perf_counter()
+    eng.generate_fused(toks, max_new_tokens=new_tokens)
+    fused_total = (time.perf_counter() - t0) * 1e3
+    fused_ms = fused_total / new_tokens
+
+    print(json.dumps({
+        "config": name, "preset": preset, "batch": batch,
+        "prompt_len": prompt_len, "new_tokens": new_tokens,
+        "prefill_ms": round(eng.latency_ms.get("prefill", 0.0), 2),
+        "decode_ms_per_token_hostloop": round(host_ms, 3),
+        "decode_ms_per_token_fused": round(fused_ms, 3),
+        "fused_speedup": round(host_ms / max(fused_ms, 1e-9), 2),
+        "decode_tokens_per_s_fused": round(batch * 1e3 / fused_ms, 1),
+    }), flush=True)
+
+
+CONFIGS = [
+    ("gpt2-medium-b8", dict(preset="gpt2-medium", batch=8,
+                            prompt_len=512, new_tokens=64)),
+    ("gpt2-medium-b32", dict(preset="gpt2-medium", batch=32,
+                             prompt_len=512, new_tokens=64)),
+    ("gpt2-large-b8", dict(preset="gpt2-large", batch=8,
+                           prompt_len=512, new_tokens=64)),
+    ("medium-gqa4", dict(preset="gpt2-medium", batch=8, prompt_len=512,
+                         new_tokens=64, n_kv_heads=4)),
+    ("medium-window256", dict(preset="gpt2-medium", batch=8,
+                              prompt_len=512, new_tokens=64,
+                              attn_window=256)),
+]
+
+
+def main():
+    for name, kw in CONFIGS:
+        try:
+            bench_config(name, **kw)
+        except Exception as e:
+            print(json.dumps({"config": name, "error": repr(e)[:200]}),
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()
