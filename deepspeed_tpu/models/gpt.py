@@ -276,14 +276,19 @@ def _attention(q, k, v, cfg: GPTConfig, segment_ids=None, kv_mask=None):
         # k/v; Ulysses needs the sp degree to divide both head counts
         if cfg.sp_impl == "ulysses":
             from deepspeed_tpu.ops.attention.ulysses import ulysses_attention
-            blocks = _flash_blocks(cfg, q.shape[1])
+            S = q.shape[1]
+            blocks = _flash_blocks(cfg, S)
             return ulysses_attention(
                 q, k, v, cfg.mesh, causal=True, scale=scale,
                 use_flash=blocks is not None,
                 block_q=blocks[0] if blocks else cfg.flash_block_q,
                 block_kv=blocks[1] if blocks else cfg.flash_block_kv,
                 segment_ids=segment_ids, kv_mask=kv_mask,
-                window=cfg.attn_window)
+                window=cfg.attn_window,
+                bwd_block_q=(_effective_block(cfg.flash_block_bwd_q, S)
+                             if cfg.flash_block_bwd_q else None),
+                bwd_block_kv=(_effective_block(cfg.flash_block_bwd_kv, S)
+                              if cfg.flash_block_bwd_kv else None))
         if cfg.sp_impl != "ring":
             raise ValueError(f"unknown sp_impl {cfg.sp_impl!r} "
                              "(expected 'ring' or 'ulysses')")

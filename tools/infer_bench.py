@@ -50,18 +50,18 @@ def bench_config(name, preset, batch, prompt_len, new_tokens,
     toks = np.random.default_rng(0).integers(
         0, cfg.vocab_size, (batch, prompt_len)).astype(np.int32)
 
-    # warmup both paths (compiles)
-    eng.generate(toks, max_new_tokens=4)
-    eng.generate_fused(toks, max_new_tokens=4)
-
-    t0 = time.perf_counter()
+    # warmup at the MEASURED lengths: the fused scan executable is keyed
+    # on n_steps, so a shorter warmup would leave the full compile inside
+    # the timed call
     eng.generate(toks, max_new_tokens=new_tokens)
-    host_ms = (time.perf_counter() - t0) * 1e3 / new_tokens
-
-    t0 = time.perf_counter()
     eng.generate_fused(toks, max_new_tokens=new_tokens)
-    fused_total = (time.perf_counter() - t0) * 1e3
-    fused_ms = fused_total / new_tokens
+
+    # measured pass — report the engine's own per-token latencies, which
+    # exclude prefill and compile by construction
+    eng.generate(toks, max_new_tokens=new_tokens)
+    host_ms = eng.latency_ms["decode_per_token"]
+    eng.generate_fused(toks, max_new_tokens=new_tokens)
+    fused_ms = eng.latency_ms["decode_per_token_fused"]
 
     print(json.dumps({
         "config": name, "preset": preset, "batch": batch,
