@@ -262,3 +262,71 @@ def load_fp32_state_dict_from_zero_checkpoint(ckpt_dir: str,
 def get_fp32_state_dict_from_zero_checkpoint(ckpt_dir: str,
                                              tag: Optional[str] = None):
     return load_fp32_state_dict_from_zero_checkpoint(ckpt_dir, tag)
+
+
+# ---------------------------------------------------------------------------
+# flat 16-bit weight export (ref: engine.py:3136 save_16bit_model)
+# ---------------------------------------------------------------------------
+
+def _flat_key(path) -> str:
+    import jax
+    parts = []
+    for p in path:
+        if isinstance(p, jax.tree_util.DictKey):
+            parts.append(str(p.key))
+        elif isinstance(p, jax.tree_util.SequenceKey):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def write_16bit_model(params, save_dir: str,
+                      save_filename: str = "model_weights.npz") -> str:
+    """Save a param pytree as one flat npz with path-joined keys.
+    bf16 (npz-unrepresentable) leaves are stored as uint16 bit patterns;
+    a ``__bf16_keys__`` manifest records which, so load_16bit_model can
+    reverse the view exactly."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    os.makedirs(save_dir, exist_ok=True)
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    out, bf16_keys = {}, []
+    for path, leaf in flat:
+        k = _flat_key(path)
+        a = np.asarray(leaf)
+        if a.dtype == jnp.bfloat16.dtype:
+            bf16_keys.append(k)
+            a = a.view(np.uint16)
+        out[k] = a
+    out["__bf16_keys__"] = np.asarray(bf16_keys, dtype="U")
+    path = os.path.join(save_dir, save_filename)
+    np.savez(path, **out)
+    return path
+
+
+def load_16bit_model(path: str):
+    """Inverse of write_16bit_model: returns a NESTED dict pytree
+    (splitting keys on '/') with bf16 leaves restored."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    with np.load(path) as z:
+        bf16 = set(z["__bf16_keys__"].tolist())
+        tree = {}
+        for k in z.files:
+            if k == "__bf16_keys__":
+                continue
+            a = z[k]
+            if k in bf16:
+                a = a.view(jnp.bfloat16.dtype)
+            node = tree
+            parts = k.split("/")
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            node[parts[-1]] = a
+    return tree

@@ -1235,3 +1235,22 @@ class DeepSpeedEngine:
                         lambda _: NamedSharding(self.mesh, P()),
                         self.state.params))(self.state.params))
         return full
+
+    def module_state_dict(self):
+        """The param pytree (the reference's module.state_dict analog,
+        ref: engine.py:3107)."""
+        return self.state.params
+
+    def save_16bit_model(self, save_dir: str,
+                         save_filename: str = "model_weights.npz") -> bool:
+        """Consolidate the (possibly ZeRO-3-sharded) weights and save ONE
+        flat compute-dtype npz (ref: engine.py:3136 save_16bit_model —
+        there a torch .bin; here a numpy archive with path-joined keys;
+        bf16 leaves are stored as uint16 bit patterns with a dtype
+        manifest since npz has no bf16). Load with
+        ``runtime.checkpointing.load_16bit_model``."""
+        self.flush_delayed_update()
+        from deepspeed_tpu.runtime.checkpointing import write_16bit_model
+        write_16bit_model(self.consolidated_16bit_state_dict(),
+                          save_dir, save_filename)
+        return True
