@@ -154,3 +154,58 @@ def test_bert_mlm_loss_chunked_parity():
                            dataclasses.replace(cfg, loss_chunk=8),
                            deterministic=True)
     np.testing.assert_allclose(chunked, dense, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# property-based chunked-CE invariants (hypothesis)
+# ---------------------------------------------------------------------------
+
+from hypothesis import given, settings, strategies as st
+
+
+@settings(max_examples=25, deadline=None)
+@given(st.integers(min_value=1, max_value=6),     # rows N
+       st.integers(min_value=3, max_value=37),    # vocab V
+       st.integers(min_value=1, max_value=9),     # chunk
+       st.booleans(),                              # bias
+       st.booleans())                              # mask
+def test_chunked_matches_dense_any_shape(n, v, chunk, with_bias,
+                                         with_mask):
+    """For ANY (rows, vocab, chunk, bias, mask) combination — including
+    chunk sizes that don't divide the row count — the fused chunked loss
+    and its grads match the dense log-softmax computation."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.cross_entropy import chunked_softmax_xent
+
+    r = np.random.default_rng(n * 100 + v)
+    h = 8
+    x = jnp.asarray(r.standard_normal((n, h)), jnp.float32)
+    w = jnp.asarray(r.standard_normal((v, h)), jnp.float32)
+    b = jnp.asarray(r.standard_normal((v,)), jnp.float32) \
+        if with_bias else None
+    t = jnp.asarray(r.integers(0, v, (n,)), jnp.int32)
+    m = jnp.asarray((r.random(n) > 0.3).astype(np.float32)) \
+        if with_mask else None
+
+    def dense(x, w):
+        logits = x @ w.T + (b if b is not None else 0.0)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, t[:, None], 1).squeeze(-1)
+        if m is not None:
+            return (nll * m).sum() / jnp.maximum(m.sum(), 1.0)
+        return nll.mean()
+
+    def fused(x, w):
+        return chunked_softmax_xent(x[None], w, t[None], bias=b,
+                                    chunk=chunk,
+                                    loss_mask=None if m is None
+                                    else m[None])
+
+    np.testing.assert_allclose(float(dense(x, w)), float(fused(x, w)),
+                               rtol=1e-5, atol=1e-6)
+    gd = jax.grad(dense, argnums=(0, 1))(x, w)
+    gf = jax.grad(fused, argnums=(0, 1))(x, w)
+    for a, c in zip(gd, gf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   rtol=1e-4, atol=1e-5)

@@ -261,3 +261,64 @@ def test_packed_batch_through_engine(devices):
     batch = {k: v[:8] for k, v in packed.items()}
     losses = [float(eng.train_batch(batch)["loss"]) for _ in range(6)]
     assert losses[-1] < losses[0], losses
+
+
+# ---------------------------------------------------------------------------
+# property-based packing invariants (hypothesis)
+# ---------------------------------------------------------------------------
+
+from hypothesis import given, settings, strategies as st
+
+
+@settings(max_examples=40, deadline=None)
+@given(st.lists(st.integers(min_value=2, max_value=40), min_size=1,
+                max_size=12),
+       st.integers(min_value=8, max_value=48))
+def test_pack_documents_invariants(doc_lens, seq_len):
+    """For ANY document mix: every token of every (>=2-token) document
+    lands in exactly one row slot, segments never interleave, positions
+    restart per document, and the loss mask is 1 exactly on within-doc
+    next-token positions."""
+    from deepspeed_tpu.runtime.dataloader import pack_documents
+
+    r = np.random.default_rng(0)
+    docs = [r.integers(1, 1000, ln).astype(np.int32) for ln in doc_lens]
+    packed = pack_documents(docs, seq_len=seq_len, pad_token=0)
+    toks, segs = packed["tokens"], packed["segment_ids"]
+    poss, mask = packed["positions"], packed["loss_mask"]
+
+    n, S = toks.shape
+    assert segs.shape == (n, S) and poss.shape == (n, S)
+    assert mask.shape == (n, S - 1)
+
+    # total non-padding tokens == total tokens of all packed pieces
+    # (docs longer than seq_len are split; trailing <2-token scraps drop)
+    expected = 0
+    for ln in doc_lens:
+        while ln > seq_len:
+            expected += seq_len
+            ln -= seq_len
+        if ln >= 2:
+            expected += ln
+    assert int((segs >= 0).sum()) == expected
+
+    for i in range(n):
+        row_segs = segs[i]
+        # segments are contiguous runs starting at 0, padding (-1) only
+        # at the tail
+        valid = row_segs >= 0
+        if valid.any():
+            last_valid = np.max(np.nonzero(valid))
+            assert valid[:last_valid + 1].all()   # no holes
+            runs = row_segs[:last_valid + 1]
+            # non-decreasing, increments of exactly 1
+            d = np.diff(runs)
+            assert ((d == 0) | (d == 1)).all()
+        # positions restart at each segment start and increment inside
+        for sid in np.unique(row_segs[row_segs >= 0]):
+            where = np.nonzero(row_segs == sid)[0]
+            np.testing.assert_array_equal(poss[i][where],
+                                          np.arange(len(where)))
+        # mask[i, j] == 1 iff token j and j+1 share a segment (>=0)
+        same = (row_segs[:-1] == row_segs[1:]) & (row_segs[:-1] >= 0)
+        np.testing.assert_array_equal(mask[i] > 0, same)
