@@ -262,15 +262,6 @@ def _attention(q, k, v, cfg: GPTConfig, segment_ids=None, kv_mask=None):
     inside each segment (block-diagonal x causal).
     kv_mask: optional [B, S] key-validity mask (left-padded prompts)."""
     scale = cfg.attn_scale  # None -> kernels default to 1/sqrt(Dh)
-    if (segment_ids is not None or kv_mask is not None
-            or cfg.attn_window is not None) \
-            and cfg.sequence_parallel and cfg.mesh is not None \
-            and cfg.sp_impl != "ulysses":
-        raise NotImplementedError(
-            "segment_ids / kv_mask / attn_window + RING sequence "
-            "parallelism is not supported (rotating K/V blocks never "
-            "co-reside with the full row) — use sp_impl='ulysses', whose "
-            "head-sharded layout keeps full rows local")
     if cfg.sequence_parallel and cfg.mesh is not None:
         # GQA works under both SP impls: ring rotates the small grouped
         # k/v; Ulysses needs the sp degree to divide both head counts
@@ -293,7 +284,11 @@ def _attention(q, k, v, cfg: GPTConfig, segment_ids=None, kv_mask=None):
             raise ValueError(f"unknown sp_impl {cfg.sp_impl!r} "
                              "(expected 'ring' or 'ulysses')")
         from deepspeed_tpu.ops.attention.ring import ring_attention
-        return ring_attention(q, k, v, cfg.mesh, causal=True, scale=scale)
+        # packing/padding metadata rotates with the K/V blocks; window
+        # is masked exactly (the DMA-elision fast path is single-chip)
+        return ring_attention(q, k, v, cfg.mesh, causal=True, scale=scale,
+                              segment_ids=segment_ids, kv_mask=kv_mask,
+                              window=cfg.attn_window)
     blocks = _flash_blocks(cfg, q.shape[1])
     if blocks is not None:
         from deepspeed_tpu.ops.attention.flash import flash_attention

@@ -27,11 +27,16 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def _ring_attention_local(q, k, v, *, axis: str, causal: bool, scale: float):
+def _ring_attention_local(q, k, v, segs, kvm, *, axis: str, causal: bool,
+                          scale: float, window: Optional[int]):
     """Inside shard_map: q local [B, S_loc, H, D]; k/v may carry Hkv < H
     heads (GQA) — the SMALL grouped k/v rotate around the ring (the
     ICI-traffic win scales with the group factor) and are repeated
-    locally per step for the einsum. Returns [B, S_loc, H, D]."""
+    locally per step for the einsum. segs/kvm: [B, S_loc] per-token
+    metadata (packed segment ids / key-validity) that ROTATES with its
+    K/V block — each step masks scores against the metadata of the block
+    currently held, so packing and padding masks are exact under the
+    ring. Returns [B, S_loc, H, D]."""
     n = jax.lax.axis_size(axis)
     idx = jax.lax.axis_index(axis)
     B, S_loc, H, D = q.shape
@@ -46,7 +51,7 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool, scale: float):
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def step(carry, i):
-        k_cur, v_cur, m, l, acc = carry
+        k_cur, v_cur, segs_cur, kvm_cur, m, l, acc = carry
         # the block currently held originated at ring position (idx - i) % n
         src = (idx - i) % n
         # repeat LOCALLY for the einsum; the carry (and the ppermute
@@ -58,7 +63,15 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool, scale: float):
             k_pos = src * S_loc + jax.lax.broadcasted_iota(
                 jnp.int32, (S_loc, S_loc), 1)
             mask = q_pos[None, None] >= k_pos[None, None]
+            if window is not None:
+                mask = jnp.logical_and(
+                    mask, q_pos[None, None] - k_pos[None, None] < window)
             s = jnp.where(mask, s, -1e30)
+        if segs_cur is not None:
+            same = segs[:, None, :, None] == segs_cur[:, None, None, :]
+            s = jnp.where(same, s, -1e30)
+        if kvm_cur is not None:
+            s = jnp.where(kvm_cur[:, None, None, :] > 0, s, -1e30)
         m_cur = jnp.max(s, axis=-1, keepdims=True)            # [B,H,Sq,1]
         m_new = jnp.maximum(m, m_cur)
         p = jnp.exp(s - m_new)
@@ -68,13 +81,18 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool, scale: float):
         acc_new = acc * alpha.transpose(0, 1, 2, 3) + pv
         k_nxt = jax.lax.ppermute(k_cur, axis, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis, perm)
-        return (k_nxt, v_nxt, m_new, l_new, acc_new), None
+        segs_nxt = (None if segs_cur is None
+                    else jax.lax.ppermute(segs_cur, axis, perm))
+        kvm_nxt = (None if kvm_cur is None
+                   else jax.lax.ppermute(kvm_cur, axis, perm))
+        return (k_nxt, v_nxt, segs_nxt, kvm_nxt, m_new, l_new,
+                acc_new), None
 
     m0 = jnp.full((B, H, S_loc, 1), -1e30, jnp.float32)
     l0 = jnp.zeros((B, H, S_loc, 1), jnp.float32)
     acc0 = jnp.zeros((B, H, S_loc, D), jnp.float32)
-    (_, _, m, l, acc), _ = jax.lax.scan(
-        step, (k, v, m0, l0, acc0), jnp.arange(n))
+    (_, _, _, _, m, l, acc), _ = jax.lax.scan(
+        step, (k, v, segs, kvm, m0, l0, acc0), jnp.arange(n))
     l_safe = jnp.where(l == 0.0, 1.0, l)
     out = (acc / l_safe).transpose(0, 2, 1, 3)                # [B,S_loc,H,D]
     return out.astype(q.dtype)
@@ -83,23 +101,44 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool, scale: float):
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    mesh: Mesh, *, causal: bool = True,
                    scale: Optional[float] = None,
-                   axis: str = "sequence") -> jnp.ndarray:
+                   axis: str = "sequence",
+                   segment_ids: Optional[jnp.ndarray] = None,
+                   kv_mask: Optional[jnp.ndarray] = None,
+                   window: Optional[int] = None) -> jnp.ndarray:
     """Exact (causal) attention with the sequence dim sharded over ``axis``.
 
     q,k,v: [B, S, H, D] global arrays whose S dim is (or will be) sharded
     over the 'sequence' mesh axis. Batch/head dims stay auto-sharded.
+
+    segment_ids/kv_mask: [B, S] packed-sequence ids / key-validity —
+    sharded like the tokens; each shard's slice rotates around the ring
+    with its K/V block, so packing/padding masks are exact. window:
+    sliding-window causal attention (mask-exact; out-of-band ring steps
+    still rotate — the flash kernel's DMA elision is the single-chip
+    perf path, the ring's win is capacity).
     """
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
+    if window is not None:
+        assert causal, "sliding window requires causal attention"
+    if segment_ids is not None:
+        segment_ids = segment_ids.astype(jnp.int32)
+    if kv_mask is not None:
+        kv_mask = kv_mask.astype(jnp.float32)
     inner = partial(_ring_attention_local, axis=axis, causal=causal,
-                    scale=scale)
+                    scale=scale, window=window)
     spec = P(None, axis, None, None)
+    tok_spec = P(None, axis)
+    args = [q, k, v, segment_ids, kv_mask]
+    in_specs = [spec, spec, spec,
+                None if segment_ids is None else tok_spec,
+                None if kv_mask is None else tok_spec]
     mapped = jax.shard_map(
         inner, mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=tuple(in_specs),
         out_specs=spec,
         axis_names={axis},
         check_vma=False)
     # partial-manual shard_map mis-canonicalizes out_specs when traced
     # eagerly in this jax version; under jit it is correct — force it.
-    return jax.jit(mapped)(q, k, v)
+    return jax.jit(mapped)(*args)

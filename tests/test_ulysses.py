@@ -193,15 +193,3 @@ def test_ulysses_packed_gpt_trains(devices):
         np.testing.assert_allclose(l_sp, l_ref, rtol=1e-4)
     assert np.isfinite(l_sp)
 
-
-def test_ring_packed_still_raises(devices):
-    from deepspeed_tpu.models import gpt
-    mesh = make_mesh(MeshSpec(data=1, sequence=8))
-    cfg = gpt.GPTConfig(vocab_size=128, n_layers=1, n_heads=8, d_model=32,
-                        max_seq_len=64, use_flash_attention=False,
-                        remat=False, dtype=jnp.float32,
-                        sequence_parallel=True, sp_impl="ring", mesh=mesh)
-    q = jax.random.normal(jax.random.PRNGKey(0), (1, 64, 8, 4))
-    segs = jnp.zeros((1, 64), jnp.int32)
-    with pytest.raises(NotImplementedError, match="RING"):
-        gpt._attention(q, q, q, cfg, segment_ids=segs)
