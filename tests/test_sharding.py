@@ -136,3 +136,45 @@ def test_zero_init_materializes_sharded(devices):
     tokens = np.random.default_rng(0).integers(0, 256, (8, 17)).astype(np.int32)
     m = eng.train_batch({"tokens": tokens})
     assert np.isfinite(float(m["loss"]))
+
+
+def test_gqa_tensor_parallel_sharding(devices):
+    """GQA x TP: the fused qkv projection has width (H + 2*Hkv)*Dh —
+    Megatron column rules must still shard it over 'model', and training
+    must match the unsharded model."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt
+    from deepspeed_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    mesh = make_mesh(MeshSpec(data=4, model=2))
+    ref_mesh = make_mesh(MeshSpec(data=4), devices=jax.devices()[:4])
+
+    def build(tp):
+        cfg = gpt.GPTConfig(vocab_size=128, n_layers=2, n_heads=8,
+                            d_model=32, max_seq_len=32, n_kv_heads=2,
+                            use_flash_attention=False, remat=False,
+                            dtype=jnp.float32)
+        params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model=gpt.make_loss_fn(cfg), model_parameters=params,
+            config={"train_batch_size": 4,
+                    "mesh": ({"data_parallel_size": 4,
+                              "tensor_parallel_size": 2} if tp
+                             else {"data_parallel_size": 4}),
+                    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                    "steps_per_print": 1000},
+            mesh=mesh if tp else ref_mesh,
+            partition_rules=gpt.gpt_partition_rules() if tp else None)
+        return eng, cfg
+
+    e_tp, cfg = build(True)
+    e_ref, _ = build(False)
+    qkv = e_tp.state.params["block"]["qkv"]["kernel"]
+    # qkv width = (8 + 2*2) * 4 = 48 -> 24 per model shard
+    assert qkv.sharding.shard_shape(qkv.shape)[-1] == cfg.qkv_dim // 2
+    data = {"tokens": np.random.default_rng(0).integers(
+        0, 128, (4, 33)).astype(np.int32)}
+    for _ in range(2):
+        l_tp = float(e_tp.train_batch(data)["loss"])
+        l_ref = float(e_ref.train_batch(data)["loss"])
+        np.testing.assert_allclose(l_tp, l_ref, rtol=1e-4)
