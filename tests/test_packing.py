@@ -8,8 +8,6 @@ kernel parity model per SURVEY §4 (fused op vs pure-jnp baseline).
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
-
 from deepspeed_tpu.models import gpt
 from deepspeed_tpu.ops.attention import flash as F
 
@@ -109,24 +107,32 @@ def test_packed_chunked_ce_matches_dense(devices):
                                rtol=1e-5, atol=1e-6)
 
 
-def test_segment_ids_with_sp_raises(devices):
-    """Packing + ACTIVE sequence parallelism (mesh set) is rejected; with
-    mesh=None SP is inert and packing must keep working."""
+def test_segment_ids_with_sp_matches_dense(devices):
+    """Packing + ACTIVE sequence parallelism composes (the ring rotates
+    per-token metadata with its K/V block): _attention under ring SP with
+    segment_ids must match the dense local path exactly. With mesh=None
+    SP is inert and packing keeps working through the local path."""
+    import dataclasses
     from deepspeed_tpu.parallel.mesh import MeshSpec, make_mesh
     mesh = make_mesh(MeshSpec(sequence=4, data=-1))
     cfg = gpt.GPTConfig(vocab_size=32, n_layers=1, n_heads=2, d_model=16,
                         max_seq_len=16, dtype=jnp.float32,
                         use_flash_attention=False, remat=False,
                         sequence_parallel=True, mesh=mesh)
-    q = jnp.zeros((1, 8, 2, 8), jnp.float32)
-    segs = jnp.zeros((1, 8), jnp.int32)
-    with pytest.raises(NotImplementedError):
-        gpt._attention(q, q, q, cfg, segment_ids=segs)
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q, k, v = (jax.random.normal(kk, (1, 8, 2, 8), jnp.float32)
+               for kk in ks)
+    # uneven 3/5 split: the boundary falls INSIDE shard 1 (tokens 2-3),
+    # so within-shard mixed-segment masking is exercised, not just the
+    # rotated-block case
+    segs = jnp.asarray(np.array([0, 0, 0, 1, 1, 1, 1, 1])[None], jnp.int32)
+    out_sp = gpt._attention(q, k, v, cfg, segment_ids=segs)
     # inert SP (no mesh): packing works through the local path
-    import dataclasses
     cfg0 = dataclasses.replace(cfg, mesh=None)
-    out = gpt._attention(q, q, q, cfg0, segment_ids=segs)
-    assert out.shape == q.shape
+    out_local = gpt._attention(q, k, v, cfg0, segment_ids=segs)
+    assert out_sp.shape == q.shape
+    np.testing.assert_allclose(np.asarray(out_sp), np.asarray(out_local),
+                               rtol=1e-5, atol=1e-6)
 
 
 def test_pack_documents_roundtrip(devices):
