@@ -243,12 +243,8 @@ def _flash_blocks(cfg: GPTConfig, seq_len: int):
     bkv = _effective_block(cfg.flash_block_kv, seq_len)
     if bq is None or bkv is None:
         return None
-    try:
-        d = jax.devices()[0]
-        on_tpu = "tpu" in (d.platform + d.device_kind).lower()
-    except Exception:
-        return None
-    return (bq, bkv) if on_tpu else None
+    from deepspeed_tpu.utils import on_tpu
+    return (bq, bkv) if on_tpu() else None
 
 
 def _flash_eligible(cfg: GPTConfig, seq_len: int) -> bool:
@@ -284,11 +280,17 @@ def _attention(q, k, v, cfg: GPTConfig, segment_ids=None, kv_mask=None):
             raise ValueError(f"unknown sp_impl {cfg.sp_impl!r} "
                              "(expected 'ring' or 'ulysses')")
         from deepspeed_tpu.ops.attention.ring import ring_attention
-        # packing/padding metadata rotates with the K/V blocks; window
-        # is masked exactly (the DMA-elision fast path is single-chip)
-        return ring_attention(q, k, v, cfg.mesh, causal=True, scale=scale,
-                              segment_ids=segment_ids, kv_mask=kv_mask,
-                              window=cfg.attn_window)
+        # packing/padding metadata rotates with the K/V blocks; the local
+        # block runs the Pallas flash kernel when eligible (gated on the
+        # LOCAL shard length — that is what the kernel sees per step)
+        S_loc = q.shape[1] // cfg.mesh.shape["sequence"]
+        blocks = _flash_blocks(cfg, S_loc)
+        return ring_attention(
+            q, k, v, cfg.mesh, causal=True, scale=scale,
+            segment_ids=segment_ids, kv_mask=kv_mask,
+            window=cfg.attn_window, use_flash=blocks is not None,
+            block_q=blocks[0] if blocks else 512,
+            block_kv=blocks[1] if blocks else 512)
     blocks = _flash_blocks(cfg, q.shape[1])
     if blocks is not None:
         from deepspeed_tpu.ops.attention.flash import flash_attention

@@ -37,7 +37,7 @@ def _ceil_to(x, m):
     return (x + m - 1) // m * m
 
 
-def _causal_kv_index_map(block_q, block_kv, num_kv, window=None):
+def _causal_kv_index_map(block_q, block_kv, num_kv, window=None, q_off=0):
     """Block index map for KV-blocked inputs when the grid is
     (b, h, q-block, kv-block) and causal skipping applies: skipped
     above-diagonal steps re-map to the last valid KV block, so the index
@@ -46,14 +46,20 @@ def _causal_kv_index_map(block_q, block_kv, num_kv, window=None):
 
     With a sliding ``window``, blocks fully BELOW the band (ki too small)
     clamp up to the first in-band block — their fetches elide the same
-    way, making windowed attention O(S*W) in HBM reads as well."""
+    way, making windowed attention O(S*W) in HBM reads as well.
+
+    ``q_off`` is a STATIC global q-position offset: ring attention calls
+    the kernel with q rows that globally sit ``q_off`` tokens after the
+    held K/V block's first key (the ring-step distance is static once
+    the ring loop is unrolled), so all causal/window geometry shifts by
+    it."""
 
     def kvmap(b, h, qi, ki):
-        limit = jnp.minimum((qi * block_q + block_q - 1) // block_kv,
+        limit = jnp.minimum((qi * block_q + block_q - 1 + q_off) // block_kv,
                             num_kv - 1)
         ki = jnp.minimum(ki, limit)
         if window is not None:
-            lo = jnp.clip((qi * block_q - window + 1) // block_kv,
+            lo = jnp.clip((qi * block_q + q_off - window + 1) // block_kv,
                           0, num_kv - 1)
             ki = jnp.maximum(ki, lo)
         return (b, h, ki, 0)
@@ -61,15 +67,16 @@ def _causal_kv_index_map(block_q, block_kv, num_kv, window=None):
     return kvmap
 
 
-def _band_run(qi, ki, block_q, block_kv, causal, window):
+def _band_run(qi, ki, block_q, block_kv, causal, window, q_off=0):
     """Whether grid step (qi, ki) intersects the attention band."""
     run = True
     if causal:
-        run = qi * block_q + block_q - 1 >= ki * block_kv
+        run = qi * block_q + block_q - 1 + q_off >= ki * block_kv
     if window is not None:
         # lowest q row of the block must still reach the block's last col
         run = jnp.logical_and(
-            run, ki * block_kv + block_kv - 1 >= qi * block_q - window + 1)
+            run,
+            ki * block_kv + block_kv - 1 >= qi * block_q + q_off - window + 1)
     return run
 
 
@@ -84,7 +91,8 @@ def _window_mask(s, rows, cols, window):
 
 def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
                 causal: bool, has_mask: bool, has_segs: bool, scale: float,
-                block_q: int, block_kv: int, num_kv: int, window=None):
+                block_q: int, block_kv: int, num_kv: int, window=None,
+                q_off: int = 0):
     rest = list(rest)
     mask_ref = rest.pop(0) if has_mask else None
     qseg_ref = rest.pop(0) if has_segs else None
@@ -99,7 +107,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
         l_scratch[:] = jnp.zeros_like(l_scratch)
         acc_scratch[:] = jnp.zeros_like(acc_scratch)
 
-    run = _band_run(qi, ki, block_q, block_kv, causal, window)
+    run = _band_run(qi, ki, block_q, block_kv, causal, window, q_off)
 
     @pl.when(run)
     def _body():
@@ -111,7 +119,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
             preferred_element_type=jnp.float32) * scale   # [bq, bkv]
 
         if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + qi * block_q
+            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) \
+                + qi * block_q + q_off
             cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ki * block_kv
             s = jnp.where(rows >= cols, s, NEG_INF)
             if window is not None:
@@ -177,8 +186,8 @@ def _group_head(map_fn, group: int):
     return wrapped
 
 
-def _flash_fwd(q, k, v, mask, segs, causal, scale, block_q, block_kv,
-               window=None):
+def _flash_fwd(q, k, v, mask, qsegs, ksegs, causal, scale, block_q, block_kv,
+               window=None, q_off=0):
     # arrays are [B, H, S, D] inside the op (wrapper transposes)
     B, H, S, D = q.shape
     Skv = k.shape[2]
@@ -193,7 +202,7 @@ def _flash_fwd(q, k, v, mask, segs, causal, scale, block_q, block_kv,
         return (b, h, qi, 0)
 
     if causal:
-        kvmap = _causal_kv_index_map(block_q, block_kv, num_kv, window)
+        kvmap = _causal_kv_index_map(block_q, block_kv, num_kv, window, q_off)
     else:
         def kvmap(b, h, qi, ki):
             return (b, h, ki, 0)
@@ -201,11 +210,12 @@ def _flash_fwd(q, k, v, mask, segs, causal, scale, block_q, block_kv,
 
     grid = (B, H, num_q, num_kv)
     has_mask = mask is not None
-    has_segs = segs is not None
+    has_segs = qsegs is not None
+    assert (qsegs is None) == (ksegs is None)
     kernel = functools.partial(
         _fwd_kernel, causal=causal, has_mask=has_mask, has_segs=has_segs,
         scale=scale, block_q=block_q, block_kv=block_kv, num_kv=num_kv,
-        window=window)
+        window=window, q_off=q_off)
 
     in_specs = [
         pl.BlockSpec((1, 1, block_q, D), qmap),
@@ -219,7 +229,7 @@ def _flash_fwd(q, k, v, mask, segs, causal, scale, block_q, block_kv,
     if has_segs:
         in_specs.append(_qseg_spec(block_q, qmap))
         in_specs.append(_mask_spec(block_kv, kvmap))   # kv-side segments
-        operands.extend([segs, segs])
+        operands.extend([qsegs, ksegs])
 
     out_shape = [
         jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
@@ -252,7 +262,7 @@ def _flash_fwd(q, k, v, mask, segs, causal, scale, block_q, block_kv,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     *rest, causal: bool, has_mask: bool, has_segs: bool,
                     scale: float, block_q: int, block_kv: int, num_q: int,
-                    window=None):
+                    window=None, q_off: int = 0):
     rest = list(rest)
     mask_ref = rest.pop(0) if has_mask else None
     qseg_ref = rest.pop(0) if has_segs else None
@@ -266,7 +276,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scratch[:] = jnp.zeros_like(dk_scratch)
         dv_scratch[:] = jnp.zeros_like(dv_scratch)
 
-    run = _band_run(qi, ki, block_q, block_kv, causal, window)
+    run = _band_run(qi, ki, block_q, block_kv, causal, window, q_off)
 
     @pl.when(run)
     def _body():
@@ -280,7 +290,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + qi * block_q
+            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) \
+                + qi * block_q + q_off
             cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ki * block_kv
             s = jnp.where(rows >= cols, s, NEG_INF)
             if window is not None:
@@ -314,7 +325,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    *rest, causal: bool, has_mask: bool, has_segs: bool,
                    scale: float, block_q: int, block_kv: int, num_kv: int,
-                   window=None):
+                   window=None, q_off: int = 0):
     rest = list(rest)
     mask_ref = rest.pop(0) if has_mask else None
     qseg_ref = rest.pop(0) if has_segs else None
@@ -327,7 +338,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_scratch[:] = jnp.zeros_like(dq_scratch)
 
-    run = _band_run(qi, ki, block_q, block_kv, causal, window)
+    run = _band_run(qi, ki, block_q, block_kv, causal, window, q_off)
 
     @pl.when(run)
     def _body():
@@ -341,7 +352,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + qi * block_q
+            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) \
+                + qi * block_q + q_off
             cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ki * block_kv
             s = jnp.where(rows >= cols, s, NEG_INF)
             if window is not None:
@@ -364,8 +376,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0, 0] = dq_scratch[:].astype(dq_ref.dtype)
 
 
-def _flash_bwd(causal, scale, block_q, block_kv, window, res, g):
-    q, k, v, mask, segs, o, lse = res
+def _flash_bwd(causal, scale, block_q, block_kv, window, res, g, q_off=0,
+               delta=None, out_fp32=False):
+    """out_fp32: emit fp32 grads (ring accumulates per-step contributions
+    across hops — rounding each to the input dtype first would compound
+    quantization noise; the custom-vjp path keeps input-dtype cotangents
+    as jax requires). res's ``o`` may be None when ``delta`` is given."""
+    q, k, v, mask, qsegs, ksegs, o, lse = res
     do = g
     B, H, S, D = q.shape
     Skv = k.shape[2]
@@ -377,10 +394,12 @@ def _flash_bwd(causal, scale, block_q, block_kv, window, res, g):
     num_q = S // block_q
     num_kv = Skv // block_kv
     has_mask = mask is not None
-    has_segs = segs is not None
+    has_segs = qsegs is not None
+    assert (qsegs is None) == (ksegs is None)
 
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1)                                  # [B,H,S]
+    if delta is None:
+        delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                        axis=-1)                              # [B,H,S]
     lse_b = jnp.broadcast_to(lse[..., None], (B, H, S, STATS))
     delta_b = jnp.broadcast_to(delta[..., None], (B, H, S, STATS))
 
@@ -389,7 +408,7 @@ def _flash_bwd(causal, scale, block_q, block_kv, window, res, g):
 
     if causal:
         kvmap_q_outer = _causal_kv_index_map(block_q, block_kv, num_kv,
-                                             window)
+                                             window, q_off)
     else:
         def kvmap_q_outer(b, h, i, j):
             return (b, h, j, 0)
@@ -411,17 +430,18 @@ def _flash_bwd(causal, scale, block_q, block_kv, window, res, g):
     if has_segs:
         in_specs.append(_qseg_spec(block_q, qmap))
         in_specs.append(_mask_spec(block_kv, kvmap_q_outer))
-        operands.extend([segs, segs])
+        operands.extend([qsegs, ksegs])
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, has_mask=has_mask,
                           has_segs=has_segs,
                           scale=scale, block_q=block_q, block_kv=block_kv,
-                          num_kv=num_kv, window=window),
+                          num_kv=num_kv, window=window, q_off=q_off),
         grid=(B, H, num_q, num_kv),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, block_q, D), qmap),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
-        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct(
+            (B, H, S, D), jnp.float32 if out_fp32 else q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
     )(*operands)
@@ -438,12 +458,14 @@ def _flash_bwd(causal, scale, block_q, block_kv, window, res, g):
         # valid q block is bounded too — late steps clamp down the same
         # way.
         def qmap_kv_outer(b, h, ki, qi):
-            first = jnp.minimum((ki * block_kv) // block_q, num_q - 1)
+            first = jnp.clip((ki * block_kv - q_off) // block_q,
+                             0, num_q - 1)
             qi = jnp.maximum(qi, first)
             if window is not None:
-                last = jnp.minimum(
-                    (ki * block_kv + block_kv - 1 + window - 1) // block_q,
-                    num_q - 1)
+                last = jnp.clip(
+                    (ki * block_kv + block_kv - 1 + window - 1 - q_off)
+                    // block_q,
+                    0, num_q - 1)
                 qi = jnp.minimum(qi, last)
             return (b, h, qi, 0)
     else:
@@ -468,12 +490,12 @@ def _flash_bwd(causal, scale, block_q, block_kv, window, res, g):
     if has_segs:
         in_specs.append(_qseg_spec(block_q, qmap_kv_outer))
         in_specs.append(_mask_spec(block_kv, kvmap))
-        operands.extend([segs, segs])
+        operands.extend([qsegs, ksegs])
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, causal=causal, has_mask=has_mask,
                           has_segs=has_segs,
                           scale=scale, block_q=block_q, block_kv=block_kv,
-                          num_q=num_q, window=window),
+                          num_q=num_q, window=window, q_off=q_off),
         grid=(B, H, num_kv, num_q),
         in_specs=in_specs,
         out_specs=[
@@ -487,10 +509,12 @@ def _flash_bwd(causal, scale, block_q, block_kv, window, res, g):
         out_shape=[
             # GQA partials stay fp32 so the cross-head reduction below
             # accumulates at full precision (cast once after the sum)
-            jax.ShapeDtypeStruct((B, H, Skv, D),
-                                 jnp.float32 if group > 1 else k.dtype),
-            jax.ShapeDtypeStruct((B, H, Skv, D),
-                                 jnp.float32 if group > 1 else v.dtype),
+            jax.ShapeDtypeStruct(
+                (B, H, Skv, D),
+                jnp.float32 if (group > 1 or out_fp32) else k.dtype),
+            jax.ShapeDtypeStruct(
+                (B, H, Skv, D),
+                jnp.float32 if (group > 1 or out_fp32) else v.dtype),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
@@ -501,8 +525,10 @@ def _flash_bwd(causal, scale, block_q, block_kv, window, res, g):
         # across q heads can't happen inside the kernel (h is a parallel
         # grid dim), so reduce the group outside
         Hkv = H // group
-        dk = dk.reshape(B, Hkv, group, Skv, D).sum(2).astype(k.dtype)
-        dv = dv.reshape(B, Hkv, group, Skv, D).sum(2).astype(v.dtype)
+        kd = jnp.float32 if out_fp32 else k.dtype
+        vd = jnp.float32 if out_fp32 else v.dtype
+        dk = dk.reshape(B, Hkv, group, Skv, D).sum(2).astype(kd)
+        dv = dv.reshape(B, Hkv, group, Skv, D).sum(2).astype(vd)
     return dq, dk, dv
 
 
@@ -510,17 +536,18 @@ def _flash_bwd(causal, scale, block_q, block_kv, window, res, g):
 # public op
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
-def _flash(q, k, v, mask, segs, causal, scale, block_q, block_kv,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11, 12))
+def _flash(q, k, v, mask, qsegs, ksegs, causal, scale, block_q, block_kv,
            window=None, bwd_block_q=None, bwd_block_kv=None):
-    o, _ = _flash_fwd(q, k, v, mask, segs, causal, scale, block_q, block_kv,
-                      window)
+    o, _ = _flash_fwd(q, k, v, mask, qsegs, ksegs, causal, scale, block_q,
+                      block_kv, window)
     return o
 
 
-def _flash_vjp_fwd(q, k, v, mask, segs, causal, scale, block_q, block_kv,
-                   window=None, bwd_block_q=None, bwd_block_kv=None):
-    o, lse = _flash_fwd(q, k, v, mask, segs, causal, scale, block_q,
+def _flash_vjp_fwd(q, k, v, mask, qsegs, ksegs, causal, scale, block_q,
+                   block_kv, window=None, bwd_block_q=None,
+                   bwd_block_kv=None):
+    o, lse = _flash_fwd(q, k, v, mask, qsegs, ksegs, causal, scale, block_q,
                         block_kv, window)
     # named so a selective remat policy can keep the residuals — without
     # these, jax.checkpoint re-runs the whole forward kernel in the backward
@@ -532,12 +559,12 @@ def _flash_vjp_fwd(q, k, v, mask, segs, causal, scale, block_q, block_kv,
     o_res = o.transpose(0, 2, 1, 3).reshape(B, S, H * D)
     o_res = checkpoint_name(o_res, "flash_out")
     lse = checkpoint_name(lse, "flash_lse")
-    return o, (q, k, v, mask, segs, o_res, lse)
+    return o, (q, k, v, mask, qsegs, ksegs, o_res, lse)
 
 
 def _flash_vjp_bwd(causal, scale, block_q, block_kv, window, bwd_block_q,
                    bwd_block_kv, res, g):
-    q, k, v, mask, segs, o_res, lse = res
+    q, k, v, mask, qsegs, ksegs, o_res, lse = res
     B, H, S, D = q.shape
     o = o_res.reshape(B, S, H, D).transpose(0, 2, 1, 3)
     # the dq/dkv kernels have different reuse patterns than the forward
@@ -545,8 +572,8 @@ def _flash_vjp_bwd(causal, scale, block_q, block_kv, window, bwd_block_q,
     # their tiles independently of the fwd blocks
     dq, dk, dv = _flash_bwd(causal, scale, bwd_block_q or block_q,
                             bwd_block_kv or block_kv, window,
-                            (q, k, v, mask, segs, o, lse), g)
-    return dq, dk, dv, None, None
+                            (q, k, v, mask, qsegs, ksegs, o, lse), g)
+    return dq, dk, dv, None, None, None
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -602,12 +629,7 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if window is not None:
         assert causal, "sliding window attention requires causal=True"
         assert window >= 1
-    Dp = D if D % 8 == 0 else _ceil_to(D, 8)
-    if Dp != D:
-        pad = [(0, 0), (0, 0), (0, 0), (0, Dp - D)]
-        q = jnp.pad(q, pad)
-        k = jnp.pad(k, pad)
-        v = jnp.pad(v, pad)
+    q, k, v, D, Dp = _pad_heads(q, k, v)
     # kernel-internal layout is [B, H, S, D]
     q = q.transpose(0, 2, 1, 3)
     k = k.transpose(0, 2, 1, 3)
@@ -616,12 +638,120 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         kv_mask = kv_mask.astype(jnp.float32)
     if segment_ids is not None:
         segment_ids = segment_ids.astype(jnp.int32)
-    out = _flash(q, k, v, kv_mask, segment_ids, causal, scale,
+    out = _flash(q, k, v, kv_mask, segment_ids, segment_ids, causal, scale,
                  block_q, block_kv, window, bwd_block_q, bwd_block_kv)
     out = out.transpose(0, 2, 1, 3)
     if Dp != D:
         out = out[..., :D]
     return out
+
+
+# ---------------------------------------------------------------------------
+# block-level entry points (ring attention building blocks)
+# ---------------------------------------------------------------------------
+
+def flash_block_fwd_t(q, k, v, kv_mask=None, q_segs=None, kv_segs=None, *,
+                      causal=True, scale, block_q=512, block_kv=512,
+                      window=None, q_off=0):
+    """Kernel-layout ([B, H, S, D], D sublane-aligned) variant of
+    :func:`flash_block_fwd` — no per-call pad/transpose, so a ring loop
+    can hoist the layout change out of its steps. Returns (o [B,H,S,D],
+    lse [B,H,S]). Not differentiable (ring owns the VJP)."""
+    return _flash_fwd(q, k, v, kv_mask, q_segs, kv_segs, causal, scale,
+                      block_q, block_kv, window, q_off)
+
+
+def flash_block_bwd_t(q, k, v, do, lse, kv_mask=None, q_segs=None,
+                      kv_segs=None, *, causal=True, scale, block_q=512,
+                      block_kv=512, window=None, q_off=0, delta, o=None):
+    """Kernel-layout backward companion of :func:`flash_block_fwd_t`;
+    ``delta`` (= rowsum(do*o), [B,H,S]) is precomputed ONCE per ring
+    backward, so ``o`` is not needed (pass it only if delta were ever
+    recomputed here). Returns fp32 (dq, dk, dv) in [B,H,S,D] — the ring
+    sums per-step contributions across hops and must not round each to
+    the input dtype first."""
+    return _flash_bwd(causal, scale, block_q, block_kv, window,
+                      (q, k, v, kv_mask, q_segs, kv_segs, o, lse),
+                      do, q_off, delta, out_fp32=True)
+
+
+def _pad_heads(q, k, v):
+    D = q.shape[-1]
+    Dp = D if D % 8 == 0 else _ceil_to(D, 8)
+    if Dp != D:
+        pad = [(0, 0), (0, 0), (0, 0), (0, Dp - D)]
+        q, k, v = jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad)
+    return q, k, v, D, Dp
+
+
+def flash_block_fwd(q, k, v, kv_mask=None, q_segs=None, kv_segs=None, *,
+                    causal=True, scale=None, block_q=512, block_kv=512,
+                    window=None, q_off=0):
+    """One flash forward over [B, S, H, D] tensors, returning BOTH the
+    normalized output and the per-row logsumexp: ``(o [B,S,H,D],
+    lse [B,H,S])``.
+
+    NOT differentiable — ring attention (ops/attention/ring.py) calls
+    this per held K/V block inside its own custom VJP and combines the
+    per-block (o, lse) pairs with an online softmax across ring steps.
+    ``q_off`` is the static global position of q row 0 relative to key 0
+    of this block (the ring-step distance x S_local); q-side and kv-side
+    segment ids are separate because the kv metadata rotates with its
+    block."""
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    q, k, v, D, Dp = _pad_heads(q, k, v)
+    q = q.transpose(0, 2, 1, 3)
+    k = k.transpose(0, 2, 1, 3)
+    v = v.transpose(0, 2, 1, 3)
+    if kv_mask is not None:
+        kv_mask = kv_mask.astype(jnp.float32)
+    if q_segs is not None:
+        q_segs = q_segs.astype(jnp.int32)
+        kv_segs = kv_segs.astype(jnp.int32)
+    o, lse = _flash_fwd(q, k, v, kv_mask, q_segs, kv_segs, causal, scale,
+                        block_q, block_kv, window, q_off)
+    o = o.transpose(0, 2, 1, 3)
+    if Dp != D:
+        o = o[..., :D]
+    return o, lse
+
+
+def flash_block_bwd(q, k, v, do, o, lse, kv_mask=None, q_segs=None,
+                    kv_segs=None, *, causal=True, scale=None, block_q=512,
+                    block_kv=512, window=None, q_off=0):
+    """Backward companion of :func:`flash_block_fwd`: given the global
+    ``lse`` (combined across ring steps) and the global output ``o``,
+    returns this block's additive contribution ``(dq, dk, dv)`` in
+    [B, S, H, D] layout. Per-block contributions with a shared lse/delta
+    sum to the exact softmax gradient (FA2 recompute form)."""
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    q, k, v, D, Dp = _pad_heads(q, k, v)
+    # pad do/o the same way (zero lanes contribute nothing to delta)
+    if Dp != D:
+        pad = [(0, 0), (0, 0), (0, 0), (0, Dp - D)]
+        do = jnp.pad(do, pad)
+        o = jnp.pad(o, pad)
+    q = q.transpose(0, 2, 1, 3)
+    k = k.transpose(0, 2, 1, 3)
+    v = v.transpose(0, 2, 1, 3)
+    do = do.transpose(0, 2, 1, 3)
+    o = o.transpose(0, 2, 1, 3)
+    if kv_mask is not None:
+        kv_mask = kv_mask.astype(jnp.float32)
+    if q_segs is not None:
+        q_segs = q_segs.astype(jnp.int32)
+        kv_segs = kv_segs.astype(jnp.int32)
+    dq, dk, dv = _flash_bwd(causal, scale, block_q, block_kv, window,
+                            (q, k, v, kv_mask, q_segs, kv_segs, o, lse),
+                            do, q_off)
+    dq = dq.transpose(0, 2, 1, 3)
+    dk = dk.transpose(0, 2, 1, 3)
+    dv = dv.transpose(0, 2, 1, 3)
+    if Dp != D:
+        dq, dk, dv = dq[..., :D], dk[..., :D], dv[..., :D]
+    return dq, dk, dv
 
 
 def mha_reference(q, k, v, causal=True, scale=None, kv_mask=None,

@@ -1,4 +1,4 @@
-"""Ring attention — sequence/context parallelism over ICI.
+"""Ring attention — sequence/context parallelism over ICI, flash-grade.
 
 The reference version has NO sequence parallelism (SURVEY §2.2: absent at
 v0.6.4; its long-sequence story is block-sparse attention). This module is
@@ -8,17 +8,42 @@ north star: exact attention over sequences sharded across chips.
 Design (Ring Attention / blockwise attention):
 - the sequence dim of Q, K, V is sharded over the 'sequence' mesh axis;
 - each device computes attention of its local Q block against the K/V
-  block it currently holds, maintaining online-softmax running stats
-  (max, sum, accumulator) exactly like flash attention;
+  block it currently holds; per-block results carry their logsumexp and
+  are combined across ring steps with an online softmax — exactly the
+  flash-attention recurrence lifted one level up;
 - K/V blocks rotate around the ring via `lax.ppermute` each step, so after
-  n_seq steps every Q block has seen every K/V block; peak memory is
-  O(S/n) per chip and the rotation overlaps with compute via XLA's
-  latency-hiding scheduler;
+  n_seq steps every Q block has seen every K/V block; the rotation
+  overlaps with compute via XLA's latency-hiding scheduler;
+- the LOCAL block computation is the Pallas flash kernel
+  (ops/attention/flash.py `flash_block_fwd_t/bwd_t`, kernel layout held
+  across the whole loop so q/do/o are padded+transposed once, not per
+  step) on TPU, and a chunked online-softmax in plain jnp elsewhere —
+  peak local memory is O(S_loc · block), never the O(S_loc²) dense score
+  matrix;
+- the ring loop is UNROLLED (the ring size is static), so each step's
+  mask geometry is static too: step 0 is ordinary causal attention,
+  step i ≥ 1 sees a K/V block exactly i·S_loc tokens behind its queries
+  — causality is automatic there, and a sliding window becomes a band
+  at a static offset the kernel's index maps can elide DMAs for.
+  Steps whose band is statically empty are dropped entirely, so causal
+  sliding-window ring attention does ceil((w+S_loc-1)/S_loc) hops, not
+  n_seq;
+- a module-level `jax.custom_vjp` replays the rotation schedule in the
+  backward pass (dk/dv accumulators travel WITH their K/V block and are
+  delivered home over whichever direction is fewer hops), so reverse-mode
+  never materializes per-step dense residuals from scan transposition;
 - causal masking uses global token positions, so the result is exactly
-  standard causal attention.
+  standard causal attention; per-token metadata (packed segment ids /
+  key-validity) ROTATES with its K/V block, so packing and padding masks
+  are exact under the ring.
+
+Contract for degenerate rows: a row with NO valid visible key anywhere
+returns exact 0 (the dense single-chip path returns a uniform average of
+v instead — both are garbage-by-contract; any masked loss zeroes their
+gradient).
 """
 
-from functools import partial
+import functools
 from typing import Optional
 
 import jax
@@ -26,77 +51,354 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from deepspeed_tpu.ops.attention.flash import (
+    NEG_INF, _pad_heads, flash_block_bwd_t, flash_block_fwd_t)
 
-def _ring_attention_local(q, k, v, segs, kvm, *, axis: str, causal: bool,
-                          scale: float, window: Optional[int]):
-    """Inside shard_map: q local [B, S_loc, H, D]; k/v may carry Hkv < H
-    heads (GQA) — the SMALL grouped k/v rotate around the ring (the
-    ICI-traffic win scales with the group factor) and are repeated
-    locally per step for the einsum. segs/kvm: [B, S_loc] per-token
-    metadata (packed segment ids / key-validity) that ROTATES with its
-    K/V block — each step masks scores against the metadata of the block
-    currently held, so packing and padding masks are exact under the
-    ring. Returns [B, S_loc, H, D]."""
+
+def _largest_divisor(n: int, cap: int) -> int:
+    """Largest divisor of ``n`` that is <= cap (cap >= 1)."""
+    for c in range(min(cap, n), 0, -1):
+        if n % c == 0:
+            return c
+    return 1
+
+
+def _num_steps(n: int, S_loc: int, causal: bool, window) -> int:
+    """Ring hops that can ever intersect the attention band. For causal
+    sliding-window attention, block i's closest key is i*S_loc - (S_loc-1)
+    tokens behind the query — once that is >= window the step is dead for
+    EVERY device and the rotation chain stops early."""
+    if causal and window is not None:
+        return min(n, -(-(window + S_loc - 1) // S_loc))
+    return n
+
+
+def _step_cfg(i: int, S_loc: int, causal: bool, window):
+    """Static mask geometry of ring step i: (causal, q_off, window) for
+    the local block call. Step 0 is self-attention; step i >= 1 sees keys
+    exactly i*S_loc tokens behind every query, so causality is automatic
+    (mask-free) unless a sliding window cuts a band through the block."""
+    if not causal:
+        return False, 0, None
+    if i == 0:
+        return True, 0, window
+    off = i * S_loc
+    if window is None or off + S_loc - 1 < window:
+        return False, 0, None       # fully in band: no masking at all
+    return True, off, window
+
+
+# ---------------------------------------------------------------------------
+# local block compute (jnp fallback: chunked online softmax)
+# ---------------------------------------------------------------------------
+
+def _mask_scores(s, rows, cols, blk_causal, window, qsegs, ksegs, kvm):
+    """Apply causal/window/segment/validity masks to [B, H, Sq, c]."""
+    if blk_causal:
+        m = rows[None, None, :, None] >= cols[None, None, None, :]
+        if window is not None:
+            m = jnp.logical_and(
+                m, rows[None, None, :, None] - cols[None, None, None, :]
+                < window)
+        s = jnp.where(m, s, NEG_INF)
+    if qsegs is not None:
+        same = qsegs[:, None, :, None] == ksegs[:, None, None, :]
+        s = jnp.where(same, s, NEG_INF)
+    if kvm is not None:
+        s = jnp.where(kvm[:, None, None, :] > 0, s, NEG_INF)
+    return s
+
+
+def _chunk_scores(qf, k, v, qsegs, ksegs, kvm, j, c, *, rows, group,
+                  blk_causal, window, scale):
+    """Shared fwd/bwd chunk prologue: slice chunk j of the held K/V block
+    (+ its rotated metadata), repeat GQA groups, compute masked scores.
+    The q-position offset is already baked into ``rows`` by the caller.
+    Returns (s [B,H,Sq,c] fp32, kj, vj [B,c,H,D])."""
+    kj = jax.lax.dynamic_slice_in_dim(k, j * c, c, axis=1)
+    vj = jax.lax.dynamic_slice_in_dim(v, j * c, c, axis=1)
+    if group > 1:
+        kj = jnp.repeat(kj, group, axis=2)
+        vj = jnp.repeat(vj, group, axis=2)
+    ksj = (None if ksegs is None else
+           jax.lax.dynamic_slice_in_dim(ksegs, j * c, c, axis=1))
+    kvj = (None if kvm is None else
+           jax.lax.dynamic_slice_in_dim(kvm, j * c, c, axis=1))
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kj.astype(jnp.float32)) * scale
+    cols = j * c + jnp.arange(c, dtype=jnp.int32)
+    s = _mask_scores(s, rows, cols, blk_causal, window, qsegs, ksj, kvj)
+    return s, kj, vj
+
+
+def _jnp_block_fwd(q, k, v, qsegs, ksegs, kvm, *, blk_causal, window,
+                   q_off, scale, chunk):
+    """Chunked online-softmax attention of local q [B,S,H,D] against one
+    K/V block. Peak memory O(B·H·S·chunk) instead of the dense
+    O(B·H·S·S_kv). Returns (o [B,H,S,D] in q.dtype, lse [B,H,S] fp32)."""
+    B, S, H, D = q.shape
+    Skv, Hkv = k.shape[1], k.shape[2]
+    group = H // Hkv
+    c = _largest_divisor(Skv, chunk)
+    nc = Skv // c
+    qf = q.astype(jnp.float32)
+    rows = q_off + jnp.arange(S, dtype=jnp.int32)
+    prolog = functools.partial(
+        _chunk_scores, qf, k, v, qsegs, ksegs, kvm, c=c, rows=rows,
+        group=group, blk_causal=blk_causal, window=window, scale=scale)
+
+    def step(carry, j):
+        m, l, acc = carry
+        s, _, vj = prolog(j=j)
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_cur)
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, vj.astype(jnp.float32))
+        return (m_new, l_new, acc_new), None
+
+    m0 = jnp.full((B, H, S), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, S), jnp.float32)
+    acc0 = jnp.zeros((B, H, S, D), jnp.float32)
+    (m, l, acc), _ = jax.lax.scan(step, (m0, l0, acc0),
+                                  jnp.arange(nc, dtype=jnp.int32))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o = (acc / l_safe[..., None]).astype(q.dtype)          # [B,H,S,D]
+    lse = m + jnp.log(l_safe)
+    return o, lse
+
+
+def _jnp_block_bwd(q, k, v, do, lse, delta, qsegs, ksegs, kvm, *,
+                   blk_causal, window, q_off, scale, chunk):
+    """This block's additive (dq, dk, dv) contribution given the GLOBAL
+    lse [B,H,S] and delta [B,H,S] (= rowsum(do*o)). Chunked like the
+    forward. Returns fp32 (dq [B,H,S,D], dk/dv [B,Hkv,Skv,D])."""
+    B, S, H, D = q.shape
+    Skv, Hkv = k.shape[1], k.shape[2]
+    group = H // Hkv
+    c = _largest_divisor(Skv, chunk)
+    nc = Skv // c
+    qf = q.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    rows = q_off + jnp.arange(S, dtype=jnp.int32)
+    prolog = functools.partial(
+        _chunk_scores, qf, k, v, qsegs, ksegs, kvm, c=c, rows=rows,
+        group=group, blk_causal=blk_causal, window=window, scale=scale)
+
+    def step(dq_acc, j):
+        s, kj, vj = prolog(j=j)
+        p = jnp.exp(s - lse[..., None])                    # [B,H,S,c]
+        dv_j = jnp.einsum("bhqk,bqhd->bhkd", p, dof)       # [B,H,c,D]
+        dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vj.astype(jnp.float32))
+        ds = p * (dp - delta[..., None]) * scale
+        dq_acc = dq_acc + jnp.einsum("bhqk,bkhd->bhqd", ds,
+                                     kj.astype(jnp.float32))
+        dk_j = jnp.einsum("bhqk,bqhd->bhkd", ds, qf)       # [B,H,c,D]
+        if group > 1:
+            dk_j = dk_j.reshape(B, Hkv, group, c, D).sum(2)
+            dv_j = dv_j.reshape(B, Hkv, group, c, D).sum(2)
+        return dq_acc, (dk_j, dv_j)
+
+    dq0 = jnp.zeros((B, H, S, D), jnp.float32)
+    dq, (dks, dvs) = jax.lax.scan(step, dq0,
+                                  jnp.arange(nc, dtype=jnp.int32))
+    dk = jnp.moveaxis(dks, 0, 2).reshape(B, Hkv, Skv, D)
+    dv = jnp.moveaxis(dvs, 0, 2).reshape(B, Hkv, Skv, D)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# ring core (inside shard_map) with custom VJP
+# ---------------------------------------------------------------------------
+
+def _rotate(xs, axis, perm):
+    return [None if x is None else jax.lax.ppermute(x, axis, perm)
+            for x in xs]
+
+
+def _ring_fwd_inner(q, k, v, segs, kvm, axis, causal, scale, window,
+                    use_flash, block_q, block_kv, chunk):
+    n = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    B, S_loc, H, D = q.shape
+    steps = _num_steps(n, S_loc, causal, window)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    if use_flash:
+        # kernel layout once for the whole loop: [B, H, S, Dp] with the
+        # head dim sublane-padded — the K/V carry rotates transposed too
+        qp, kp, vp, D0, Dp = _pad_heads(q, k, v)
+        q_use = qp.transpose(0, 2, 1, 3)
+        k_cur = kp.transpose(0, 2, 1, 3)
+        v_cur = vp.transpose(0, 2, 1, 3)
+    else:
+        q_use, k_cur, v_cur, D0, Dp = q, k, v, D, D
+    segs_cur, kvm_cur = segs, kvm
+
+    m = jnp.full((B, H, S_loc), NEG_INF, jnp.float32)
+    l = jnp.zeros((B, H, S_loc), jnp.float32)
+    acc = jnp.zeros((B, H, S_loc, Dp), jnp.float32)
+
+    for i in range(steps):
+        blk_causal, q_off, blk_window = _step_cfg(i, S_loc, causal, window)
+
+        def compute(k_c=k_cur, v_c=v_cur, sg=segs_cur, km=kvm_cur,
+                    bc=blk_causal, off=q_off, w=blk_window):
+            if use_flash:
+                return flash_block_fwd_t(
+                    q_use, k_c, v_c, kv_mask=km, q_segs=segs, kv_segs=sg,
+                    causal=bc, scale=scale, block_q=block_q,
+                    block_kv=block_kv, window=w, q_off=off)
+            return _jnp_block_fwd(q_use, k_c, v_c, segs, sg, km,
+                                  blk_causal=bc, window=w, q_off=off,
+                                  scale=scale, chunk=chunk)
+
+        if causal and i > 0:
+            # devices "above" this step's source never see it (the block
+            # is entirely in their future) — skip the compute, not just
+            # the result. No collectives inside, so a device-varying
+            # branch is fine under shard_map.
+            o_i, lse_i = jax.lax.cond(
+                idx >= i, compute,
+                lambda: (jnp.zeros((B, H, S_loc, Dp), q.dtype),
+                         jnp.full((B, H, S_loc), NEG_INF, jnp.float32)))
+        else:
+            o_i, lse_i = compute()
+
+        m_new = jnp.maximum(m, lse_i)
+        alpha = jnp.exp(m - m_new)
+        # a block where a row has NO valid key reports lse == NEG_INF and
+        # a garbage o (uniform over its local keys, the dense-softmax
+        # degenerate form) — gate its mass to zero so rows with no valid
+        # visible key anywhere come out as exact 0 (see module contract)
+        coef = jnp.where(lse_i > NEG_INF / 2, jnp.exp(lse_i - m_new), 0.0)
+        l = l * alpha + coef
+        acc = acc * alpha[..., None] + coef[..., None] * \
+            o_i.astype(jnp.float32)
+        m = m_new
+
+        if i < steps - 1:
+            k_cur, v_cur, segs_cur, kvm_cur = _rotate(
+                [k_cur, v_cur, segs_cur, kvm_cur], axis, perm)
+
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / l_safe[..., None]).transpose(0, 2, 1, 3)[..., :D0]
+    lse = m + jnp.log(l_safe)
+    return out.astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10,
+                                                    11, 12))
+def _ring_core(q, k, v, segs, kvm, axis, causal, scale, window, use_flash,
+               block_q, block_kv, chunk):
+    out, _ = _ring_fwd_inner(q, k, v, segs, kvm, axis, causal, scale,
+                             window, use_flash, block_q, block_kv, chunk)
+    return out
+
+
+def _ring_core_fwd(q, k, v, segs, kvm, axis, causal, scale, window,
+                   use_flash, block_q, block_kv, chunk):
+    out, lse = _ring_fwd_inner(q, k, v, segs, kvm, axis, causal, scale,
+                               window, use_flash, block_q, block_kv, chunk)
+    return out, (q, k, v, segs, kvm, out, lse)
+
+
+def _ring_core_bwd(axis, causal, scale, window, use_flash, block_q,
+                   block_kv, chunk, res, g):
+    q, k, v, segs, kvm, o, lse = res
+    do = g
     n = jax.lax.axis_size(axis)
     idx = jax.lax.axis_index(axis)
     B, S_loc, H, D = q.shape
     Hkv = k.shape[2]
-    assert H % Hkv == 0, f"q heads {H} not a multiple of kv heads {Hkv}"
-    group = H // Hkv
-    qf = q.astype(jnp.float32)
+    steps = _num_steps(n, S_loc, causal, window)
+    perm = [(j, (j + 1) % n) for j in range(n)]
 
-    q_pos = idx * S_loc + jax.lax.broadcasted_iota(
-        jnp.int32, (S_loc, S_loc), 0)
+    # global per-row delta = rowsum(do * o) — shared by every block's
+    # recompute (FA2 backward identity); computed ONCE, like the layout
+    # change below (both are step-invariant)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1).transpose(0, 2, 1)            # [B, H, S_loc]
 
-    perm = [(i, (i + 1) % n) for i in range(n)]
+    if use_flash:
+        qp, kp, vp, D0, Dp = _pad_heads(q, k, v)
+        dop = _pad_heads(do, do, do)[0]
+        q_use = qp.transpose(0, 2, 1, 3)
+        k_cur = kp.transpose(0, 2, 1, 3)
+        v_cur = vp.transpose(0, 2, 1, 3)
+        do_use = dop.transpose(0, 2, 1, 3)
+    else:
+        q_use, k_cur, v_cur, do_use = q, k, v, do
+        D0, Dp = D, D
+    segs_cur, kvm_cur = segs, kvm
 
-    def step(carry, i):
-        k_cur, v_cur, segs_cur, kvm_cur, m, l, acc = carry
-        # the block currently held originated at ring position (idx - i) % n
-        src = (idx - i) % n
-        # repeat LOCALLY for the einsum; the carry (and the ppermute
-        # below) stays at the small grouped width
-        k_use = jnp.repeat(k_cur, group, axis=2) if group > 1 else k_cur
-        v_use = jnp.repeat(v_cur, group, axis=2) if group > 1 else v_cur
-        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_use.astype(jnp.float32)) * scale
-        if causal:
-            k_pos = src * S_loc + jax.lax.broadcasted_iota(
-                jnp.int32, (S_loc, S_loc), 1)
-            mask = q_pos[None, None] >= k_pos[None, None]
-            if window is not None:
-                mask = jnp.logical_and(
-                    mask, q_pos[None, None] - k_pos[None, None] < window)
-            s = jnp.where(mask, s, -1e30)
-        if segs_cur is not None:
-            same = segs[:, None, :, None] == segs_cur[:, None, None, :]
-            s = jnp.where(same, s, -1e30)
-        if kvm_cur is not None:
-            s = jnp.where(kvm_cur[:, None, None, :] > 0, s, -1e30)
-        m_cur = jnp.max(s, axis=-1, keepdims=True)            # [B,H,Sq,1]
-        m_new = jnp.maximum(m, m_cur)
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        pv = jnp.einsum("bhqk,bkhd->bhqd", p, v_use.astype(jnp.float32))
-        acc_new = acc * alpha.transpose(0, 1, 2, 3) + pv
-        k_nxt = jax.lax.ppermute(k_cur, axis, perm)
-        v_nxt = jax.lax.ppermute(v_cur, axis, perm)
-        segs_nxt = (None if segs_cur is None
-                    else jax.lax.ppermute(segs_cur, axis, perm))
-        kvm_nxt = (None if kvm_cur is None
-                   else jax.lax.ppermute(kvm_cur, axis, perm))
-        return (k_nxt, v_nxt, segs_nxt, kvm_nxt, m_new, l_new,
-                acc_new), None
+    dq = jnp.zeros((B, H, S_loc, Dp), jnp.float32)
+    dk_acc = jnp.zeros((B, Hkv, S_loc, Dp), jnp.float32)
+    dv_acc = jnp.zeros((B, Hkv, S_loc, Dp), jnp.float32)
 
-    m0 = jnp.full((B, H, S_loc, 1), -1e30, jnp.float32)
-    l0 = jnp.zeros((B, H, S_loc, 1), jnp.float32)
-    acc0 = jnp.zeros((B, H, S_loc, D), jnp.float32)
-    (_, _, _, _, m, l, acc), _ = jax.lax.scan(
-        step, (k, v, segs, kvm, m0, l0, acc0), jnp.arange(n))
-    l_safe = jnp.where(l == 0.0, 1.0, l)
-    out = (acc / l_safe).transpose(0, 2, 1, 3)                # [B,S_loc,H,D]
-    return out.astype(q.dtype)
+    for i in range(steps):
+        blk_causal, q_off, blk_window = _step_cfg(i, S_loc, causal, window)
 
+        def compute(k_c=k_cur, v_c=v_cur, sg=segs_cur, km=kvm_cur,
+                    bc=blk_causal, off=q_off, w=blk_window):
+            if use_flash:
+                dq_i, dk_i, dv_i = flash_block_bwd_t(
+                    q_use, k_c, v_c, do_use, lse, kv_mask=km,
+                    q_segs=segs, kv_segs=sg, causal=bc, scale=scale,
+                    block_q=block_q, block_kv=block_kv, window=w,
+                    q_off=off, delta=delta)
+            else:
+                dq_i, dk_i, dv_i = _jnp_block_bwd(
+                    q_use, k_c, v_c, do_use, lse, delta, segs, sg, km,
+                    blk_causal=bc, window=w, q_off=off, scale=scale,
+                    chunk=chunk)
+            return (dq_i.astype(jnp.float32), dk_i.astype(jnp.float32),
+                    dv_i.astype(jnp.float32))
+
+        if causal and i > 0:
+            dq_i, dk_i, dv_i = jax.lax.cond(
+                idx >= i, compute,
+                lambda: (jnp.zeros((B, H, S_loc, Dp), jnp.float32),
+                         jnp.zeros((B, Hkv, S_loc, Dp), jnp.float32),
+                         jnp.zeros((B, Hkv, S_loc, Dp), jnp.float32)))
+        else:
+            dq_i, dk_i, dv_i = compute()
+
+        dq = dq + dq_i
+        dk_acc = dk_acc + dk_i
+        dv_acc = dv_acc + dv_i
+
+        if i < steps - 1:
+            k_cur, v_cur, segs_cur, kvm_cur, dk_acc, dv_acc = _rotate(
+                [k_cur, v_cur, segs_cur, kvm_cur, dk_acc, dv_acc],
+                axis, perm)
+
+    # deliver each K/V block's grad accumulator back to its origin: block
+    # b sits at device (b + steps - 1) % n now — go forward the rest of
+    # the way around, or retrace backwards, whichever is fewer hops
+    fwd_hops = (n - steps + 1) % n
+    bwd_hops = steps - 1
+    if fwd_hops <= bwd_hops:
+        for _ in range(fwd_hops):
+            dk_acc, dv_acc = _rotate([dk_acc, dv_acc], axis, perm)
+    else:
+        inv = [(j, (j - 1) % n) for j in range(n)]
+        for _ in range(bwd_hops):
+            dk_acc, dv_acc = _rotate([dk_acc, dv_acc], axis, inv)
+
+    dq_out = dq.transpose(0, 2, 1, 3)[..., :D0].astype(q.dtype)
+    dk_out = dk_acc.transpose(0, 2, 1, 3)[..., :D0].astype(k.dtype)
+    dv_out = dv_acc.transpose(0, 2, 1, 3)[..., :D0].astype(v.dtype)
+    return dq_out, dk_out, dv_out, None, None
+
+
+_ring_core.defvjp(_ring_core_fwd, _ring_core_bwd)
+
+
+# ---------------------------------------------------------------------------
+# public op
+# ---------------------------------------------------------------------------
 
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    mesh: Mesh, *, causal: bool = True,
@@ -104,29 +406,57 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    axis: str = "sequence",
                    segment_ids: Optional[jnp.ndarray] = None,
                    kv_mask: Optional[jnp.ndarray] = None,
-                   window: Optional[int] = None) -> jnp.ndarray:
+                   window: Optional[int] = None,
+                   use_flash: Optional[bool] = None,
+                   block_q: int = 512, block_kv: int = 512,
+                   chunk: int = 1024) -> jnp.ndarray:
     """Exact (causal) attention with the sequence dim sharded over ``axis``.
 
     q,k,v: [B, S, H, D] global arrays whose S dim is (or will be) sharded
     over the 'sequence' mesh axis. Batch/head dims stay auto-sharded.
+    k/v may carry fewer heads (GQA) — the SMALL grouped k/v rotate around
+    the ring (the ICI-traffic win scales with the group factor).
 
     segment_ids/kv_mask: [B, S] packed-sequence ids / key-validity —
     sharded like the tokens; each shard's slice rotates around the ring
     with its K/V block, so packing/padding masks are exact. window:
-    sliding-window causal attention (mask-exact; out-of-band ring steps
-    still rotate — the flash kernel's DMA elision is the single-chip
-    perf path, the ring's win is capacity).
+    sliding-window causal attention — ring steps whose band is
+    statically empty are dropped, so the rotation does
+    ceil((window + S_loc - 1)/S_loc) hops instead of n_seq.
+
+    The local block runs the Pallas flash kernel on TPU (``use_flash``
+    defaults to auto-detect; ``block_q``/``block_kv`` are clamped to
+    divisors of the local shard) and a chunked online-softmax in plain
+    jnp elsewhere (``chunk`` keys at a time) — peak local memory is
+    O(S_loc · block), not O(S_loc²). Backward runs through a ring-level
+    custom VJP that replays the rotation (no dense per-step residuals).
     """
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
     if window is not None:
         assert causal, "sliding window requires causal attention"
+    H, Hkv = q.shape[2], k.shape[2]
+    assert H % Hkv == 0, f"q heads {H} not a multiple of kv heads {Hkv}"
+    assert v.shape[2] == Hkv, \
+        f"k has {Hkv} heads but v has {v.shape[2]} — kv head counts must match"
+    n_seq = mesh.shape[axis]
+    S = q.shape[1]
+    assert S % n_seq == 0, (S, n_seq)
+    S_loc = S // n_seq
+    if use_flash is None:
+        from deepspeed_tpu.utils import on_tpu
+        use_flash = on_tpu() and S_loc >= 128
+    block_q = _largest_divisor(S_loc, min(block_q, S_loc))
+    block_kv = _largest_divisor(S_loc, min(block_kv, S_loc))
     if segment_ids is not None:
         segment_ids = segment_ids.astype(jnp.int32)
     if kv_mask is not None:
         kv_mask = kv_mask.astype(jnp.float32)
-    inner = partial(_ring_attention_local, axis=axis, causal=causal,
-                    scale=scale, window=window)
+
+    def inner(q, k, v, segs, kvm):
+        return _ring_core(q, k, v, segs, kvm, axis, causal, scale, window,
+                          use_flash, block_q, block_kv, chunk)
+
     spec = P(None, axis, None, None)
     tok_spec = P(None, axis)
     args = [q, k, v, segment_ids, kv_mask]

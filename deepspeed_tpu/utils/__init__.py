@@ -12,3 +12,14 @@ def honor_platform_request() -> None:
     if want:
         import jax
         jax.config.update("jax_platforms", want)
+
+
+def on_tpu() -> bool:
+    """Whether device 0 is a TPU — the single source of truth for flash
+    eligibility and other hardware gates (models/gpt.py, ops ring)."""
+    try:
+        import jax
+        d = jax.devices()[0]
+        return "tpu" in (d.platform + d.device_kind).lower()
+    except Exception:
+        return False

@@ -139,11 +139,19 @@ def test_ring_kv_mask_matches_dense(devices):
     q, k, v = (jax.random.normal(kk, (2, 64, 4, 16), jnp.float32)
                for kk in ks)
     r = np.random.default_rng(3)
-    mask = jnp.asarray((r.random((2, 64)) > 0.25).astype(np.float32))
+    mask_np = (r.random((2, 64)) > 0.25).astype(np.float32)
+    mask = jnp.asarray(mask_np)
     out = ring_attention(q, k, v, mesh, causal=True, kv_mask=mask)
     ref = mha_reference(q, k, v, causal=True, kv_mask=mask)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+    # rows with NO causally-visible valid key are garbage-by-contract
+    # (dense: uniform average over all keys; ring: exact 0 — it skips
+    # above-diagonal blocks) — compare only defined rows, and pin the
+    # ring's documented contract for the rest
+    defined = np.cumsum(mask_np, axis=1) > 0              # [B, S]
+    np.testing.assert_allclose(np.asarray(out)[defined],
+                               np.asarray(ref)[defined],
                                rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out)[~defined], 0.0, atol=1e-6)
 
 
 def test_ring_window_matches_dense(devices):
@@ -176,6 +184,132 @@ def test_ring_packed_grads_match_dense(devices):
     for a, b, nm in zip(g_r, g_d, "qkv"):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-5, err_msg=nm)
+
+
+def test_ring_multichunk_matches_dense(devices):
+    """chunk < S_loc exercises the chunked online-softmax path (the
+    fallback's whole point: O(S_loc*chunk) local memory, never the dense
+    O(S_loc^2) score matrix), forward and grads."""
+    mesh = make_mesh(MeshSpec(data=2, sequence=4))
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q, k, v = (jax.random.normal(kk, (1, 64, 2, 8), jnp.float32)
+               for kk in ks)
+    out = ring_attention(q, k, v, mesh, causal=True, chunk=4)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    g_r = jax.grad(lambda q, k, v: jnp.sum(ring_attention(
+        q, k, v, mesh, causal=True, chunk=4) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    g_d = jax.grad(lambda q, k, v: jnp.sum(mha_reference(
+        q, k, v, causal=True) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for a, b, nm in zip(g_r, g_d, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5, err_msg=nm)
+
+
+def test_ring_window_multichunk_matches_dense(devices):
+    """Sliding window + chunked local path + the static early-stop of the
+    rotation chain (window=24 over S_loc=16 -> 3 hops, not 4)."""
+    from deepspeed_tpu.ops.attention.ring import _num_steps
+    assert _num_steps(4, 16, True, 24) == 3
+    assert _num_steps(8, 8, True, 8) == 2
+    mesh = make_mesh(MeshSpec(data=2, sequence=4))
+    ks = jax.random.split(jax.random.PRNGKey(6), 3)
+    q, k, v = (jax.random.normal(kk, (1, 64, 2, 8), jnp.float32)
+               for kk in ks)
+    out = ring_attention(q, k, v, mesh, causal=True, window=24, chunk=8)
+    ref = mha_reference(q, k, v, causal=True, window=24)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ring_flash_kernel_matches_dense(devices, pallas_interpret):
+    """use_flash=True routes every ring step through the Pallas flash
+    kernel (interpret mode on CPU): parity incl. grads, GQA, packing."""
+    mesh = make_mesh(MeshSpec(data=2, sequence=4))
+    B, S, H, Hkv, D = 1, 256, 4, 2, 8
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(ks[0], (B, S, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, S, Hkv, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, S, Hkv, D), jnp.float32)
+    segs = jnp.asarray(
+        np.repeat(np.arange(4), 64)[None].astype(np.int32))
+    out = ring_attention(q, k, v, mesh, causal=True, use_flash=True,
+                         block_q=32, block_kv=32, segment_ids=segs)
+    ref = mha_reference(q, k, v, causal=True, segment_ids=segs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+    g_r = jax.grad(lambda q, k, v: jnp.sum(ring_attention(
+        q, k, v, mesh, causal=True, use_flash=True, block_q=32,
+        block_kv=32, segment_ids=segs) ** 2), argnums=(0, 1, 2))(q, k, v)
+    g_d = jax.grad(lambda q, k, v: jnp.sum(mha_reference(
+        q, k, v, causal=True, segment_ids=segs) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b, nm in zip(g_r, g_d, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-3, err_msg=nm)
+
+
+def test_ring_flash_window_matches_dense(devices, pallas_interpret):
+    """Flash-kernel ring steps with a sliding window: the banded partial
+    block (static q_off) goes through the kernel's offset index maps."""
+    mesh = make_mesh(MeshSpec(data=2, sequence=4))
+    ks = jax.random.split(jax.random.PRNGKey(8), 3)
+    q, k, v = (jax.random.normal(kk, (1, 256, 2, 8), jnp.float32)
+               for kk in ks)
+    out = ring_attention(q, k, v, mesh, causal=True, use_flash=True,
+                         block_q=32, block_kv=32, window=96)
+    ref = mha_reference(q, k, v, causal=True, window=96)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+    # grads too: the q_off-shifted windowed BACKWARD index maps (the
+    # clip-based first/last q-block computation in _flash_bwd) are
+    # otherwise uncovered
+    g_r = jax.grad(lambda q, k, v: jnp.sum(ring_attention(
+        q, k, v, mesh, causal=True, use_flash=True, block_q=32,
+        block_kv=32, window=96) ** 2), argnums=(0, 1, 2))(q, k, v)
+    g_d = jax.grad(lambda q, k, v: jnp.sum(mha_reference(
+        q, k, v, causal=True, window=96) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b, nm in zip(g_r, g_d, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-3, err_msg=nm)
+
+
+def test_flash_block_q_off_primitive(devices, pallas_interpret):
+    """flash_block_fwd with a static q_off equals the corresponding
+    off-diagonal tile of a dense full-sequence attention: q rows sit
+    q_off tokens after the block's first key."""
+    from deepspeed_tpu.ops.attention.flash import flash_block_fwd
+    S_loc, off = 64, 64          # q rows are tokens [64, 128), keys [0, 64)
+    ks = jax.random.split(jax.random.PRNGKey(9), 3)
+    q, k, v = (jax.random.normal(kk, (1, 2 * S_loc, 2, 8), jnp.float32)
+               for kk in ks)
+    o, lse = flash_block_fwd(q[:, S_loc:], k[:, :S_loc], v[:, :S_loc],
+                             causal=True, block_q=32, block_kv=32,
+                             window=96, q_off=off)
+    # dense tile: full-seq windowed-causal attention restricted to
+    # q-rows [64,128) x keys [0,64), renormalized over those keys only
+    D = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q[:, S_loc:],
+                        k[:, :S_loc]) / np.sqrt(D)
+    rows = off + np.arange(S_loc)[:, None]
+    cols = np.arange(S_loc)[None, :]
+    band = (rows >= cols) & (rows - cols < 96)
+    logits = jnp.where(jnp.asarray(band)[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    ref = jnp.einsum("bhqk,bkhd->bqhd", probs, v[:, :S_loc])
+    valid = band.any(axis=1)                 # rows inside the band
+    np.testing.assert_allclose(np.asarray(o)[0, valid],
+                               np.asarray(ref)[0, valid],
+                               rtol=2e-5, atol=2e-5)
+    # lse is the banded logsumexp for in-band rows: both [H, S] slices
+    ref_lse = np.asarray(jax.scipy.special.logsumexp(logits, axis=-1))[0]
+    got_lse = np.asarray(lse)[0]
+    np.testing.assert_allclose(got_lse[:, valid], ref_lse[:, valid],
+                               rtol=2e-5, atol=2e-5)
 
 
 def test_ring_packed_gpt_matches_ulysses(devices):

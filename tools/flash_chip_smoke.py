@@ -78,6 +78,83 @@ def main():
     ]
     for name, make in cases:
         run_case(name, make)
+    ring_block_cases()
+
+
+def ring_block_cases():
+    """Mosaic-compile the ring building blocks (flash_block_fwd/bwd with
+    a static q_off and separate kv-side segments) — the flash-grade ring
+    (ops/attention/ring.py) stands on these; interpret mode cannot catch
+    their lowering failures."""
+    r = np.random.default_rng(1)
+    B, S, H, D = 1, 512, 4, 64
+    q = jnp.asarray(r.standard_normal((B, S, H, D)), jnp.bfloat16)
+    k = jnp.asarray(r.standard_normal((B, S, H, D)), jnp.bfloat16)
+    v = jnp.asarray(r.standard_normal((B, S, H, D)), jnp.bfloat16)
+    # distinct q-side vs kv-side metadata (the rotated-block shape), but
+    # every q row keeps >=1 matching key: rows with NO valid key are
+    # garbage-by-contract (lse = -inf -> p = 1 everywhere in BOTH
+    # implementations; their bf16-amplified grads differ meaninglessly
+    # and the ring's global lse / loss mask excludes them in training)
+    qsegs = jnp.asarray(np.repeat(np.arange(2), S // 2)[None], jnp.int32)
+    ksegs = jnp.asarray(np.repeat([0, 1], [S // 4, 3 * S // 4])[None],
+                        jnp.int32)
+
+    for name, kwargs in [
+        ("ring-block-offset", dict(causal=True, q_off=S)),
+        ("ring-block-offset-window",
+         dict(causal=True, q_off=S, window=S + 128)),
+        ("ring-block-ksegs",
+         dict(causal=True, q_off=S, q_segs=qsegs, kv_segs=ksegs)),
+    ]:
+        try:
+            o, lse = jax.jit(lambda a, b, c: F.flash_block_fwd(
+                a, b, c, block_q=256, block_kv=256, **kwargs))(q, k, v)
+            dq, dk, dv = jax.jit(lambda a, b, c, do, o, lse:
+                                 F.flash_block_bwd(
+                                     a, b, c, do, o, lse, block_q=256,
+                                     block_kv=256, **kwargs))(
+                q, k, v, jnp.ones_like(q), o, lse)
+            finite = all(bool(jnp.isfinite(x.astype(jnp.float32)).all())
+                         for x in (o, lse, dq, dk, dv))
+            # cross-check BOTH passes vs the jnp chunked block (the ring
+            # fallback): a Mosaic miscompile that stays finite must not
+            # slip through on 'ok': true
+            from deepspeed_tpu.ops.attention.ring import (
+                _jnp_block_bwd, _jnp_block_fwd)
+            scale = 1.0 / np.sqrt(D)
+            o_ref, lse_ref = _jnp_block_fwd(
+                q, k, v, kwargs.get("q_segs"), kwargs.get("kv_segs"), None,
+                blk_causal=kwargs["causal"], window=kwargs.get("window"),
+                q_off=kwargs["q_off"], scale=scale, chunk=256)
+            o_ref = o_ref.transpose(0, 2, 1, 3)     # kernel -> [B,S,H,D]
+            err = float(jnp.max(jnp.abs(o.astype(jnp.float32) -
+                                        o_ref.astype(jnp.float32))))
+            do = jnp.ones_like(q)
+            delta = jnp.sum(do.astype(jnp.float32) *
+                            o.astype(jnp.float32),
+                            axis=-1).transpose(0, 2, 1)     # [B,H,S]
+            dq_r, dk_r, dv_r = _jnp_block_bwd(
+                q, k, v, do, lse, delta, kwargs.get("q_segs"),
+                kwargs.get("kv_segs"), None, blk_causal=kwargs["causal"],
+                window=kwargs.get("window"), q_off=kwargs["q_off"],
+                scale=scale, chunk=256)
+            gerr = max(
+                float(jnp.max(jnp.abs(dq.astype(jnp.float32) -
+                                      dq_r.transpose(0, 2, 1, 3)))),
+                float(jnp.max(jnp.abs(dk.astype(jnp.float32) -
+                                      dk_r.transpose(0, 2, 1, 3)))),
+                float(jnp.max(jnp.abs(dv.astype(jnp.float32) -
+                                      dv_r.transpose(0, 2, 1, 3)))))
+            print(json.dumps({"case": name,
+                              "ok": bool(finite and err < 5e-2 and
+                                         gerr < 5e-1),
+                              "fwd_err_vs_jnp_block": round(err, 5),
+                              "bwd_err_vs_jnp_block": round(gerr, 5)}),
+                  flush=True)
+        except Exception as e:
+            print(json.dumps({"case": name, "ok": False,
+                              "error": repr(e)[:300]}), flush=True)
 
 
 if __name__ == "__main__":
