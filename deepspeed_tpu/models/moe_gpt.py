@@ -55,6 +55,15 @@ def init_params(rng: jax.Array, cfg: MoEGPTConfig) -> Dict:
     return base
 
 
+def num_params(cfg: MoEGPTConfig) -> int:
+    """Dense-GPT count with every layer's MLP swapped for the E-expert
+    stack + gate (init_params above is the shape source of truth)."""
+    d, L, ff, E = cfg.d_model, cfg.n_layers, cfg.ffn_dim, cfg.num_experts
+    dense_mlp = 2 * d * ff + d + ff
+    moe_mlp = E * (2 * d * ff + d + ff) + d * E
+    return gpt_lib.num_params(cfg) + L * (moe_mlp - dense_mlp)
+
+
 def _moe_block(x, layer_params, cfg: MoEGPTConfig, rng, train: bool):
     """One transformer block with MoE FFN. x: [B, S, D]."""
     B, S, D = x.shape

@@ -181,6 +181,68 @@ def estimate_gpt_train_bytes(cfg, batch: int, seq: Optional[int] = None,
         loss_chunk=cfg.loss_chunk, **kw)
 
 
+def estimate_bert_train_bytes(cfg, batch: int, seq: Optional[int] = None,
+                              **kw) -> MemoryEstimate:
+    """Convenience wrapper mapping a models.bert.BertConfig. The encoder
+    layer is the classic post/pre-LN transformer (ffn = 4d, fused qkv =
+    3d); bidirectional attention changes flops, not live bytes, so the
+    GPT activation-width model carries over unchanged."""
+    from deepspeed_tpu.models import bert
+    return estimate_train_bytes(
+        n_params=bert.num_params(cfg), n_layers=cfg.n_layers,
+        d_model=cfg.d_model, ffn_dim=4 * cfg.d_model,
+        qkv_dim=3 * cfg.d_model, n_heads=cfg.n_heads,
+        vocab_size=cfg.vocab_size, batch=batch,
+        seq=seq or cfg.max_seq_len, remat=cfg.remat,
+        remat_policy=cfg.remat_policy, loss_chunk=cfg.loss_chunk, **kw)
+
+
+def estimate_moe_train_bytes(cfg, batch: int, seq: Optional[int] = None,
+                             **kw) -> MemoryEstimate:
+    """models.moe_gpt.MoEGPTConfig variant: the dense-GPT estimate (with
+    the MoE param count — experts dominate) plus the gating/dispatch
+    working set of ONE layer (transient under the moe remat policy):
+    fp32 combine weights + dispatch mask [B, S, E, C] and the dispatched
+    expert activations [E, C_total, d..ffn]."""
+    from deepspeed_tpu.models import moe_gpt
+    from deepspeed_tpu.moe.sharded_moe import _capacity
+    seq = seq or cfg.max_seq_len
+    est = estimate_train_bytes(
+        n_params=moe_gpt.num_params(cfg), n_layers=cfg.n_layers,
+        d_model=cfg.d_model, ffn_dim=cfg.ffn_dim, qkv_dim=cfg.qkv_dim,
+        n_heads=cfg.n_heads, vocab_size=cfg.vocab_size, batch=batch,
+        seq=seq, remat=cfg.remat, remat_policy=cfg.remat_policy,
+        loss_chunk=cfg.loss_chunk, **kw)
+    E = cfg.num_experts
+    cf = cfg.capacity_factor * (2 if cfg.moe_k == 2 else 1)
+    C = _capacity(seq, E, cf, cfg.min_capacity)
+    dispatch = batch * seq * E * C * 5            # fp32 combine + bool mask
+    expert_act = E * C * batch * (cfg.d_model + cfg.ffn_dim) * 2
+    est.contributions["moe_dispatch"] = dispatch + expert_act
+    return est
+
+
+def estimate_infer_bytes(cfg, batch: int,
+                         max_seq: Optional[int] = None) -> MemoryEstimate:
+    """Inference working set for a models.gpt config: bf16 params, the
+    preallocated [L, B, S_max, Hkv, Dh] KV cache pair, one fp32 logits
+    row per sequence, and the prefill activation transient."""
+    from deepspeed_tpu.models import gpt
+    est = MemoryEstimate()
+    max_seq = max_seq or cfg.max_seq_len
+    pb = 2                                        # bf16 serving
+    est.contributions["params"] = gpt.num_params(cfg) * pb
+    est.contributions["kv_cache"] = (
+        2 * cfg.n_layers * batch * max_seq * cfg.kv_heads
+        * cfg.head_dim * pb)
+    est.contributions["logits"] = batch * cfg.vocab_size * 4
+    # prefill holds one layer's qkv/ffn working set across the prompt
+    est.contributions["prefill"] = int(
+        batch * max_seq * (cfg.qkv_dim + cfg.ffn_dim + 2 * cfg.d_model) * pb)
+    est.contributions["fudge"] = FUDGE_BYTES
+    return est
+
+
 def device_hbm_bytes(device: Any = None) -> Optional[int]:
     """Device HBM capacity, via memory_stats when the backend exposes it,
     else the known-capacity table. None for CPU/unknown (no guard)."""
@@ -218,13 +280,7 @@ def check_compile_safe(est: MemoryEstimate, hbm_bytes: Optional[int],
     return est.total <= limit, msg
 
 
-def guard_gpt_config(cfg, batch: int, seq: Optional[int] = None,
-                     device: Any = None,
-                     headroom_gib: float = DEFAULT_HEADROOM_GIB,
-                     **estimate_kw) -> str:
-    """Raise MemoryGuardError if compiling this training config risks the
-    borderline-HBM compile grind; returns the decision message otherwise."""
-    est = estimate_gpt_train_bytes(cfg, batch, seq, **estimate_kw)
+def _guard(est: MemoryEstimate, device, headroom_gib) -> str:
     ok, msg = check_compile_safe(est, device_hbm_bytes(device), headroom_gib)
     if not ok:
         raise MemoryGuardError(
@@ -232,3 +288,40 @@ def guard_gpt_config(cfg, batch: int, seq: Optional[int] = None,
             f"this backend (PERF.md); shrink batch/model or use "
             f"remat_policy='full' + loss_chunk.")
     return msg
+
+
+def guard_gpt_config(cfg, batch: int, seq: Optional[int] = None,
+                     device: Any = None,
+                     headroom_gib: float = DEFAULT_HEADROOM_GIB,
+                     **estimate_kw) -> str:
+    """Raise MemoryGuardError if compiling this training config risks the
+    borderline-HBM compile grind; returns the decision message otherwise."""
+    return _guard(estimate_gpt_train_bytes(cfg, batch, seq, **estimate_kw),
+                  device, headroom_gib)
+
+
+def guard_bert_config(cfg, batch: int, seq: Optional[int] = None,
+                      device: Any = None,
+                      headroom_gib: float = DEFAULT_HEADROOM_GIB,
+                      **estimate_kw) -> str:
+    """Encoder (BERT) variant of :func:`guard_gpt_config`."""
+    return _guard(estimate_bert_train_bytes(cfg, batch, seq, **estimate_kw),
+                  device, headroom_gib)
+
+
+def guard_moe_config(cfg, batch: int, seq: Optional[int] = None,
+                     device: Any = None,
+                     headroom_gib: float = DEFAULT_HEADROOM_GIB,
+                     **estimate_kw) -> str:
+    """MoE-GPT variant of :func:`guard_gpt_config` (adds the dispatch
+    working set on top of the dense estimate)."""
+    return _guard(estimate_moe_train_bytes(cfg, batch, seq, **estimate_kw),
+                  device, headroom_gib)
+
+
+def guard_infer_config(cfg, batch: int, max_seq: Optional[int] = None,
+                       device: Any = None,
+                       headroom_gib: float = DEFAULT_HEADROOM_GIB) -> str:
+    """Inference variant: params + KV cache + logits + prefill transient."""
+    return _guard(estimate_infer_bytes(cfg, batch, max_seq),
+                  device, headroom_gib)

@@ -113,3 +113,108 @@ def test_gqa_shrinks_estimate():
     b = hbm.estimate_gpt_train_bytes(base, 8, 1024).total
     g = hbm.estimate_gpt_train_bytes(gqa, 8, 1024).total
     assert g < b
+
+
+def test_bert_estimator_calibration():
+    """bert-large seq128 b256 and seq512 b64 (the bench grid's upper
+    rows) must be SAFE on 16GiB with full remat + chunked CE; an absurd
+    batch must be REFUSED — so bert_bench's guard keeps the real grid
+    runnable while stopping rig-wedging compiles."""
+    from deepspeed_tpu.models import bert
+    cfg = bert.preset("bert-large", max_seq_len=512, dropout=0.0,
+                      dtype=jnp.bfloat16, remat=True, remat_policy="full",
+                      loss_chunk=2048)
+    for seq, batch in [(128, 256), (128, 512), (512, 32), (512, 64)]:
+        est = hbm.estimate_bert_train_bytes(cfg, batch, seq)
+        ok, msg = hbm.check_compile_safe(est, V5E)
+        assert ok, f"seq{seq} b{batch} must be safe: {msg}"
+    est = hbm.estimate_bert_train_bytes(cfg, 4096, 512)
+    ok, msg = hbm.check_compile_safe(est, V5E)
+    assert not ok, f"b4096 seq512 must be refused: {msg}"
+
+
+def test_moe_estimator_calibration():
+    """The moe_bench grid (12L/768d, E=8/16, b8 seq1024) is SAFE; the
+    dispatch working set grows the estimate over dense; a huge
+    expert-count config at big batch is REFUSED."""
+    from deepspeed_tpu.models import moe_gpt
+    cfg = moe_gpt.MoEGPTConfig(n_layers=12, n_heads=12, d_model=768,
+                               max_seq_len=1024, dtype=jnp.bfloat16,
+                               remat=True, num_experts=8, moe_k=2,
+                               capacity_factor=1.25)
+    est = hbm.estimate_moe_train_bytes(cfg, 8, 1024)
+    ok, msg = hbm.check_compile_safe(est, V5E)
+    assert ok, msg
+    assert est.contributions["moe_dispatch"] > 0
+    dense_like = hbm.estimate_train_bytes(
+        n_params=moe_gpt.num_params(cfg), n_layers=cfg.n_layers,
+        d_model=cfg.d_model, ffn_dim=cfg.ffn_dim, qkv_dim=cfg.qkv_dim,
+        n_heads=cfg.n_heads, vocab_size=cfg.vocab_size, batch=8, seq=1024,
+        remat=cfg.remat, remat_policy=cfg.remat_policy,
+        loss_chunk=cfg.loss_chunk)
+    assert est.total > dense_like.total
+    big = moe_gpt.MoEGPTConfig(n_layers=24, n_heads=16, d_model=2048,
+                               max_seq_len=2048, dtype=jnp.bfloat16,
+                               remat=True, num_experts=64, moe_k=2)
+    est = hbm.estimate_moe_train_bytes(big, 32, 2048)
+    ok, msg = hbm.check_compile_safe(est, V5E)
+    assert not ok, f"64-expert 1.3B-ish at b32 must be refused: {msg}"
+
+
+def test_moe_num_params_matches_init():
+    from deepspeed_tpu.models import moe_gpt
+    import jax
+    cfg = moe_gpt.MoEGPTConfig(vocab_size=128, n_layers=2, n_heads=2,
+                               d_model=32, max_seq_len=64,
+                               dtype=jnp.float32, num_experts=4)
+    params = moe_gpt.init_params(jax.random.PRNGKey(0), cfg)
+    n = sum(x.size for x in jax.tree.leaves(params))
+    assert moe_gpt.num_params(cfg) == n
+
+
+def test_infer_estimator_calibration():
+    """The infer_bench grid (gpt2-medium/large, b8-32, 584-token cache)
+    is SAFE; a 32k-cache x 256-batch config is REFUSED (KV cache alone
+    exceeds HBM)."""
+    cfg = gpt.preset("gpt2-large", max_seq_len=584, dtype=jnp.bfloat16)
+    est = hbm.estimate_infer_bytes(cfg, 32, 584)
+    ok, msg = hbm.check_compile_safe(est, V5E)
+    assert ok, msg
+    cfg = gpt.preset("gpt2-large", max_seq_len=32768, dtype=jnp.bfloat16)
+    est = hbm.estimate_infer_bytes(cfg, 256, 32768)
+    ok, msg = hbm.check_compile_safe(est, V5E)
+    assert not ok, msg
+    assert est.contributions["kv_cache"] > est.contributions["params"]
+
+
+class _FakeV5e:
+    platform = "tpu"
+    device_kind = "TPU v5e"
+
+    def memory_stats(self):
+        return {}
+
+
+def test_guard_wrappers_raise():
+    """guard_bert/moe/infer_config raise MemoryGuardError on a v5e-sized
+    device for configs past the headroom, and return the decision message
+    for safe ones."""
+    from deepspeed_tpu.models import bert, moe_gpt
+    dev = _FakeV5e()
+    bcfg = bert.preset("bert-large", max_seq_len=512, dtype=jnp.bfloat16,
+                       remat=True, remat_policy="full", loss_chunk=2048)
+    assert "estimated peak" in hbm.guard_bert_config(bcfg, 64, 512,
+                                                     device=dev)
+    with pytest.raises(hbm.MemoryGuardError):
+        hbm.guard_bert_config(bcfg, 4096, 512, device=dev)
+    mcfg = moe_gpt.MoEGPTConfig(n_layers=12, n_heads=12, d_model=768,
+                                max_seq_len=1024, dtype=jnp.bfloat16,
+                                remat=True, num_experts=8)
+    assert "estimated peak" in hbm.guard_moe_config(mcfg, 8, 1024,
+                                                    device=dev)
+    icfg = gpt.preset("gpt2-large", max_seq_len=584, dtype=jnp.bfloat16)
+    assert "estimated peak" in hbm.guard_infer_config(icfg, 32, 584,
+                                                      device=dev)
+    big = gpt.preset("gpt2-large", max_seq_len=32768, dtype=jnp.bfloat16)
+    with pytest.raises(hbm.MemoryGuardError):
+        hbm.guard_infer_config(big, 256, 32768, device=dev)

@@ -43,6 +43,11 @@ def run(seq, batch, steps):
                       dropout=0.0, dtype=jnp.bfloat16,
                       remat=True, remat_policy="full",
                       loss_chunk=2048 if on_tpu else 0)
+    if on_tpu:
+        # refuse borderline-HBM compiles before any backend contact —
+        # one unguarded compile can wedge the rig (utils/hbm.py, PERF.md)
+        from deepspeed_tpu.utils import hbm
+        hbm.guard_bert_config(cfg, batch, seq)
     params = bert.init_params(jax.random.PRNGKey(0), cfg)
     eng, _, _, _ = deepspeed_tpu.initialize(
         model=bert.make_loss_fn(cfg), model_parameters=params,
@@ -73,8 +78,15 @@ def main():
     # each config runs in a FRESH subprocess: the remote compile helper on
     # this rig 500s on repeat compiles within one long-lived process
     if len(sys.argv) > 1 and sys.argv[1] == "--one":
+        from deepspeed_tpu.utils.hbm import MemoryGuardError
         seq, batch, steps = (int(x) for x in sys.argv[2:5])
-        dt, sps, tf = run(seq, batch, steps)
+        try:
+            dt, sps, tf = run(seq, batch, steps)
+        except MemoryGuardError as e:
+            print(json.dumps({"model": "bert-large", "seq": seq,
+                              "batch": batch, "skipped": "memory guard",
+                              "why": str(e)[:300]}), flush=True)
+            return
         print(json.dumps({
             "model": "bert-large", "seq": seq, "batch": batch,
             "step_ms": round(dt * 1e3, 1),

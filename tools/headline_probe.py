@@ -17,6 +17,7 @@ Usage: python tools/headline_probe.py [variant ...]
 """
 
 import json
+import os
 import sys
 
 sys.path.insert(0, ".")
@@ -92,9 +93,13 @@ print(json.dumps({{"variant": {name!r}, "preset": s["preset"],
 """
 
 
-def guard_variant(name, s, hbm_gib=16):
+def guard_variant(name, s, hbm_gib=None):
     """Analytic safety decision — NO backend contact (a wedged tunnel
-    hangs jax.devices(); the v5e capacity is known)."""
+    hangs jax.devices(); default capacity comes from DS_TPU_HBM_GIB or
+    falls back to the 16GiB v5e so the decision stays consistent with
+    utils/hbm.py's device table without requiring a live backend)."""
+    if hbm_gib is None:
+        hbm_gib = float(os.environ.get("DS_TPU_HBM_GIB", 16))
     import jax.numpy as jnp
     from deepspeed_tpu.models import gpt
     from deepspeed_tpu.utils import hbm

@@ -43,6 +43,11 @@ def bench_config(name, preset, batch, prompt_len, new_tokens,
     cfg = gpt.preset(preset, max_seq_len=prompt_len + new_tokens + 8,
                      dtype=jnp.bfloat16, use_flash_attention=on_tpu,
                      n_kv_heads=n_kv_heads, attn_window=attn_window)
+    if on_tpu:
+        # refuse borderline-HBM compiles before any backend contact
+        # (utils/hbm.py, PERF.md incident log)
+        from deepspeed_tpu.utils import hbm
+        hbm.guard_infer_config(cfg, batch, cfg.max_seq_len)
     params = gpt.init_params(jax.random.PRNGKey(0), cfg)
     eng = deepspeed_tpu.init_inference(model=(cfg, params),
                                        dtype=jnp.bfloat16)
@@ -90,9 +95,13 @@ CONFIGS = [
 
 
 def main():
+    from deepspeed_tpu.utils.hbm import MemoryGuardError
     for name, kw in CONFIGS:
         try:
             bench_config(name, **kw)
+        except MemoryGuardError as e:
+            print(json.dumps({"config": name, "skipped": "memory guard",
+                              "why": str(e)[:300]}), flush=True)
         except Exception as e:
             print(json.dumps({"config": name, "error": repr(e)[:200]}),
                   flush=True)

@@ -37,6 +37,19 @@ else:
                          use_flash_attention=on_tpu,
                          num_experts={experts}, moe_k={k},
                          capacity_factor=1.25)
+if on_tpu:
+    # refuse borderline-HBM compiles before any backend contact
+    # (utils/hbm.py, PERF.md incident log)
+    from deepspeed_tpu.utils import hbm
+    try:
+        if kind == 'dense':
+            hbm.guard_gpt_config(cfg, batch, seq)
+        else:
+            hbm.guard_moe_config(cfg, batch, seq)
+    except hbm.MemoryGuardError as e:
+        print(json.dumps({{"kind": kind, "experts": {experts},
+            "skipped": "memory guard", "why": str(e)[:300]}}))
+        sys.exit(0)
 params = M.init_params(jax.random.PRNGKey(0), cfg)
 n_params = sum(x.size for x in jax.tree.leaves(params))
 engine, _, _, _ = deepspeed_tpu.initialize(
