@@ -94,6 +94,44 @@ def mesh():
                           "max_err_vs_dense": round(err, 6),
                           "step_ms_cpu": round(dt * 1e3, 1)}), flush=True)
 
+    # memory curve: XLA temp-buffer bytes of the compiled fwd+bwd program.
+    # The ring's chunked local block holds O(S_loc*chunk) score memory, so
+    # its temps grow LINEARLY with S; dense attention grows O(S^2). This
+    # is the capacity claim the reference's block-sparse attention makes
+    # (ref README.md:38 "10x longer sequences") — here with EXACT
+    # attention.
+    def temp_bytes(fun, *args):
+        comp = jax.jit(fun).lower(*args).compile()
+        m = comp.memory_analysis()
+        return None if m is None else int(m.temp_size_in_bytes)
+
+    chunk = 512
+    for S_curve in (2048, 4096, 8192, 16384):
+        qc, kc, vc = (jnp.zeros((1, S_curve, H, D), jnp.float32)
+                      for _ in range(3))
+        shc = NamedSharding(mesh, P(None, "sequence", None, None))
+        qc, kc, vc = (jax.device_put(t, shc) for t in (qc, kc, vc))
+
+        def ring_loss(a, b, c):
+            return (ring_attention(a, b, c, mesh=mesh, axis="sequence",
+                                   causal=True, chunk=chunk) ** 2).sum()
+
+        def dense_loss(a, b, c):
+            return (mha_reference(a, b, c, causal=True) ** 2).sum()
+
+        ring_t = temp_bytes(jax.grad(ring_loss, argnums=(0, 1, 2)),
+                            qc, kc, vc)
+        dense_t = (temp_bytes(jax.grad(dense_loss, argnums=(0, 1, 2)),
+                              qc, kc, vc) if S_curve <= 8192 else None)
+        print(json.dumps({
+            "metric": "longcontext_memory_curve", "seq": S_curve,
+            "sp": 8, "chunk": chunk,
+            "ring_temp_mb": (None if ring_t is None
+                             else round(ring_t / 1e6, 1)),
+            "dense_temp_mb": (None if dense_t is None
+                              else round(dense_t / 1e6, 1)),
+        }), flush=True)
+
 
 if __name__ == "__main__":
     (chip if (sys.argv[1:] or ["mesh"])[0] == "chip" else mesh)()
