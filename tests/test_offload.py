@@ -351,22 +351,29 @@ def test_step_pipeline_overlap_schedule():
 
 
 def test_loopback_pipeline_efficiency():
-    """The overlap claim enforced: under an emulated serialized link the
-    REAL step schedule must reach >=0.7 of the ideal two-stage pipeline
-    bound and beat the no-overlap serial model at two link speeds.
-    (Thresholds are looser than tools/offload_loopback.py's headline
-    numbers — CI machines jitter.)"""
+    """The overlap claim enforced at ~0.9 of the measured headline:
+    under an emulated serialized link the REAL step schedule must reach
+    >=0.85 of the ideal two-stage pipeline bound and come in at <=0.89x
+    the no-overlap serial model at two link speeds. (Measured at these
+    parameters: efficiency 0.89-1.34, vs_serial 0.55-0.86 across trials
+    — PERF.md headline 1.11/0.97 eff, 0.53x/0.83x serial at 1/4 GB/s on
+    bigger shards. Best-of-3 absorbs host jitter; a regression to the
+    old 0.65/0.9 floor now fails.) Source of truth is the tool's own
+    run() — the same numbers its JSON line reports."""
     from tools.offload_loopback import run as loopback_run
     # link speeds chosen so t_transfer is comparable to t_adam for these
     # shard sizes — that's where overlap vs serial actually discriminates
     # (a negligible link makes both models collapse to t_adam)
     for bw in (0.5, 1.5):
         results = []
-        for _ in range(2):            # best-of-2: host jitter happens
+        for _ in range(3):            # best-of-3: host jitter happens
             eff, vs_serial = loopback_run(bw, n_leaves=6, elems=2_000_000)
             results.append((eff, vs_serial))
-            if eff >= 0.65 and vs_serial <= 0.9:
+            if eff >= 0.85 and vs_serial <= 0.89:
                 break
         eff, vs_serial = max(results, key=lambda r: r[0] - r[1])
-        assert eff >= 0.65, (bw, results)
-        assert vs_serial <= 0.9, (bw, results)
+        assert eff >= 0.85, (bw, results)
+        # 0.89 ceiling: worst observed single trial is 0.861 — leave a
+        # few % for slower CI hosts while still failing a real
+        # regression to the serial model (1.0)
+        assert vs_serial <= 0.89, (bw, results)
