@@ -371,9 +371,11 @@ def test_loopback_pipeline_efficiency():
             results.append((eff, vs_serial))
             if eff >= 0.85 and vs_serial <= 0.89:
                 break
-        eff, vs_serial = max(results, key=lambda r: r[0] - r[1])
-        assert eff >= 0.85, (bw, results)
-        # 0.89 ceiling: worst observed single trial is 0.861 — leave a
-        # few % for slower CI hosts while still failing a real
-        # regression to the serial model (1.0)
-        assert vs_serial <= 0.89, (bw, results)
+        # SOME trial must clear BOTH gates (a max-over-one-metric pick
+        # could select a trial that fails the other gate even when a
+        # fully-passing trial exists). 0.89 ceiling: worst observed
+        # single trial is 0.861 — a few % slack for slower CI hosts
+        # while still failing a real regression toward the serial
+        # model (1.0).
+        assert any(e >= 0.85 and v <= 0.89 for e, v in results), \
+            (bw, results)
