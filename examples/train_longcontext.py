@@ -29,7 +29,7 @@ sys.path.insert(0, ".")
 
 import jax
 
-from deepspeed_tpu.utils import honor_platform_request
+from deepspeed_tpu.utils import honor_platform_request, on_tpu
 
 honor_platform_request()
 
@@ -54,18 +54,19 @@ def main():
     ap.add_argument("--steps", type=int, default=5)
     args = ap.parse_args()
 
-    if len(jax.devices()) < args.sp and jax.devices()[0].platform == "cpu":
+    if len(jax.devices()) % args.sp or len(jax.devices()) < args.sp:
         raise SystemExit(
-            f"need {args.sp} devices for sp={args.sp}; run with "
+            f"have {len(jax.devices())} devices; sp={args.sp} needs a "
+            f"multiple of it. For a virtual mesh run with "
             f"XLA_FLAGS=--xla_force_host_platform_device_count={args.sp} "
-            f"JAX_PLATFORMS=cpu for a virtual mesh")
+            f"JAX_PLATFORMS=cpu")
 
-    on_tpu = jax.devices()[0].platform == "tpu"
+    tpu = on_tpu()
     mesh = make_mesh(MeshSpec(data=len(jax.devices()) // args.sp,
                               sequence=args.sp))
     cfg = gpt.preset(args.preset, max_seq_len=args.seq,
-                     dtype=jnp.bfloat16 if on_tpu else jnp.float32,
-                     use_flash_attention=on_tpu,
+                     dtype=jnp.bfloat16 if tpu else jnp.float32,
+                     use_flash_attention=tpu,
                      sequence_parallel=True, sp_impl=args.impl,
                      attn_window=args.window, mesh=mesh,
                      loss_chunk=2048)
@@ -75,7 +76,7 @@ def main():
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=gpt.make_loss_fn(cfg), model_parameters=params,
         config={"train_batch_size": args.batch,
-                "bf16": {"enabled": on_tpu},
+                "bf16": {"enabled": tpu},
                 "mesh": {"data_parallel_size":
                          len(jax.devices()) // args.sp,
                          "sequence_parallel_size": args.sp},
