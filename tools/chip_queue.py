@@ -29,12 +29,16 @@ QUEUE = [
     # variants pass the analytic memory guard inside headline_probe —
     # unsafe configs (the rig-wedging borderline-HBM compiles) are
     # skipped with a JSON line, never attempted
-    # outer budget covers 10 variants x the probe's 2400s per-config cap
+    # outer budget covers 14 variants x the probe's 2400s per-config cap;
+    # ordering is greedy: baseline re-confirmation, then the single
+    # biggest lever (offload_flash), then its combinations, then tiles
     ("probe", [sys.executable, "tools/headline_probe.py",
-               "b16-full-ce", "b16-offloadflash-ce", "b20-full-ce",
-               "b22-full-ce", "b12-flashonly-ce", "b16-bwd512",
-               "b16-bwdq512", "b16-bwdkv512", "med-b8-noremat",
-               "med-b16-ce"], 24100),
+               "b16-full-ce", "b16-offloadflash-ce",
+               "b16-offloadflash-bwd512", "b18-offloadflash-ce",
+               "b20-offloadflash-ce", "b20-full-ce",
+               "b22-full-ce", "b12-flashonly-ce", "b12-flashonly-bwd512",
+               "b16-bwd512", "b16-bwdq512", "b16-bwdkv512",
+               "med-b8-noremat", "med-b16-ce"], 33700),
     ("trace-1.5b", [sys.executable, "tools/trace_analyze.py", "run",
                     "gpt2-1.5b", "16", "full", "2048"], 1500),
     # outer budgets cover each tool's own per-config 1500s timeouts

@@ -59,6 +59,16 @@ VARIANTS = {
     # fwd-tile asymmetry
     "b16-fbq512": _v(fb=512, fbkv=1024),
     "b16-fbkv512": _v(fb=1024, fbkv=512),
+    # combined levers: offload_flash (skip the flash-fwd recompute) x
+    # bwd tiles / batch growth — if the individual levers pay, their
+    # combination is the plausible headline winner; all guard-checked
+    # like everything else before any backend contact
+    "b16-offloadflash-bwd512": _v(pol="offload_flash", bwdq=512,
+                                  bwdkv=512),
+    "b18-offloadflash-ce": _v(batch=18, pol="offload_flash"),
+    "b20-offloadflash-ce": _v(batch=20, pol="offload_flash"),
+    "b12-flashonly-bwd512": _v(batch=12, pol="flash_only", bwdq=512,
+                               bwdkv=512),
     # --- medium secondary family ------------------------------------
     "med-b8": _v(preset="gpt2-medium", batch=8, pol="selective", lc=0,
                  stage=1, me=False),
