@@ -146,17 +146,47 @@ def _sub(which):
     return None
 
 
+HEADLINE_OVERRIDE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "BENCH_HEADLINE.json")
+
+
+def _headline_overrides() -> dict:
+    """Optional repo-root BENCH_HEADLINE.json selecting the probe-winning
+    headline variant ({batch, remat_pol, flash_block, flash_block_kv,
+    bwd_block_q, bwd_block_kv, loss_chunk}) — when tools/headline_probe.py
+    finds a faster configuration, flipping the driver headline to it is a
+    one-line data change, not bench-code surgery. Absent file = the
+    established b16-full-ce config."""
+    try:
+        with open(HEADLINE_OVERRIDE) as f:
+            return json.load(f)
+    except OSError:
+        return {}                       # absent: the established config
+    except ValueError as e:
+        # a BROKEN override must not silently publish the wrong config
+        # as the headline — shout and fall back
+        print(f"bench: BENCH_HEADLINE.json is malformed ({e}); "
+              f"falling back to the default headline config",
+              file=sys.stderr)
+        return {}
+
+
 def _run_one(which):
     on_tpu = _on_tpu()
     if which == "headline":
         preset = "gpt2-1.5b" if on_tpu else "gpt2-small"
-        batch, seq = (16, 1024) if on_tpu else (2, 128)
+        ov = _headline_overrides() if on_tpu else {}
+        batch, seq = (ov.get("batch", 16), 1024) if on_tpu else (2, 128)
         dt, tps, mfu = run_config(
             preset, batch, seq, 10 if on_tpu else 2,
             {"bf16": {"enabled": True, "memory_efficient": True},
              "zero_optimization": {"stage": 3}},
-            on_tpu, remat_pol="full", flash_block=1024,
-            loss_chunk=2048 if on_tpu else 0)
+            on_tpu, remat_pol=ov.get("remat_pol", "full"),
+            flash_block=ov.get("flash_block", 1024),
+            flash_block_kv=ov.get("flash_block_kv"),
+            bwd_block_q=ov.get("bwd_block_q"),
+            bwd_block_kv=ov.get("bwd_block_kv"),
+            loss_chunk=(ov.get("loss_chunk", 2048) if on_tpu else 0))
         return {"preset": preset, "batch": batch, "seq": seq,
                 "dt": dt, "tps": tps, "mfu": mfu}
     if which == "medium":
@@ -309,9 +339,14 @@ def main():
                 "batch": batch15, "seq": seq,
                 "step_ms": round(dt15 * 1e3, 2),
                 "mfu": round(mfu15, 4),
-                "mode": "bf16 memory_efficient (bf16 params+moments, "
-                        "stochastic rounding), zero_stage=3, "
-                        "full remat, flash attention, chunked CE",
+                # built from the ACTUAL config (BENCH_HEADLINE.json may
+                # have overridden it — the published label must match)
+                "mode": ("bf16 memory_efficient (bf16 params+moments, "
+                         "stochastic rounding), zero_stage=3, "
+                         f"{_headline_overrides().get('remat_pol', 'full')}"
+                         " remat, flash attention, "
+                         + ("chunked CE" if _headline_overrides().get(
+                             "loss_chunk", 2048) else "dense CE")),
             },
             "secondary_gpt2_medium": {
                 "tokens_per_sec": round(tps_m, 1),

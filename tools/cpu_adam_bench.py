@@ -1,0 +1,78 @@
+"""CPU Adam/Adagrad throughput micro-benchmark.
+
+Analog of the reference's `tests/perf/adam_test.py` (CPU Adam throughput
+over a synthetic parameter) for the AVX C++ step in
+`csrc/adam/cpu_adam.cpp`: elements/second of the fused
+momentum+variance+update loop vs a vectorized numpy reference — the
+number that bounds the host half of the ZeRO-Offload 3-stage pipeline
+(`runtime/zero/offload.py`; the loopback tool consumes exactly these
+per-shard Adam durations).
+
+Usage: python tools/cpu_adam_bench.py [elems ...]   (default 1M 8M 64M)
+Prints one JSON line per size.
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+from deepspeed_tpu.ops.cpu_adam import DeepSpeedCPUAdam
+
+
+def numpy_adam_step(p, g, m, v, step, lr=1e-3, b1=0.9, b2=0.999,
+                    eps=1e-8, wd=0.0):
+    m *= b1
+    m += (1 - b1) * g
+    v *= b2
+    v += (1 - b2) * g * g
+    bc1 = 1 - b1 ** step
+    bc2 = 1 - b2 ** step
+    p -= lr * (m / bc1) / (np.sqrt(v / bc2) + eps)
+    return p
+
+
+def bench(elems: int, iters: int = 10):
+    r = np.random.default_rng(0)
+    params = r.standard_normal(elems).astype(np.float32)
+    grads = r.standard_normal(elems).astype(np.float32)
+
+    opt = DeepSpeedCPUAdam(lr=1e-3)
+    opt.step("w", params.copy(), grads)          # state init + warmup
+    p_c = params.copy()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        opt.step("w", p_c, grads)
+    dt_c = (time.perf_counter() - t0) / iters
+
+    m = np.zeros(elems, np.float32)
+    v = np.zeros(elems, np.float32)
+    p_n = params.copy()
+    numpy_adam_step(p_n, grads, m, v, 1)          # warmup allocs
+    t0 = time.perf_counter()
+    for i in range(iters):
+        numpy_adam_step(p_n, grads, m, v, i + 2)
+    dt_n = (time.perf_counter() - t0) / iters
+
+    print(json.dumps({
+        "metric": "cpu_adam_throughput",
+        "elems": elems,
+        "cxx_ms": round(dt_c * 1e3, 2),
+        "cxx_gelems_per_s": round(elems / dt_c / 1e9, 3),
+        "numpy_ms": round(dt_n * 1e3, 2),
+        "speedup_vs_numpy": round(dt_n / dt_c, 2),
+    }), flush=True)
+
+
+def main():
+    sizes = [int(a) for a in sys.argv[1:]] or [1_000_000, 8_000_000,
+                                               64_000_000]
+    for n in sizes:
+        bench(n)
+
+
+if __name__ == "__main__":
+    main()
