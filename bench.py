@@ -177,18 +177,22 @@ def _run_one(which):
         preset = "gpt2-1.5b" if on_tpu else "gpt2-small"
         ov = _headline_overrides() if on_tpu else {}
         batch, seq = (ov.get("batch", 16), 1024) if on_tpu else (2, 128)
+        remat_pol = ov.get("remat_pol", "full")
+        loss_chunk = ov.get("loss_chunk", 2048) if on_tpu else 0
         dt, tps, mfu = run_config(
             preset, batch, seq, 10 if on_tpu else 2,
             {"bf16": {"enabled": True, "memory_efficient": True},
              "zero_optimization": {"stage": 3}},
-            on_tpu, remat_pol=ov.get("remat_pol", "full"),
+            on_tpu, remat_pol=remat_pol,
             flash_block=ov.get("flash_block", 1024),
             flash_block_kv=ov.get("flash_block_kv"),
             bwd_block_q=ov.get("bwd_block_q"),
             bwd_block_kv=ov.get("bwd_block_kv"),
-            loss_chunk=(ov.get("loss_chunk", 2048) if on_tpu else 0))
+            loss_chunk=loss_chunk)
+        # echo the ACTUAL config so the published label can't drift
         return {"preset": preset, "batch": batch, "seq": seq,
-                "dt": dt, "tps": tps, "mfu": mfu}
+                "dt": dt, "tps": tps, "mfu": mfu,
+                "remat_pol": remat_pol, "loss_chunk": loss_chunk}
     if which == "medium":
         preset = "gpt2-medium" if on_tpu else "gpt2-small"
         batch, seq = (8, 1024) if on_tpu else (2, 128)
@@ -339,14 +343,14 @@ def main():
                 "batch": batch15, "seq": seq,
                 "step_ms": round(dt15 * 1e3, 2),
                 "mfu": round(mfu15, 4),
-                # built from the ACTUAL config (BENCH_HEADLINE.json may
-                # have overridden it — the published label must match)
+                # label echoes what _run_one ACTUALLY ran (incl. any
+                # BENCH_HEADLINE.json override) — never re-derived
                 "mode": ("bf16 memory_efficient (bf16 params+moments, "
                          "stochastic rounding), zero_stage=3, "
-                         f"{_headline_overrides().get('remat_pol', 'full')}"
-                         " remat, flash attention, "
-                         + ("chunked CE" if _headline_overrides().get(
-                             "loss_chunk", 2048) else "dense CE")),
+                         f"{h.get('remat_pol', 'full')} remat, "
+                         "flash attention, "
+                         + ("chunked CE" if h.get("loss_chunk")
+                            else "dense CE")),
             },
             "secondary_gpt2_medium": {
                 "tokens_per_sec": round(tps_m, 1),

@@ -469,14 +469,21 @@ class DeepSpeedEngine:
     # compressed DP gradient reduction (comm_backend_name="dcn_compressed")
     # ------------------------------------------------------------------
     def _validate_compressed_comm(self) -> None:
-        """Compressed reduction covers plain data parallelism — the same
-        scope as the reference's 1-bit backends (DP allreduce compression;
-        incompatible with ZeRO stages >= 2, ref: onebit docs + stage checks
-        in runtime/fp16/onebit/adam.py)."""
-        if self.config.zero.stage > 1:
+        """Compressed reduction covers plain data parallelism with ZeRO
+        stage <= 2 — one stage BEYOND the reference's 1-bit backends
+        (stage <= 1, ref: onebit docs + stage checks in
+        runtime/fp16/onebit/adam.py): stage 2's gradient partitioning
+        dissolves here (the sharded optimizer update consumes its slice
+        of the compressed-averaged gradient in the auto domain, outside
+        the manual-'data' shard_map), so per-rank gradients stay whole
+        exactly as error feedback requires. Stage 3 shards PARAMETERS
+        over 'fsdp', which the wire path does not compose with (see
+        PERF.md 'Compressed DCN x ZeRO-fsdp — scope position')."""
+        if self.config.zero.stage > 2:
             raise ValueError(
-                "comm_backend_name='dcn_compressed' requires zero stage <= 1 "
-                "(gradients must be whole per rank to error-compress)")
+                "comm_backend_name='dcn_compressed' requires zero stage <= 2 "
+                "(stage 3 shards params over fsdp; the compressed wire "
+                "path is data-parallel — see PERF.md scope position)")
         for axis in ("fsdp", "model", "pipe", "sequence"):
             if mesh_lib.axis_size(self.mesh, axis) > 1:
                 raise ValueError(

@@ -160,11 +160,33 @@ def test_dcn_compressed_wire_payload_is_packed_uint8(devices):
     assert not any(f"f32[{HIDDEN},{HIDDEN}]" in ln for ln in gathers)
 
 
-def test_dcn_compressed_rejects_zero2(devices):
+def test_dcn_compressed_zero2_converges_with_sharded_state(devices):
+    """Compressed wire + ZeRO stage 2 — one stage beyond the reference's
+    1-bit backends: stage 2's gradient partitioning dissolves (the
+    sharded opt update slices the compressed-averaged gradient in the
+    auto domain), so error feedback still sees whole per-rank grads
+    while the optimizer state keeps its 'data'-axis sharding."""
+    losses, engine = _train_dp8(
+        {"comm_backend_name": "dcn_compressed",
+         # min shard lowered so the tiny test model's 32x32 kernels
+         # actually shard over dp=8 (default 1024 leaves them whole)
+         "zero_optimization": {"stage": 2, "stage3_min_shard_size": 1}},
+        return_engine=True)
+    assert losses[-1] < losses[0] * 0.5
+    # the stage-2 memory win survives compression: moments are sharded
+    moments = [x for x in jax.tree_util.tree_leaves(engine.state.opt_state)
+               if getattr(x, "ndim", 0) == 2]
+    assert moments, "no matrix-shaped optimizer-state leaves found"
+    assert any(m.sharding.shard_shape(m.shape) != tuple(m.shape)
+               for m in moments), \
+        "stage-2 optimizer state not sharded under dcn_compressed"
+
+
+def test_dcn_compressed_rejects_zero3(devices):
     cfg = dict(BASE)
     cfg["optimizer"] = {"type": "adamw", "params": {"lr": 1e-2}}
     cfg["comm_backend_name"] = "dcn_compressed"
-    cfg["zero_optimization"] = {"stage": 2}
+    cfg["zero_optimization"] = {"stage": 3}
     params = simple_model_params(hidden_dim=HIDDEN, nlayers=2)
     with pytest.raises(ValueError):
         deepspeed_tpu.initialize(model=simple_model_loss,

@@ -13,10 +13,11 @@ Prints one JSON line per size.
 """
 
 import json
+import os
 import sys
 import time
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
@@ -43,19 +44,23 @@ def bench(elems: int, iters: int = 10):
     opt = DeepSpeedCPUAdam(lr=1e-3)
     opt.step("w", params.copy(), grads)          # state init + warmup
     p_c = params.copy()
-    t0 = time.perf_counter()
+    ts = []
     for _ in range(iters):
+        t0 = time.perf_counter()
         opt.step("w", p_c, grads)
-    dt_c = (time.perf_counter() - t0) / iters
+        ts.append(time.perf_counter() - t0)
+    dt_c = sorted(ts)[len(ts) // 2]              # median: GC/scheduler-robust
 
     m = np.zeros(elems, np.float32)
     v = np.zeros(elems, np.float32)
     p_n = params.copy()
     numpy_adam_step(p_n, grads, m, v, 1)          # warmup allocs
-    t0 = time.perf_counter()
+    ts = []
     for i in range(iters):
+        t0 = time.perf_counter()
         numpy_adam_step(p_n, grads, m, v, i + 2)
-    dt_n = (time.perf_counter() - t0) / iters
+        ts.append(time.perf_counter() - t0)
+    dt_n = sorted(ts)[len(ts) // 2]
 
     print(json.dumps({
         "metric": "cpu_adam_throughput",

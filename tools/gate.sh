@@ -24,8 +24,8 @@ if [[ "${1:-}" == "quick" ]]; then
     # files by name heuristic; plus the always-on smoke set
     # (engine/config/gpt cover the load-bearing core)
     tests="tests/test_engine.py tests/test_config.py tests/test_gpt.py"
-    tests="$tests $(git diff --name-only HEAD -- 'tests/test_*.py' | tr '\n' ' ')"
-    changed=$(git diff --name-only HEAD -- 'deepspeed_tpu/**.py' \
+    tests="$tests $(git diff --name-only --diff-filter=d HEAD -- 'tests/test_*.py' | tr '\n' ' ')"
+    changed=$(git diff --name-only --diff-filter=d HEAD -- 'deepspeed_tpu/**.py' \
               | xargs -rn1 basename | sed 's/\.py$//')
     for c in $changed; do
         for t in tests/test_*"${c#*_}"* tests/test_*"$c"*; do
