@@ -100,7 +100,12 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     sliding windows compose with the sequence sharding: heads stay whole
     per rank, so after the seq->head all-to-all the local flash kernel
     sees full rows and applies the masks exactly as in the unsharded
-    case (ring SP cannot do this — its K/V blocks never co-reside).
+    case. (Ring SP composes with the same features by a different route
+    — per-token metadata rotates with its K/V block; see
+    ops/attention/ring.py. Trade-off: Ulysses is perfectly
+    load-balanced under causal masks and needs sp | heads; the ring has
+    no head-divisibility constraint, rotates only the small grouped K/V
+    under GQA, and stops early under sliding windows.)
     """
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
