@@ -218,3 +218,42 @@ def test_guard_wrappers_raise():
     big = gpt.preset("gpt2-large", max_seq_len=32768, dtype=jnp.bfloat16)
     with pytest.raises(hbm.MemoryGuardError):
         hbm.guard_infer_config(big, 256, 32768, device=dev)
+
+
+# ---------------------------------------------------------------------------
+# property-based estimator invariants (hypothesis)
+# ---------------------------------------------------------------------------
+
+from hypothesis import given, settings, strategies as st
+
+
+@settings(max_examples=30, deadline=None)
+@given(st.integers(min_value=1, max_value=64),       # batch
+       st.sampled_from([256, 1024, 4096]),           # seq
+       st.sampled_from(["full", "selective", "flash_only"]),
+       st.booleans())                                 # loss chunked?
+def test_estimator_monotonicity(batch, seq, pol, chunked):
+    """The guard's safety rests on these order relations: more batch/seq
+    never estimates SMALLER; 'full' remat never estimates above
+    'selective' or no-remat; chunked CE never estimates above dense.
+    A violation would let a strictly-bigger program through a guard the
+    smaller one failed."""
+    cfg = gpt.preset("gpt2-medium", max_seq_len=seq,
+                     dtype=jnp.bfloat16, remat=True, remat_policy=pol,
+                     loss_chunk=2048 if chunked else 0)
+    base = hbm.estimate_gpt_train_bytes(cfg, batch, seq).total
+    assert hbm.estimate_gpt_train_bytes(cfg, batch + 1, seq).total >= base
+    if seq >= 512:
+        assert hbm.estimate_gpt_train_bytes(cfg, batch, seq * 2).total \
+            >= base
+    import dataclasses
+    if pol != "full":
+        full = dataclasses.replace(cfg, remat_policy="full")
+        assert hbm.estimate_gpt_train_bytes(full, batch, seq).total <= base
+    norem = dataclasses.replace(cfg, remat=False)
+    assert hbm.estimate_gpt_train_bytes(norem, batch, seq).total >= \
+        hbm.estimate_gpt_train_bytes(
+            dataclasses.replace(cfg, remat_policy="full"), batch, seq).total
+    if chunked:
+        dense = dataclasses.replace(cfg, loss_chunk=0)
+        assert hbm.estimate_gpt_train_bytes(dense, batch, seq).total >= base
