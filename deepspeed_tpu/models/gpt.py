@@ -73,6 +73,12 @@ class GPTConfig:
     # (ops/attention/ulysses.py).
     sequence_parallel: bool = False
     sp_impl: str = "ring"
+    # ring data layout: "contiguous" shards the sequence in order;
+    # "zigzag" balances the causal triangle across the ring (~2x at
+    # large ring sizes) and expects tokens/targets/positions/segment
+    # metadata pre-permuted with ops.attention.ring.zigzag_perm (the
+    # rest of the model is per-token, so only attention cares)
+    sp_layout: str = "contiguous"
     mesh: Any = None
     # --- architecture variants for foreign-checkpoint injection --------
     # (ref: module_inject/replace_policy.py — GPT-Neo :112 uses unscaled
@@ -262,6 +268,13 @@ def _attention(q, k, v, cfg: GPTConfig, segment_ids=None, kv_mask=None):
         # GQA works under both SP impls: ring rotates the small grouped
         # k/v; Ulysses needs the sp degree to divide both head counts
         if cfg.sp_impl == "ulysses":
+            if cfg.sp_layout == "zigzag":
+                # a contiguous causal mask applied to zigzag-permuted
+                # tokens is silently wrong attention — refuse loudly
+                raise ValueError(
+                    "sp_layout='zigzag' is a RING layout (balances the "
+                    "causal ring schedule); ulysses keeps the natural "
+                    "order — use sp_layout='contiguous' with it")
             from deepspeed_tpu.ops.attention.ulysses import ulysses_attention
             S = q.shape[1]
             blocks = _flash_blocks(cfg, S)
@@ -290,7 +303,8 @@ def _attention(q, k, v, cfg: GPTConfig, segment_ids=None, kv_mask=None):
             segment_ids=segment_ids, kv_mask=kv_mask,
             window=cfg.attn_window, use_flash=blocks is not None,
             block_q=blocks[0] if blocks else 512,
-            block_kv=blocks[1] if blocks else 512)
+            block_kv=blocks[1] if blocks else 512,
+            layout=cfg.sp_layout)
     blocks = _flash_blocks(cfg, q.shape[1])
     if blocks is not None:
         from deepspeed_tpu.ops.attention.flash import flash_attention
@@ -391,6 +405,12 @@ def forward(params: Dict, tokens: jnp.ndarray, cfg: GPTConfig,
     learned positional embedding at each document start."""
     B, S = tokens.shape
     dtype = cfg.dtype
+    if (cfg.sequence_parallel and cfg.sp_layout == "zigzag"
+            and positions is None):
+        raise ValueError(
+            "sp_layout='zigzag' permutes the token order — pass "
+            "positions (the zigzag_perm itself for unpacked batches) so "
+            "positional encodings follow the tokens")
     wte = params["wte"]["embedding"].astype(dtype)
     x = wte[tokens]
     if cfg.use_wpe:

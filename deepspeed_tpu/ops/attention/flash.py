@@ -126,9 +126,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
             if window is not None:
                 s = _window_mask(s, rows, cols, window)
         if has_mask:
-            s = jnp.where(mask_ref[0][None, :] > 0, s, NEG_INF)
+            s = jnp.where(mask_ref[0, 0][None, :] > 0, s, NEG_INF)
         if has_segs:
-            s = jnp.where(qseg_ref[0][:, None] == kseg_ref[0][None, :],
+            s = jnp.where(qseg_ref[0, 0][:, None] == kseg_ref[0, 0][None, :],
                           s, NEG_INF)
 
         m_prev = m_scratch[:, :1]                        # [bq, 1]
@@ -155,22 +155,27 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
 
 
 def _mask_spec(block_kv, kvmap):
-    """Block spec for the optional [B, Skv] key-validity mask, following
-    the (possibly clamped) kv block index map."""
+    """Block spec for the optional key-validity mask, following the
+    (possibly clamped) kv block index map. The [B, Skv] metadata is fed
+    to the kernel as [B, 1, Skv]: Mosaic requires the LAST TWO dims of a
+    block to be (8, 128)-tile-divisible or equal to the array dims, and
+    a (1, block) slice of [B, Skv] violates that whenever B > 1 (caught
+    by the on-chip smoke; interpret mode does not check tiling)."""
     def mmap(b, h, qi, ki):
         _, _, kblk, _ = kvmap(b, h, qi, ki)
-        return (b, kblk)
+        return (b, 0, kblk)
 
-    return pl.BlockSpec((1, block_kv), mmap)
+    return pl.BlockSpec((1, 1, block_kv), mmap)
 
 
 def _qseg_spec(block_q, qmap):
-    """Block spec for the q-side [B, S] segment ids, following qmap."""
+    """Block spec for the q-side segment ids ([B, S] fed as [B, 1, S] —
+    see _mask_spec), following qmap."""
     def smap(*ids):
         _, _, qblk, _ = qmap(*ids)
-        return (ids[0], qblk)
+        return (ids[0], 0, qblk)
 
-    return pl.BlockSpec((1, block_q), smap)
+    return pl.BlockSpec((1, 1, block_q), smap)
 
 
 def _group_head(map_fn, group: int):
@@ -225,11 +230,11 @@ def _flash_fwd(q, k, v, mask, qsegs, ksegs, causal, scale, block_q, block_kv,
     operands = [q, k, v]
     if has_mask:
         in_specs.append(_mask_spec(block_kv, kvmap))
-        operands.append(mask)
+        operands.append(mask[:, None])
     if has_segs:
         in_specs.append(_qseg_spec(block_q, qmap))
         in_specs.append(_mask_spec(block_kv, kvmap))   # kv-side segments
-        operands.extend([qsegs, ksegs])
+        operands.extend([qsegs[:, None], ksegs[:, None]])
 
     out_shape = [
         jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
@@ -297,9 +302,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             if window is not None:
                 s = _window_mask(s, rows, cols, window)
         if has_mask:
-            s = jnp.where(mask_ref[0][None, :] > 0, s, NEG_INF)
+            s = jnp.where(mask_ref[0, 0][None, :] > 0, s, NEG_INF)
         if has_segs:
-            s = jnp.where(qseg_ref[0][:, None] == kseg_ref[0][None, :],
+            s = jnp.where(qseg_ref[0, 0][:, None] == kseg_ref[0, 0][None, :],
                           s, NEG_INF)
         p = jnp.exp(s - lse)                               # [bq, bkv]
 
@@ -359,9 +364,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             if window is not None:
                 s = _window_mask(s, rows, cols, window)
         if has_mask:
-            s = jnp.where(mask_ref[0][None, :] > 0, s, NEG_INF)
+            s = jnp.where(mask_ref[0, 0][None, :] > 0, s, NEG_INF)
         if has_segs:
-            s = jnp.where(qseg_ref[0][:, None] == kseg_ref[0][None, :],
+            s = jnp.where(qseg_ref[0, 0][:, None] == kseg_ref[0, 0][None, :],
                           s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -426,11 +431,11 @@ def _flash_bwd(causal, scale, block_q, block_kv, window, res, g, q_off=0,
     operands = [q, k, v, do, lse_b, delta_b]
     if has_mask:
         in_specs.append(_mask_spec(block_kv, kvmap_q_outer))
-        operands.append(mask)
+        operands.append(mask[:, None])
     if has_segs:
         in_specs.append(_qseg_spec(block_q, qmap))
         in_specs.append(_mask_spec(block_kv, kvmap_q_outer))
-        operands.extend([qsegs, ksegs])
+        operands.extend([qsegs[:, None], ksegs[:, None]])
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, has_mask=has_mask,
                           has_segs=has_segs,
@@ -486,11 +491,11 @@ def _flash_bwd(causal, scale, block_q, block_kv, window, res, g, q_off=0,
         # kv blocks are on the OUTER grid dim here; _mask_spec follows
         # this call's kvmap, which resolves to (b, ki)
         in_specs.append(_mask_spec(block_kv, kvmap))
-        operands.append(mask)
+        operands.append(mask[:, None])
     if has_segs:
         in_specs.append(_qseg_spec(block_q, qmap_kv_outer))
         in_specs.append(_mask_spec(block_kv, kvmap))
-        operands.extend([qsegs, ksegs])
+        operands.extend([qsegs[:, None], ksegs[:, None]])
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, causal=causal, has_mask=has_mask,
                           has_segs=has_segs,

@@ -41,6 +41,27 @@ Contract for degenerate rows: a row with NO valid visible key anywhere
 returns exact 0 (the dense single-chip path returns a uniform average of
 v instead — both are garbage-by-contract; any masked loss zeroes their
 gradient).
+
+Zigzag layout (``layout="zigzag"``): causal ring attention on a
+contiguous layout is imbalanced — device d's queries can see d+1 of the
+n K/V blocks, so the last device does ~2x the work of the average and
+sets the wall clock. The zigzag layout splits the sequence into 2n
+chunks and gives device d chunks (d, 2n-1-d) — every device then holds
+exactly one "early" and one "late" chunk and does the SAME work at
+every ring step:
+- step 0 (self): the local shard [lo, hi] is globally monotone and its
+  chunk boundaries align, so a plain LOCAL causal mask is exactly the
+  global causal mask restricted to this block;
+- step i>0 against the block from device src=(idx-i) mod n: if
+  src < idx both local chunks see src's LOW chunk fully (its high chunk
+  is entirely in their future); if src > idx the local HIGH chunk sees
+  both of src's chunks fully (the low chunk sees neither). Either way
+  the step computes exactly half the full-block work, mask-free.
+Tokens must be pre-permuted with :func:`zigzag_perm` (and positions /
+targets / segment metadata with them) — the model's per-token compute
+is permutation-invariant, so only the data layout changes.
+Sliding windows are not supported under zigzag (the band geometry is no
+longer a static per-step offset); use the contiguous layout there.
 """
 
 import functools
@@ -61,6 +82,32 @@ def _largest_divisor(n: int, cap: int) -> int:
         if n % c == 0:
             return c
     return 1
+
+
+def zigzag_perm(S: int, n: int) -> np.ndarray:
+    """Token permutation for the zigzag ring layout: split the sequence
+    into 2n chunks; device d's shard is [chunk d, chunk 2n-1-d]. Apply to
+    tokens/targets/positions/segment metadata on the HOST (``x[:, p]``)
+    before sharding the sequence dim contiguously over the ring axis."""
+    assert S % (2 * n) == 0, (S, n)
+    C = S // (2 * n)
+    out = np.empty(S, np.int64)
+    for d in range(n):
+        base = d * 2 * C
+        out[base:base + C] = np.arange(d * C, (d + 1) * C)
+        out[base + C:base + 2 * C] = np.arange((2 * n - 1 - d) * C,
+                                               (2 * n - d) * C)
+    return out
+
+
+def zigzag_unperm(S: int, n: int) -> np.ndarray:
+    """Inverse of :func:`zigzag_perm` (restore global order)."""
+    return np.argsort(zigzag_perm(S, n))
+
+
+def _seq_slice(x, a, b, axis):
+    """x[..., a:b, ...] along ``axis`` (static bounds)."""
+    return None if x is None else jax.lax.slice_in_dim(x, a, b, axis=axis)
 
 
 def _num_steps(n: int, S_loc: int, causal: bool, window) -> int:
@@ -218,11 +265,13 @@ def _rotate(xs, axis, perm):
 
 
 def _ring_fwd_inner(q, k, v, segs, kvm, axis, causal, scale, window,
-                    use_flash, block_q, block_kv, chunk):
+                    use_flash, block_q, block_kv, chunk, layout):
     n = jax.lax.axis_size(axis)
     idx = jax.lax.axis_index(axis)
     B, S_loc, H, D = q.shape
-    steps = _num_steps(n, S_loc, causal, window)
+    zig = layout == "zigzag"
+    steps = n if zig else _num_steps(n, S_loc, causal, window)
+    C = S_loc // 2                           # zigzag half-block
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     if use_flash:
@@ -232,39 +281,80 @@ def _ring_fwd_inner(q, k, v, segs, kvm, axis, causal, scale, window,
         q_use = qp.transpose(0, 2, 1, 3)
         k_cur = kp.transpose(0, 2, 1, 3)
         v_cur = vp.transpose(0, 2, 1, 3)
+        seq_ax = 2                           # seq axis of q/k/v operands
     else:
         q_use, k_cur, v_cur, D0, Dp = q, k, v, D, D
+        seq_ax = 1
     segs_cur, kvm_cur = segs, kvm
+
+    def fwd_block(q_c, k_c, v_c, qsg, sg, km, bc, off, w):
+        """One local attention block in the current operand layout.
+        Returns (o [B,H,Sq,Dp], lse [B,H,Sq])."""
+        if use_flash:
+            return flash_block_fwd_t(
+                q_c, k_c, v_c, kv_mask=km, q_segs=qsg, kv_segs=sg,
+                causal=bc, scale=scale, block_q=block_q,
+                block_kv=block_kv, window=w, q_off=off)
+        return _jnp_block_fwd(q_c, k_c, v_c, qsg, sg, km,
+                              blk_causal=bc, window=w, q_off=off,
+                              scale=scale, chunk=chunk)
 
     m = jnp.full((B, H, S_loc), NEG_INF, jnp.float32)
     l = jnp.zeros((B, H, S_loc), jnp.float32)
     acc = jnp.zeros((B, H, S_loc, Dp), jnp.float32)
 
     for i in range(steps):
-        blk_causal, q_off, blk_window = _step_cfg(i, S_loc, causal, window)
+        if zig and i > 0:
+            # balanced zigzag step: src's block is either entirely
+            # visible to-the-low-chunk-level (src < idx: its low chunk
+            # is past for BOTH local chunks, its high chunk future for
+            # both) or visible only to the local high chunk (src > idx:
+            # both its chunks are past for the high chunk, future for
+            # the low). Both branches are mask-free half-block work.
+            def br_lo(k_c=k_cur, v_c=v_cur, sg=segs_cur, km=kvm_cur):
+                o, lse = fwd_block(
+                    q_use, _seq_slice(k_c, 0, C, seq_ax),
+                    _seq_slice(v_c, 0, C, seq_ax), segs,
+                    _seq_slice(sg, 0, C, 1), _seq_slice(km, 0, C, 1),
+                    False, 0, None)
+                return o, lse
 
-        def compute(k_c=k_cur, v_c=v_cur, sg=segs_cur, km=kvm_cur,
-                    bc=blk_causal, off=q_off, w=blk_window):
-            if use_flash:
-                return flash_block_fwd_t(
-                    q_use, k_c, v_c, kv_mask=km, q_segs=segs, kv_segs=sg,
-                    causal=bc, scale=scale, block_q=block_q,
-                    block_kv=block_kv, window=w, q_off=off)
-            return _jnp_block_fwd(q_use, k_c, v_c, segs, sg, km,
-                                  blk_causal=bc, window=w, q_off=off,
-                                  scale=scale, chunk=chunk)
+            def br_hi(k_c=k_cur, v_c=v_cur, sg=segs_cur, km=kvm_cur):
+                o_hi, lse_hi = fwd_block(
+                    _seq_slice(q_use, C, S_loc, 2 if use_flash else 1),
+                    k_c, v_c, _seq_slice(segs, C, S_loc, 1), sg, km,
+                    False, 0, None)
+                pad_o = jnp.zeros((B, H, C, Dp), o_hi.dtype)
+                pad_l = jnp.full((B, H, C), NEG_INF, jnp.float32)
+                return (jnp.concatenate([pad_o, o_hi], axis=2),
+                        jnp.concatenate([pad_l, lse_hi], axis=2))
 
-        if causal and i > 0:
-            # devices "above" this step's source never see it (the block
-            # is entirely in their future) — skip the compute, not just
-            # the result. No collectives inside, so a device-varying
-            # branch is fine under shard_map.
-            o_i, lse_i = jax.lax.cond(
-                idx >= i, compute,
-                lambda: (jnp.zeros((B, H, S_loc, Dp), q.dtype),
-                         jnp.full((B, H, S_loc), NEG_INF, jnp.float32)))
+            src = jax.lax.rem(idx - i + n, n)
+            o_i, lse_i = jax.lax.cond(src < idx, br_lo, br_hi)
+            o_i = o_i.astype(q.dtype)
         else:
-            o_i, lse_i = compute()
+            blk_causal, q_off, blk_window = (
+                (causal, 0, window) if zig
+                else _step_cfg(i, S_loc, causal, window))
+
+            def compute(k_c=k_cur, v_c=v_cur, sg=segs_cur, km=kvm_cur,
+                        bc=blk_causal, off=q_off, w=blk_window):
+                return fwd_block(q_use, k_c, v_c, segs, sg, km, bc, off,
+                                 w)
+
+            if causal and i > 0:
+                # contiguous layout: devices "above" this step's source
+                # never see it (the block is entirely in their future) —
+                # skip the compute, not just the result. No collectives
+                # inside, so a device-varying branch is fine under
+                # shard_map.
+                o_i, lse_i = jax.lax.cond(
+                    idx >= i, compute,
+                    lambda: (jnp.zeros((B, H, S_loc, Dp), q.dtype),
+                             jnp.full((B, H, S_loc), NEG_INF,
+                                      jnp.float32)))
+            else:
+                o_i, lse_i = compute()
 
         m_new = jnp.maximum(m, lse_i)
         alpha = jnp.exp(m - m_new)
@@ -289,30 +379,34 @@ def _ring_fwd_inner(q, k, v, segs, kvm, axis, causal, scale, window,
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10,
-                                                    11, 12))
+                                                    11, 12, 13))
 def _ring_core(q, k, v, segs, kvm, axis, causal, scale, window, use_flash,
-               block_q, block_kv, chunk):
+               block_q, block_kv, chunk, layout):
     out, _ = _ring_fwd_inner(q, k, v, segs, kvm, axis, causal, scale,
-                             window, use_flash, block_q, block_kv, chunk)
+                             window, use_flash, block_q, block_kv, chunk,
+                             layout)
     return out
 
 
 def _ring_core_fwd(q, k, v, segs, kvm, axis, causal, scale, window,
-                   use_flash, block_q, block_kv, chunk):
+                   use_flash, block_q, block_kv, chunk, layout):
     out, lse = _ring_fwd_inner(q, k, v, segs, kvm, axis, causal, scale,
-                               window, use_flash, block_q, block_kv, chunk)
+                               window, use_flash, block_q, block_kv,
+                               chunk, layout)
     return out, (q, k, v, segs, kvm, out, lse)
 
 
 def _ring_core_bwd(axis, causal, scale, window, use_flash, block_q,
-                   block_kv, chunk, res, g):
+                   block_kv, chunk, layout, res, g):
     q, k, v, segs, kvm, o, lse = res
     do = g
     n = jax.lax.axis_size(axis)
     idx = jax.lax.axis_index(axis)
     B, S_loc, H, D = q.shape
     Hkv = k.shape[2]
-    steps = _num_steps(n, S_loc, causal, window)
+    zig = layout == "zigzag"
+    steps = n if zig else _num_steps(n, S_loc, causal, window)
+    C = S_loc // 2
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     # global per-row delta = rowsum(do * o) — shared by every block's
@@ -328,42 +422,84 @@ def _ring_core_bwd(axis, causal, scale, window, use_flash, block_q,
         k_cur = kp.transpose(0, 2, 1, 3)
         v_cur = vp.transpose(0, 2, 1, 3)
         do_use = dop.transpose(0, 2, 1, 3)
+        seq_ax = 2
     else:
         q_use, k_cur, v_cur, do_use = q, k, v, do
         D0, Dp = D, D
+        seq_ax = 1
     segs_cur, kvm_cur = segs, kvm
+
+    def bwd_block(q_c, do_c, lse_c, delta_c, k_c, v_c, qsg, sg, km, bc,
+                  off, w):
+        """One local backward block in the current operand layout.
+        Returns fp32 (dq [B,H,Sq,Dp], dk/dv [B,Hkv,Skv,Dp])."""
+        if use_flash:
+            dq_i, dk_i, dv_i = flash_block_bwd_t(
+                q_c, k_c, v_c, do_c, lse_c, kv_mask=km, q_segs=qsg,
+                kv_segs=sg, causal=bc, scale=scale, block_q=block_q,
+                block_kv=block_kv, window=w, q_off=off, delta=delta_c)
+        else:
+            dq_i, dk_i, dv_i = _jnp_block_bwd(
+                q_c, k_c, v_c, do_c, lse_c, delta_c, qsg, sg, km,
+                blk_causal=bc, window=w, q_off=off, scale=scale,
+                chunk=chunk)
+        return (dq_i.astype(jnp.float32), dk_i.astype(jnp.float32),
+                dv_i.astype(jnp.float32))
 
     dq = jnp.zeros((B, H, S_loc, Dp), jnp.float32)
     dk_acc = jnp.zeros((B, Hkv, S_loc, Dp), jnp.float32)
     dv_acc = jnp.zeros((B, Hkv, S_loc, Dp), jnp.float32)
 
     for i in range(steps):
-        blk_causal, q_off, blk_window = _step_cfg(i, S_loc, causal, window)
+        if zig and i > 0:
+            # mirror of the forward's balanced branches (see
+            # _ring_fwd_inner): src < idx -> all q rows vs src's low
+            # chunk (grads land in the accumulator's low half);
+            # src > idx -> local high q rows vs src's full block.
+            def br_lo(k_c=k_cur, v_c=v_cur, sg=segs_cur, km=kvm_cur):
+                dq_i, dk_lo, dv_lo = bwd_block(
+                    q_use, do_use, lse, delta,
+                    _seq_slice(k_c, 0, C, seq_ax),
+                    _seq_slice(v_c, 0, C, seq_ax), segs,
+                    _seq_slice(sg, 0, C, 1), _seq_slice(km, 0, C, 1),
+                    False, 0, None)
+                pad = jnp.zeros((B, Hkv, C, Dp), jnp.float32)
+                return (dq_i, jnp.concatenate([dk_lo, pad], axis=2),
+                        jnp.concatenate([dv_lo, pad], axis=2))
 
-        def compute(k_c=k_cur, v_c=v_cur, sg=segs_cur, km=kvm_cur,
-                    bc=blk_causal, off=q_off, w=blk_window):
-            if use_flash:
-                dq_i, dk_i, dv_i = flash_block_bwd_t(
-                    q_use, k_c, v_c, do_use, lse, kv_mask=km,
-                    q_segs=segs, kv_segs=sg, causal=bc, scale=scale,
-                    block_q=block_q, block_kv=block_kv, window=w,
-                    q_off=off, delta=delta)
-            else:
-                dq_i, dk_i, dv_i = _jnp_block_bwd(
-                    q_use, k_c, v_c, do_use, lse, delta, segs, sg, km,
-                    blk_causal=bc, window=w, q_off=off, scale=scale,
-                    chunk=chunk)
-            return (dq_i.astype(jnp.float32), dk_i.astype(jnp.float32),
-                    dv_i.astype(jnp.float32))
+            def br_hi(k_c=k_cur, v_c=v_cur, sg=segs_cur, km=kvm_cur):
+                dq_hi, dk_i, dv_i = bwd_block(
+                    _seq_slice(q_use, C, S_loc, seq_ax),
+                    _seq_slice(do_use, C, S_loc, seq_ax),
+                    _seq_slice(lse, C, S_loc, 2),
+                    _seq_slice(delta, C, S_loc, 2),
+                    k_c, v_c, _seq_slice(segs, C, S_loc, 1), sg, km,
+                    False, 0, None)
+                pad = jnp.zeros((B, H, C, Dp), jnp.float32)
+                return (jnp.concatenate([pad, dq_hi], axis=2), dk_i,
+                        dv_i)
 
-        if causal and i > 0:
-            dq_i, dk_i, dv_i = jax.lax.cond(
-                idx >= i, compute,
-                lambda: (jnp.zeros((B, H, S_loc, Dp), jnp.float32),
-                         jnp.zeros((B, Hkv, S_loc, Dp), jnp.float32),
-                         jnp.zeros((B, Hkv, S_loc, Dp), jnp.float32)))
+            src = jax.lax.rem(idx - i + n, n)
+            dq_i, dk_i, dv_i = jax.lax.cond(src < idx, br_lo, br_hi)
         else:
-            dq_i, dk_i, dv_i = compute()
+            blk_causal, q_off, blk_window = (
+                (causal, 0, window) if zig
+                else _step_cfg(i, S_loc, causal, window))
+
+            def compute(k_c=k_cur, v_c=v_cur, sg=segs_cur, km=kvm_cur,
+                        bc=blk_causal, off=q_off, w=blk_window):
+                return bwd_block(q_use, do_use, lse, delta, k_c, v_c,
+                                 segs, sg, km, bc, off, w)
+
+            if causal and i > 0:
+                dq_i, dk_i, dv_i = jax.lax.cond(
+                    idx >= i, compute,
+                    lambda: (jnp.zeros((B, H, S_loc, Dp), jnp.float32),
+                             jnp.zeros((B, Hkv, S_loc, Dp), jnp.float32),
+                             jnp.zeros((B, Hkv, S_loc, Dp),
+                                       jnp.float32)))
+            else:
+                dq_i, dk_i, dv_i = compute()
 
         dq = dq + dq_i
         dk_acc = dk_acc + dk_i
@@ -409,7 +545,8 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    window: Optional[int] = None,
                    use_flash: Optional[bool] = None,
                    block_q: int = 512, block_kv: int = 512,
-                   chunk: int = 1024) -> jnp.ndarray:
+                   chunk: int = 1024,
+                   layout: str = "contiguous") -> jnp.ndarray:
     """Exact (causal) attention with the sequence dim sharded over ``axis``.
 
     q,k,v: [B, S, H, D] global arrays whose S dim is (or will be) sharded
@@ -430,11 +567,24 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     jnp elsewhere (``chunk`` keys at a time) — peak local memory is
     O(S_loc · block), not O(S_loc²). Backward runs through a ring-level
     custom VJP that replays the rotation (no dense per-step residuals).
+
+    layout: "contiguous" (default) shards the sequence in order;
+    "zigzag" expects tokens pre-permuted with :func:`zigzag_perm` and
+    balances the causal triangle so every device does equal work at
+    every ring step (~2x faster at large ring sizes; see module
+    docstring). Causal-only, no sliding window.
     """
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
     if window is not None:
         assert causal, "sliding window requires causal attention"
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown ring layout {layout!r}")
+    if layout == "zigzag":
+        if not causal or window is not None:
+            raise ValueError(
+                "zigzag layout balances the CAUSAL triangle; use the "
+                "contiguous layout for non-causal or windowed attention")
     H, Hkv = q.shape[2], k.shape[2]
     assert H % Hkv == 0, f"q heads {H} not a multiple of kv heads {Hkv}"
     assert v.shape[2] == Hkv, \
@@ -443,11 +593,16 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     S = q.shape[1]
     assert S % n_seq == 0, (S, n_seq)
     S_loc = S // n_seq
+    if layout == "zigzag":
+        assert S_loc % 2 == 0, \
+            f"zigzag needs an even local shard, got S_loc={S_loc}"
     if use_flash is None:
         from deepspeed_tpu.utils import on_tpu
         use_flash = on_tpu() and S_loc >= 128
-    block_q = _largest_divisor(S_loc, min(block_q, S_loc))
-    block_kv = _largest_divisor(S_loc, min(block_kv, S_loc))
+    # zigzag steps run on half blocks — tiles must divide C as well
+    blk_unit = S_loc // 2 if layout == "zigzag" else S_loc
+    block_q = _largest_divisor(blk_unit, min(block_q, blk_unit))
+    block_kv = _largest_divisor(blk_unit, min(block_kv, blk_unit))
     if segment_ids is not None:
         segment_ids = segment_ids.astype(jnp.int32)
     if kv_mask is not None:
@@ -455,7 +610,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
     def inner(q, k, v, segs, kvm):
         return _ring_core(q, k, v, segs, kvm, axis, causal, scale, window,
-                          use_flash, block_q, block_kv, chunk)
+                          use_flash, block_q, block_kv, chunk, layout)
 
     spec = P(None, axis, None, None)
     tok_spec = P(None, axis)

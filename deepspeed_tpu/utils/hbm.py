@@ -157,12 +157,14 @@ def estimate_train_bytes(
     est.contributions["grads_or_acts"] = max(grad_bytes, act_bytes)
 
     # --- loss path ----------------------------------------------------
-    if loss_chunk:
-        # chunked CE: fp32 chunk logits + softmax + bwd residual
-        est.contributions["loss"] = loss_chunk * vocab_size * 12
-    else:
-        # dense: fp32 logits + log-probs
-        est.contributions["loss"] = tokens * vocab_size * 8
+    # one cost model for both paths: rows processed at once x fp32
+    # (logits + softmax + bwd residual). Dense is simply chunk=inf —
+    # using a SMALLER per-row factor for dense (as r3 did: 8 vs 12)
+    # breaks the monotonicity the guard's safety rests on in the
+    # clamped regime chunk >= tokens, where the two programs coincide
+    # (hypothesis counterexample: b1/s256/chunk2048)
+    rows = min(loss_chunk, tokens) if loss_chunk else tokens
+    est.contributions["loss"] = rows * vocab_size * 12
 
     est.contributions["fudge"] = FUDGE_BYTES
     return est

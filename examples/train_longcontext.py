@@ -48,6 +48,10 @@ def main():
     ap.add_argument("--sp", type=int, default=8,
                     help="sequence-parallel degree (devices in the ring)")
     ap.add_argument("--impl", default="ring", choices=["ring", "ulysses"])
+    ap.add_argument("--layout", default="contiguous",
+                    choices=["contiguous", "zigzag"],
+                    help="ring data layout; zigzag balances the causal "
+                         "triangle across the ring (~2x at large rings)")
     ap.add_argument("--window", type=int, default=None,
                     help="optional sliding-window size")
     ap.add_argument("--batch", type=int, default=1)
@@ -64,10 +68,14 @@ def main():
     tpu = on_tpu()
     mesh = make_mesh(MeshSpec(data=len(jax.devices()) // args.sp,
                               sequence=args.sp))
+    if args.layout == "zigzag" and args.impl != "ring":
+        ap.error("--layout zigzag is a ring layout; use --impl ring")
+    zig = args.layout == "zigzag"
     cfg = gpt.preset(args.preset, max_seq_len=args.seq,
                      dtype=jnp.bfloat16 if tpu else jnp.float32,
                      use_flash_attention=tpu,
                      sequence_parallel=True, sp_impl=args.impl,
+                     sp_layout="zigzag" if zig else "contiguous",
                      attn_window=args.window, mesh=mesh,
                      loss_chunk=2048)
     params = gpt.init_params(jax.random.PRNGKey(0), cfg)
@@ -87,14 +95,26 @@ def main():
     r = np.random.default_rng(0)
     tokens = r.integers(0, cfg.vocab_size,
                         (args.batch, args.seq + 1)).astype(np.int32)
+    if zig:
+        # zigzag layout: tokens/targets/positions are permuted once on
+        # the host; the mean loss is permutation-invariant
+        from deepspeed_tpu.ops.attention.ring import zigzag_perm
+        p = zigzag_perm(args.seq, args.sp)
+        batch = {"tokens": tokens[:, :args.seq][:, p],
+                 "targets": tokens[:, 1:][:, p],
+                 "positions": np.broadcast_to(
+                     p.astype(np.int32), (args.batch, args.seq))}
+    else:
+        batch = {"tokens": tokens}
     print(f"{args.preset}: {n_params / 1e6:.1f}M params, seq {args.seq} "
           f"over {args.sp}-way {args.impl} SP "
           f"({args.seq // args.sp} tokens/device)"
+          + (", zigzag layout" if zig else "")
           + (f", window {args.window}" if args.window else ""))
 
     for step in range(args.steps):
         t0 = time.perf_counter()
-        loss = float(engine.train_batch({"tokens": tokens})["loss"])
+        loss = float(engine.train_batch(batch)["loss"])
         dt = time.perf_counter() - t0
         tps = args.batch * args.seq / dt
         print(f"step {step}: loss {loss:.4f}  {dt * 1e3:.0f}ms  "

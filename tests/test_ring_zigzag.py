@@ -1,0 +1,187 @@
+"""Zigzag ring layout tests on the 8-device CPU mesh.
+
+The zigzag layout (ops/attention/ring.py module docstring) balances the
+causal triangle: device d holds chunks (d, 2n-1-d) of 2n, so every
+device does equal attention work at every ring step. These tests assert
+the permuted computation is EXACTLY standard causal attention: run the
+ring on zigzag-permuted inputs, unpermute, compare against the dense
+single-device reference on the original order.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.attention.flash import mha_reference
+from deepspeed_tpu.ops.attention.ring import (
+    ring_attention, zigzag_perm, zigzag_unperm)
+from deepspeed_tpu.parallel.mesh import MeshSpec, make_mesh
+
+
+def _qkv(B=2, S=64, H=2, D=16, seed=0, dtype=jnp.float32, Hkv=None):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (B, S, H, D), dtype)
+    k = jax.random.normal(ks[1], (B, S, Hkv or H, D), dtype)
+    v = jax.random.normal(ks[2], (B, S, Hkv or H, D), dtype)
+    return q, k, v
+
+
+def test_perm_roundtrip():
+    for S, n in [(16, 2), (64, 8), (48, 4), (4, 1)]:
+        p = zigzag_perm(S, n)
+        assert sorted(p.tolist()) == list(range(S))
+        np.testing.assert_array_equal(p[zigzag_unperm(S, n)],
+                                      np.arange(S))
+        # device d's shard is [chunk d, chunk 2n-1-d]
+        C = S // (2 * n)
+        for d in range(n):
+            sh = p[d * 2 * C:(d + 1) * 2 * C]
+            assert sh[0] == d * C and sh[C] == (2 * n - 1 - d) * C
+
+
+@pytest.mark.parametrize("n_seq", [2, 4, 8])
+def test_zigzag_matches_dense(devices, n_seq):
+    q, k, v = _qkv()
+    S = q.shape[1]
+    p, ip = zigzag_perm(S, n_seq), zigzag_unperm(S, n_seq)
+    mesh = make_mesh(MeshSpec(data=8 // n_seq, sequence=n_seq))
+    out = ring_attention(q[:, p], k[:, p], v[:, p], mesh, causal=True,
+                         layout="zigzag")[:, ip]
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_zigzag_grads_match_dense(devices):
+    q, k, v = _qkv(B=1, S=32, H=2, D=8)
+    S, n_seq = q.shape[1], 8
+    p, ip = zigzag_perm(S, n_seq), zigzag_unperm(S, n_seq)
+    mesh = make_mesh(MeshSpec(data=1, sequence=n_seq))
+
+    g_ring = jax.grad(lambda q, k, v: jnp.sum(
+        ring_attention(q[:, p], k[:, p], v[:, p], mesh, causal=True,
+                       layout="zigzag") ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(lambda q, k, v: jnp.sum(
+        mha_reference(q, k, v, causal=True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b, n in zip(g_ring, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5, err_msg=n)
+
+
+def test_zigzag_packed_segments_and_padding(devices):
+    """Packing metadata permutes with the tokens and stays exact, with a
+    segment boundary landing INSIDE a zigzag chunk."""
+    B, S, n_seq = 2, 64, 4
+    q, k, v = _qkv(B=B, S=S)
+    segs = jnp.asarray(
+        np.concatenate([np.zeros((B, 23), np.int32),
+                        np.ones((B, 30), np.int32),
+                        2 * np.ones((B, 11), np.int32)], axis=1))
+    kvm = jnp.asarray((np.arange(S)[None, :] < 57).astype(np.float32)
+                      * np.ones((B, 1), np.float32))
+    p, ip = zigzag_perm(S, n_seq), zigzag_unperm(S, n_seq)
+    mesh = make_mesh(MeshSpec(data=2, sequence=n_seq))
+    out = ring_attention(q[:, p], k[:, p], v[:, p], mesh, causal=True,
+                         segment_ids=segs[:, p], kv_mask=kvm[:, p],
+                         layout="zigzag")[:, ip]
+    ref = mha_reference(q, k, v, causal=True, segment_ids=segs,
+                        kv_mask=kvm)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_zigzag_gqa(devices):
+    q, k, v = _qkv(B=1, S=64, H=4, D=8, Hkv=2)
+    S, n_seq = q.shape[1], 8
+    p, ip = zigzag_perm(S, n_seq), zigzag_unperm(S, n_seq)
+    mesh = make_mesh(MeshSpec(data=1, sequence=n_seq))
+    out = ring_attention(q[:, p], k[:, p], v[:, p], mesh, causal=True,
+                         layout="zigzag")[:, ip]
+    kr = jnp.repeat(k, 2, axis=2)
+    vr = jnp.repeat(v, 2, axis=2)
+    ref = mha_reference(q, kr, vr, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_zigzag_with_data_parallel_axes(devices):
+    q, k, v = _qkv(S=32)
+    n_seq = 4
+    p, ip = zigzag_perm(32, n_seq), zigzag_unperm(32, n_seq)
+    mesh = make_mesh(MeshSpec(data=2, sequence=n_seq))
+    out = ring_attention(q[:, p], k[:, p], v[:, p], mesh, causal=True,
+                         layout="zigzag")[:, ip]
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_zigzag_rejects_window_and_noncausal(devices):
+    q, k, v = _qkv(S=32)
+    mesh = make_mesh(MeshSpec(data=2, sequence=4))
+    with pytest.raises(ValueError):
+        ring_attention(q, k, v, mesh, causal=True, window=8,
+                       layout="zigzag")
+    with pytest.raises(ValueError):
+        ring_attention(q, k, v, mesh, causal=False, layout="zigzag")
+    with pytest.raises(ValueError):
+        ring_attention(q, k, v, mesh, causal=True, layout="spiral")
+
+
+def test_zigzag_gpt_trains(devices):
+    """GPT under zigzag ring SP: first loss matches the dense oracle
+    exactly (fp32), and training decreases it. The batch carries
+    explicitly permuted tokens/targets and positions=perm."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt
+    n_seq, S = 4, 64
+    mesh = make_mesh(MeshSpec(data=2, sequence=n_seq))
+    cfg = gpt.GPTConfig(vocab_size=128, n_layers=2, n_heads=4, d_model=32,
+                        max_seq_len=64, use_flash_attention=False,
+                        remat=False, dtype=jnp.float32,
+                        sequence_parallel=True, sp_layout="zigzag",
+                        mesh=mesh)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+
+    cfg_dense = gpt.GPTConfig(vocab_size=128, n_layers=2, n_heads=4,
+                              d_model=32, max_seq_len=64,
+                              use_flash_attention=False, remat=False,
+                              dtype=jnp.float32)
+    toks = np.random.default_rng(0).integers(0, 128, (8, S + 1))
+    toks = toks.astype(np.int32)
+    ref = float(gpt.loss_fn(params, {"tokens": jnp.asarray(toks)},
+                            jax.random.PRNGKey(0), cfg_dense,
+                            deterministic=True))
+
+    p = zigzag_perm(S, n_seq)
+    batch = {"tokens": toks[:, :S][:, p],
+             "targets": toks[:, 1:][:, p],
+             "positions": np.broadcast_to(p.astype(np.int32), (8, S))}
+    ds = {"train_batch_size": 8,
+          "mesh": {"sequence_parallel_size": n_seq},
+          "optimizer": {"type": "adamw", "params": {"lr": 3e-3}},
+          "steps_per_print": 1000}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt.make_loss_fn(cfg), model_parameters=params, config=ds,
+        mesh=mesh)
+    losses = [float(engine.train_batch(batch)["loss"]) for _ in range(8)]
+    np.testing.assert_allclose(losses[0], ref, rtol=1e-5)
+    assert losses[-1] < losses[0] - 0.3
+
+
+def test_zigzag_requires_positions(devices):
+    from deepspeed_tpu.models import gpt
+    mesh = make_mesh(MeshSpec(data=2, sequence=4))
+    cfg = gpt.GPTConfig(vocab_size=128, n_layers=1, n_heads=2, d_model=16,
+                        max_seq_len=32, use_flash_attention=False,
+                        remat=False, dtype=jnp.float32,
+                        sequence_parallel=True, sp_layout="zigzag",
+                        mesh=mesh)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    toks = jnp.zeros((4, 32), jnp.int32)
+    with pytest.raises(ValueError, match="zigzag"):
+        gpt.forward(params, toks, cfg, jax.random.PRNGKey(0),
+                    deterministic=True)

@@ -23,12 +23,13 @@ HEALTH = (
 )
 
 QUEUE = [
-    # compile/parity-check the new flash kernel features through the REAL
-    # Mosaic lowering before any measurement relies on them
-    ("flash-smoke", [sys.executable, "tools/flash_chip_smoke.py"], 1800),
-    # variants pass the analytic memory guard inside headline_probe —
-    # unsafe configs (the rig-wedging borderline-HBM compiles) are
-    # skipped with a JSON line, never attempted
+    # THE ROUND'S DELIVERABLE FIRST: the headline probe variants use only
+    # the plain flash path already proven through real Mosaic (round-2
+    # headline + this round's 'plain'/'gqa' smoke passes) — the feature-
+    # matrix smoke runs AFTER the measurement is in the bank. Variants
+    # pass the analytic memory guard inside headline_probe — unsafe
+    # configs (the rig-wedging borderline-HBM compiles) are skipped with
+    # a JSON line, never attempted.
     # outer budget covers 14 variants x the probe's 2400s per-config cap;
     # ordering is greedy: baseline re-confirmation, then the single
     # biggest lever (offload_flash), then its combinations, then tiles
@@ -41,12 +42,24 @@ QUEUE = [
                "med-b8-noremat", "med-b16-ce"], 33700),
     ("trace-1.5b", [sys.executable, "tools/trace_analyze.py", "run",
                     "gpt2-1.5b", "16", "full", "2048"], 1500),
+    # compile/parity-check the flash kernel feature matrix through the
+    # REAL Mosaic lowering — WITHOUT the sliding-window cases: the r4
+    # 'window' compile hung the remote compile helper and wedged the rig
+    # for ~20min (chipq_phase1 log); window cases are quarantined in
+    # their own LAST item so a repeat costs nothing but itself
+    ("flash-smoke", [sys.executable, "tools/flash_chip_smoke.py",
+                     "plain", "kv_mask", "segments", "gqa", "bwd-tiles",
+                     "ring-blocks"], 1800),
     # outer budgets cover each tool's own per-config 1500s timeouts
     ("bert-grid", [sys.executable, "tools/bert_bench.py", "8"], 9200),
     ("moe", [sys.executable, "tools/moe_bench.py", "8"], 6200),
     ("longcontext", [sys.executable, "tools/longcontext_bench.py", "chip"],
      4800),
     ("infer", [sys.executable, "tools/infer_bench.py"], 3600),
+    # the quarantined window compiles, dead last
+    ("flash-smoke-window", [sys.executable, "tools/flash_chip_smoke.py",
+                            "window", "window+gqa+segs",
+                            "ring-blocks-window"], 1800),
 ]
 
 

@@ -80,15 +80,26 @@ def mesh():
     sh = NamedSharding(mesh, P(None, "sequence", None, None))
     qs, ks, vs = (jax.device_put(t, sh) for t in (q, k, v))
 
+    from deepspeed_tpu.ops.attention.ring import zigzag_perm, zigzag_unperm
+
     dense = mha_reference(q, k, v, causal=True)
-    for name, fn in (("ring", ring_attention), ("ulysses", ulysses_attention)):
-        f = jax.jit(lambda a, b, c, fn=fn: fn(
-            a, b, c, mesh=mesh, axis="sequence", causal=True))
-        out = jax.block_until_ready(f(qs, ks, vs))
+    zp, zip_ = zigzag_perm(S, 8), zigzag_unperm(S, 8)
+    qz, kz, vz = (jax.device_put(t[:, zp], sh) for t in (q, k, v))
+    for name, fn in (("ring", ring_attention),
+                     ("ring-zigzag", ring_attention),
+                     ("ulysses", ulysses_attention)):
+        zig = name == "ring-zigzag"
+        kw = {"layout": "zigzag"} if zig else {}
+        f = jax.jit(lambda a, b, c, fn=fn, kw=kw: fn(
+            a, b, c, mesh=mesh, axis="sequence", causal=True, **kw))
+        args = (qz, kz, vz) if zig else (qs, ks, vs)
+        out = jax.block_until_ready(f(*args))
+        if zig:
+            out = out[:, zip_]
         err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - dense)))
         t0 = time.perf_counter()
         for _ in range(3):
-            jax.block_until_ready(f(qs, ks, vs))
+            jax.block_until_ready(f(*args))
         dt = (time.perf_counter() - t0) / 3
         print(json.dumps({"impl": name, "seq": S, "sp": 8,
                           "max_err_vs_dense": round(err, 6),
