@@ -548,9 +548,11 @@ def loss_fn(params: Dict, batch: Dict, rng: jax.Array, cfg: GPTConfig,
         # seq-wide "targets" key would silently misalign mask/segments
         raise ValueError(
             f"loss_mask width {mask.shape[-1]} != target width "
-            f"{targets.shape[-1]} — packed batches (loss_mask/segment_ids "
-            f"from pack_documents) must not carry an explicit 'targets' "
-            f"key; let loss_fn derive next-token targets")
+            f"{targets.shape[-1]} — a pack_documents batch must either "
+            f"keep implicit targets (no 'targets' key; loss_fn slices "
+            f"next-token pairs) or be rewritten as a whole by "
+            f"dataloader.zigzag_batch, which derives targets BEFORE "
+            f"permuting so every per-token array stays aligned")
     if cfg.loss_chunk:
         # fused vocab-projection + loss: never materializes [B, S, V]
         # (ops/cross_entropy.py — frees ~3GB+ at GPT-2-1.5B scale)

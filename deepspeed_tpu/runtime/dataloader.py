@@ -164,3 +164,36 @@ def pack_documents(docs, seq_len: int, pad_token: int = 0):
             off += ln
     return {"tokens": tokens, "segment_ids": segs, "positions": poss,
             "loss_mask": mask}
+
+
+def zigzag_batch(batch, n_seq: int):
+    """Re-lay a next-token batch for the zigzag ring layout
+    (ops/attention/ring.py ``zigzag_perm``): derive the (inputs,
+    targets) pair FIRST, then apply the same permutation to inputs,
+    targets, and every piece of per-token metadata — permuting the raw
+    [B, S+1] token row would not commute with next-token slicing.
+
+    batch: {"tokens": [B, S+1]} optionally with "segment_ids"/
+    "positions" [B, S+1] and "loss_mask" [B, S] (pack_documents
+    layout). Returns the explicit-targets dict the GPT loss consumes,
+    with "positions" always present (the model's positional encodings
+    must follow their tokens; for unpacked batches that is the
+    permutation itself).
+    """
+    from deepspeed_tpu.ops.attention.ring import zigzag_perm
+    toks = np.asarray(batch["tokens"])
+    B, S = toks.shape[0], toks.shape[1] - 1
+    p = zigzag_perm(S, n_seq)
+    out = {"tokens": toks[:, :-1][:, p], "targets": toks[:, 1:][:, p]}
+    poss = batch.get("positions")
+    out["positions"] = (np.asarray(poss)[:, :-1][:, p]
+                        if poss is not None
+                        else np.broadcast_to(p.astype(np.int32), (B, S)))
+    segs = batch.get("segment_ids")
+    if segs is not None:
+        out["segment_ids"] = np.asarray(segs)[:, :-1][:, p]
+    mask = batch.get("loss_mask")
+    if mask is not None:
+        assert mask.shape[-1] == S, (mask.shape, S)
+        out["loss_mask"] = np.asarray(mask)[:, p]
+    return out

@@ -96,14 +96,11 @@ def main():
     tokens = r.integers(0, cfg.vocab_size,
                         (args.batch, args.seq + 1)).astype(np.int32)
     if zig:
-        # zigzag layout: tokens/targets/positions are permuted once on
-        # the host; the mean loss is permutation-invariant
-        from deepspeed_tpu.ops.attention.ring import zigzag_perm
-        p = zigzag_perm(args.seq, args.sp)
-        batch = {"tokens": tokens[:, :args.seq][:, p],
-                 "targets": tokens[:, 1:][:, p],
-                 "positions": np.broadcast_to(
-                     p.astype(np.int32), (args.batch, args.seq))}
+        # zigzag layout: derive targets, then permute tokens/targets/
+        # positions once on the host (the mean loss is permutation-
+        # invariant)
+        from deepspeed_tpu.runtime.dataloader import zigzag_batch
+        batch = zigzag_batch({"tokens": tokens}, args.sp)
     else:
         batch = {"tokens": tokens}
     print(f"{args.preset}: {n_params / 1e6:.1f}M params, seq {args.seq} "

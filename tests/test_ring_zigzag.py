@@ -185,3 +185,40 @@ def test_zigzag_requires_positions(devices):
     with pytest.raises(ValueError, match="zigzag"):
         gpt.forward(params, toks, cfg, jax.random.PRNGKey(0),
                     deterministic=True)
+
+
+def test_zigzag_batch_packed_parity(devices):
+    """zigzag_batch(pack_documents(...)) under zigzag ring SP reproduces
+    the dense packed loss exactly: derive-then-permute keeps targets,
+    segment masks, restart positions and the loss mask aligned."""
+    from deepspeed_tpu.models import gpt
+    from deepspeed_tpu.runtime.dataloader import (pack_documents,
+                                                  zigzag_batch)
+    n_seq = 4
+    mesh = make_mesh(MeshSpec(data=2, sequence=n_seq))
+    cfg = gpt.GPTConfig(vocab_size=256, n_layers=2, n_heads=4, d_model=32,
+                        max_seq_len=64, use_flash_attention=False,
+                        remat=False, dtype=jnp.float32,
+                        sequence_parallel=True, sp_layout="zigzag",
+                        mesh=mesh)
+    cfg_dense = gpt.GPTConfig(vocab_size=256, n_layers=2, n_heads=4,
+                              d_model=32, max_seq_len=64,
+                              use_flash_attention=False, remat=False,
+                              dtype=jnp.float32)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    r = np.random.default_rng(3)
+    docs = [r.integers(0, 256, n).astype(np.int32)
+            for n in (30, 21, 14, 40, 25, 9, 33, 17)]
+    packed = pack_documents(docs, seq_len=65, pad_token=0)
+    zig = zigzag_batch(packed, n_seq)
+    assert set(zig) == {"tokens", "targets", "positions", "segment_ids",
+                        "loss_mask"}
+    loss = float(gpt.loss_fn(params, {k: jnp.asarray(v)
+                                      for k, v in zig.items()},
+                             jax.random.PRNGKey(0), cfg,
+                             deterministic=True))
+    ref = float(gpt.loss_fn(params, {k: jnp.asarray(v)
+                                     for k, v in packed.items()},
+                            jax.random.PRNGKey(0), cfg_dense,
+                            deterministic=True))
+    np.testing.assert_allclose(loss, ref, rtol=1e-5)
