@@ -629,7 +629,8 @@ def gpt_pipeline_partition_rules(tp: bool = False) -> list:
 
 
 def make_pipeline_loss_fn(cfg: GPTConfig, mesh, num_stages: int,
-                          num_micro: int, schedule: str = "1f1b"):
+                          num_micro: int, schedule: str = "1f1b",
+                          virtual_chunks: int = 1):
     """Engine-contract loss running the transformer stack as a shard_map
     pipeline over the 'pipe' mesh axis (1 stage = n_layers/pp layers).
     Embedding + LM head run replicated over pipe (tied-weight grads are
@@ -674,10 +675,13 @@ def make_pipeline_loss_fn(cfg: GPTConfig, mesh, num_stages: int,
                                   n_heads=1, d_model=8, max_seq_len=8))
     specs = jax.tree_util.tree_map(spec_of, dummy["block"])
 
+    if schedule == "interleaved":
+        assert cfg.n_layers % (num_stages * virtual_chunks) == 0, \
+            (cfg.n_layers, num_stages, virtual_chunks)
     return make_pipelined_loss_fn(
         embed_fn, stage_fn, head_loss_fn, split_params,
         num_stages, num_micro, mesh, specs, remat_stage=cfg.remat,
-        schedule=schedule)
+        schedule=schedule, virtual_chunks=virtual_chunks)
 
 
 # ---------------------------------------------------------------------------

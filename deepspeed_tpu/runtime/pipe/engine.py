@@ -107,6 +107,26 @@ def pipeline_loss(stage_fn: Callable,
 # memory-bounded 1F1B execution
 # ---------------------------------------------------------------------------
 
+def _zeros_like_f32(tree):
+    return jax.tree_util.tree_map(
+        lambda x: jnp.zeros(x.shape, jnp.float32), tree)
+
+
+def _ring_perms(P_):
+    """(forward, backward) neighbor permutations on the pipe ring."""
+    return ([(i, (i + 1) % P_) for i in range(P_)],
+            [(i, (i - 1) % P_) for i in range(P_)])
+
+
+def _head_closure(head_loss_fn, target_micro, M):
+    """head_for(m): loss closure of the head for microbatch slot m
+    (clipped — invalid slots are masked by the caller)."""
+    def head_for(m):
+        tgt = jax.tree_util.tree_map(
+            lambda z: z[jnp.clip(m, 0, M - 1)], target_micro)
+        return lambda op, y: head_loss_fn(op, y, tgt)
+    return head_for
+
 def _one_f_one_b_program(stage_fn: Callable,
                          head_loss_fn: Callable,
                          num_stages: int,
@@ -138,18 +158,10 @@ def _one_f_one_b_program(stage_fn: Callable,
     num_ticks = M + 2 * P_ - 2
     K = max(2 * P_ - 1, 1)              # input ring-buffer slots
 
-    fwd_perm = [(i, (i + 1) % P_) for i in range(P_)]
-    bwd_perm = [(i, (i - 1) % P_) for i in range(P_)]
-
+    fwd_perm, bwd_perm = _ring_perms(P_)
     f32 = jnp.float32
-    zeros_like_tree = lambda t: jax.tree_util.tree_map(
-        lambda x: jnp.zeros(x.shape, f32), t)
-
-    def head_for(t):
-        """loss + vjp closure of the head for forward-microbatch slot t."""
-        tgt = jax.tree_util.tree_map(
-            lambda z: z[jnp.clip(t, 0, M - 1)], target_micro)
-        return lambda op, y: head_loss_fn(op, y, tgt)
+    zeros_like_tree = _zeros_like_f32
+    head_for = _head_closure(head_loss_fn, target_micro, M)
 
     def tick(carry, t):
         (fwd_in, bwd_in, buf, dstage, dother, dx_acc, loss_acc) = carry
@@ -274,6 +286,7 @@ def make_pipelined_loss_fn(embed_fn: Callable,
                            *,
                            remat_stage: bool = True,
                            schedule: str = "1f1b",
+                           virtual_chunks: int = 1,
                            axis: str = "pipe") -> Callable:
     """Build an engine-compatible loss fn (params, batch, rng) -> loss.
 
@@ -293,9 +306,20 @@ def make_pipelined_loss_fn(embed_fn: Callable,
     running the GPipe forward — the 1F1B custom_vjp computes gradients
     eagerly inside its forward, which eval must not pay for; the engine
     picks ``eval_fn`` up automatically.
+
+    schedule='interleaved' runs chunk-granular 1F1B over
+    ``virtual_chunks`` virtual stages per device (megatron-style
+    interleaving — beyond the reference's schedule set), cutting the
+    pipeline bubble by up to ~virtual_chunks at small M/P. The caller
+    must feed stage params in virtual-stage stacking order
+    (interleave_layer_perm); num_micro must be a multiple of the stage
+    count.
     """
-    if schedule not in ("gpipe", "1f1b"):
+    if schedule not in ("gpipe", "1f1b", "interleaved"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    if schedule == "interleaved" and virtual_chunks < 2:
+        raise ValueError("schedule='interleaved' needs virtual_chunks >= 2"
+                         " (with 1 chunk it IS plain 1f1b)")
     gpipe_stage_fn = stage_fn
     if remat_stage:
         # 1f1b checkpoints at stage granularity by construction; the
@@ -306,6 +330,10 @@ def make_pipelined_loss_fn(embed_fn: Callable,
     if schedule == "1f1b":
         loss_1f1b = make_1f1b_loss_fn(stage_fn, head_loss_fn, num_stages,
                                       mesh, stage_params_specs, axis=axis)
+    elif schedule == "interleaved":
+        loss_1f1b = make_interleaved_loss_fn(
+            stage_fn, head_loss_fn, num_stages, virtual_chunks,
+            num_micro, mesh, stage_params_specs, axis=axis)
 
     def _micro_split(params, batch):
         stage_params, other_params = split_params(params)
@@ -337,17 +365,286 @@ def make_pipelined_loss_fn(embed_fn: Callable,
 
     def loss_fn(params, batch, rng):
         del rng
-        if schedule == "1f1b":
+        if schedule in ("1f1b", "interleaved"):
             stage_params, other_params, x_micro, target_micro = \
                 _micro_split(params, batch)
+            if schedule == "interleaved":
+                # virtual-stage stacking order, applied INSIDE the traced
+                # loss: a differentiable gather, so grads scatter back to
+                # the natural layout and optimizer state/checkpoints/the
+                # gpipe eval companion never see the permuted order
+                leaves = jax.tree_util.tree_leaves(stage_params)
+                L = leaves[0].shape[0]
+                if L % (num_stages * virtual_chunks):
+                    # a non-dividing L would silently TRUNCATE the model
+                    # (the gather below keeps only the permuted rows)
+                    raise ValueError(
+                        f"interleaved schedule needs stacked layers "
+                        f"({L}) divisible by stages*chunks "
+                        f"({num_stages}*{virtual_chunks})")
+                perm = jnp.asarray(interleave_layer_perm(
+                    L, num_stages, virtual_chunks))
+                stage_params = jax.tree_util.tree_map(
+                    lambda p: p[perm], stage_params)
             return loss_1f1b(stage_params, other_params, x_micro,
                              target_micro)
         return _gpipe(params, batch)
 
-    if schedule == "1f1b":
+    if schedule in ("1f1b", "interleaved"):
         def eval_fn(params, batch, rng):
             del rng
             return _gpipe(params, batch)
         loss_fn.eval_fn = eval_fn
 
     return loss_fn
+
+
+# ---------------------------------------------------------------------------
+# interleaved 1F1B (virtual pipeline stages)
+# ---------------------------------------------------------------------------
+
+def interleave_layer_perm(L: int, P_: int, v: int):
+    """Row permutation putting a [L]-stacked layer pytree into virtual-
+    stage order: device d's contiguous 'pipe' slab then holds its v
+    chunks (virtual stages c*P+d) back to back. Applied INSIDE the
+    traced loss (a differentiable gather — autodiff scatters grads back
+    to the natural order), so optimizer state and checkpoints keep the
+    natural layer layout."""
+    import numpy as np
+    Lv = L // (v * P_)
+    rows = []
+    for d in range(P_):
+        for c in range(v):
+            base = (c * P_ + d) * Lv
+            rows.extend(range(base, base + Lv))
+    return np.asarray(rows)
+
+
+def _buffer_depths(tab, P_: int, v: int, M: int):
+    """Max in-flight (received-not-yet-consumed) microbatches per (device,
+    chunk), for the activation and cotangent ring buffers. Consumption
+    order per chunk is increasing microbatch id, so slot = m %% K is
+    collision-free for K = max window."""
+    T = tab["fwd_c"].shape[1]
+    V = v * P_
+    k_act = 1
+    k_cot = 1
+    for d in range(P_):
+        for c in range(v):
+            vs = c * P_ + d
+            # activation for F(c, m) arrives at the producer's F tick
+            # (prev virtual stage) or is read straight from x_micro
+            # (vs == 0); consumed by B(c, m)
+            if vs > 0:
+                pd, pc = (d - 1, c) if d > 0 else (P_ - 1, c - 1)
+                recv = {tab["fwd_m"][pd, t]: t for t in range(T)
+                        if tab["fwd_valid"][pd, t]
+                        and tab["fwd_c"][pd, t] == pc}
+            else:
+                recv = {tab["fwd_m"][d, t]: t for t in range(T)
+                        if tab["fwd_valid"][d, t]
+                        and tab["fwd_c"][d, t] == c}
+            cons = {tab["bwd_m"][d, t]: t for t in range(T)
+                    if tab["bwd_valid"][d, t] and tab["bwd_c"][d, t] == c}
+            for t in range(T):
+                live = [m for m in recv
+                        if recv[m] <= t and cons.get(m, T + 1) > t]
+                if live:
+                    k_act = max(k_act, max(live) - min(live) + 1)
+            # cotangent for B(c, m): produced by the next virtual
+            # stage's B (or the local head F when vs == V-1)
+            if vs == V - 1:
+                crecv = {tab["fwd_m"][d, t]: t for t in range(T)
+                         if tab["fwd_valid"][d, t]
+                         and tab["fwd_c"][d, t] == c}
+            else:
+                nd, nc = (d + 1, c) if d < P_ - 1 else (0, c + 1)
+                crecv = {tab["bwd_m"][nd, t]: t for t in range(T)
+                         if tab["bwd_valid"][nd, t]
+                         and tab["bwd_c"][nd, t] == nc}
+            for t in range(T):
+                live = [m for m in crecv
+                        if crecv[m] <= t and cons.get(m, T + 1) > t]
+                if live:
+                    k_cot = max(k_cot, max(live) - min(live) + 1)
+    return k_act, k_cot
+
+
+def _interleaved_program(stage_fn, head_loss_fn, num_stages, v, tables,
+                         k_act, k_cot, axis,
+                         stage_params, other_params, x_micro,
+                         target_micro):
+    """Interleaved 1F1B as ONE scan over the precomputed lockstep tick
+    tables (runtime/pipe/schedule.py interleaved_1f1b_tables): each tick
+    every device runs at most one chunk-forward and one chunk-backward,
+    at (chunk, microbatch) coordinates read from the table — the
+    schedule is data, not control flow. Activations/cotangents hop
+    devices via ppermute; each device's stacked slab is [v, Lv, ...]
+    with the chunk picked by dynamic index. Cuts the pipeline bubble by
+    up to ~v at small M/P (see schedule.py; megatron-style virtual
+    stages — beyond the reference's schedule set, ref deepspeed/runtime/
+    pipe/schedule.py:182)."""
+    M = x_micro.shape[0]
+    P_ = num_stages
+    V = v * P_
+    d = jax.lax.axis_index(axis)
+    T = tables["fwd_c"].shape[1]
+    tab = {k: jnp.asarray(val) for k, val in tables.items()}
+
+    fwd_perm, bwd_perm = _ring_perms(P_)
+    f32 = jnp.float32
+    zeros_like_tree = _zeros_like_f32
+    head_for = _head_closure(head_loss_fn, target_micro, M)
+
+    # local slab [v*Lv, ...] -> [v, Lv, ...]
+    slab = jax.tree_util.tree_map(
+        lambda p: p.reshape((v, p.shape[0] // v) + p.shape[1:]),
+        stage_params)
+
+    def chunk_params(c):
+        return jax.tree_util.tree_map(lambda p: p[c], slab)
+
+    x0 = jnp.zeros_like(x_micro[0])
+
+    def tick(carry, t):
+        (act_buf, cot_buf, dstage, dother, dx_acc, loss_acc) = carry
+
+        # ---- forward: one chunk-F at the table's coordinates ----
+        fc = tab["fwd_c"][d, t]
+        fm = tab["fwd_m"][d, t]
+        fv = tab["fwd_valid"][d, t] == 1
+        vs_f = fc * P_ + d
+        inp = jnp.where(vs_f == 0, x_micro[jnp.clip(fm, 0, M - 1)],
+                        act_buf[fc, jnp.clip(fm, 0, M - 1) % k_act])
+        out = stage_fn(chunk_params(fc), inp)
+
+        # last virtual stage: head loss + cotangent, delivered locally
+        loss_m, head_vjp = jax.vjp(head_for(fm), other_params, out)
+        dother_m, dy_head = head_vjp(jnp.ones((), loss_m.dtype))
+        m_head = ((vs_f == V - 1) & fv).astype(f32)
+        loss_acc = loss_acc + loss_m.astype(f32) * m_head
+        dother = jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(f32) * m_head, dother, dother_m)
+        cot_buf = jnp.where(
+            m_head > 0,
+            cot_buf.at[fc, jnp.clip(fm, 0, M - 1) % k_cot].set(
+                dy_head.astype(cot_buf.dtype)),
+            cot_buf)
+
+        # ship the activation to device d+1; store what arrives from d-1
+        recv_act = jax.lax.ppermute(out, axis, fwd_perm)
+        pd = (d - 1) % P_
+        sfc = tab["fwd_c"][pd, t]
+        sfm = tab["fwd_m"][pd, t]
+        svs = sfc * P_ + pd
+        rc = jnp.where(d == 0, sfc + 1, sfc)      # my chunk for that msg
+        r_ok = ((tab["fwd_valid"][pd, t] == 1) & (svs < V - 1)
+                & (rc < v))
+        act_buf = jnp.where(
+            r_ok,
+            act_buf.at[jnp.clip(rc, 0, v - 1),
+                       jnp.clip(sfm, 0, M - 1) % k_act].set(recv_act),
+            act_buf)
+
+        # ---- backward: one chunk-B at the table's coordinates ----
+        bc = tab["bwd_c"][d, t]
+        bm = tab["bwd_m"][d, t]
+        bv = tab["bwd_valid"][d, t] == 1
+        vs_b = bc * P_ + d
+        x_saved = jnp.where(vs_b == 0, x_micro[jnp.clip(bm, 0, M - 1)],
+                            act_buf[bc, jnp.clip(bm, 0, M - 1) % k_act])
+        cot_in = cot_buf[bc, jnp.clip(bm, 0, M - 1) % k_cot]
+        _, svjp = jax.vjp(stage_fn, chunk_params(bc), x_saved)
+        dchunk, dx_m = svjp(cot_in.astype(x_saved.dtype))
+        m_b = bv.astype(f32)
+        dstage = jax.tree_util.tree_map(
+            lambda acc, g: acc.at[bc].add(g.astype(f32) * m_b),
+            dstage, dchunk)
+        # embedding grads (virtual stage 0) accumulate per microbatch
+        m_b0 = ((vs_b == 0) & bv).astype(dx_m.dtype)
+        dx_acc = dx_acc.at[jnp.clip(bm, 0, M - 1)].add(dx_m * m_b0)
+
+        # ship the cotangent to device d-1; store what arrives from d+1
+        recv_cot = jax.lax.ppermute(dx_m, axis, bwd_perm)
+        nd = (d + 1) % P_
+        nbc = tab["bwd_c"][nd, t]
+        nbm = tab["bwd_m"][nd, t]
+        nvs = nbc * P_ + nd
+        rcb = jnp.where(d == P_ - 1, nbc - 1, nbc)
+        rb_ok = ((tab["bwd_valid"][nd, t] == 1) & (nvs > 0) & (rcb >= 0))
+        cot_buf = jnp.where(
+            rb_ok,
+            cot_buf.at[jnp.clip(rcb, 0, v - 1),
+                       jnp.clip(nbm, 0, M - 1) % k_cot].set(
+                recv_cot.astype(cot_buf.dtype)),
+            cot_buf)
+
+        return (act_buf, cot_buf, dstage, dother, dx_acc, loss_acc), None
+
+    carry0 = (jnp.zeros((v, k_act) + x0.shape, x0.dtype),
+              jnp.zeros((v, k_cot) + x0.shape, f32),
+              zeros_like_tree(slab),
+              zeros_like_tree(other_params),
+              jnp.zeros_like(x_micro),
+              jnp.zeros((), f32))
+    (_, _, dstage, dother, dx_micro, loss_sum), _ = jax.lax.scan(
+        tick, carry0, jnp.arange(T))
+
+    inv_m = 1.0 / M
+    loss = jax.lax.psum(loss_sum * inv_m, axis)
+    dother = jax.tree_util.tree_map(
+        lambda g: jax.lax.psum(g * inv_m, axis), dother)
+    dx_micro = jax.lax.psum(dx_micro * inv_m, axis)
+    # [v, Lv, ...] grads -> the [v*Lv, ...] slab layout of the input
+    dstage = jax.tree_util.tree_map(
+        lambda g: g.reshape((g.shape[0] * g.shape[1],) + g.shape[2:]) *
+        inv_m, dstage)
+    return loss, dstage, dother, dx_micro
+
+
+def make_interleaved_loss_fn(stage_fn, head_loss_fn, num_stages, v,
+                             num_micro, mesh, stage_params_specs, *,
+                             axis: str = "pipe"):
+    """(stage_params_virtual, other_params, x_micro, target_micro) ->
+    scalar loss under the interleaved 1F1B schedule; differentiable via
+    the same stashed-grads custom_vjp shape as make_1f1b_loss_fn.
+    stage_params_virtual must be stacked in VIRTUAL-STAGE order
+    (interleave_layer_perm) so the 'pipe' sharding gives each device its
+    v chunks."""
+    from deepspeed_tpu.runtime.pipe.schedule import interleaved_1f1b_tables
+    tables = interleaved_1f1b_tables(num_stages, v, num_micro)
+    k_act, k_cot = _buffer_depths(tables, num_stages, v, num_micro)
+
+    def run(stage_params, other_params, x_micro, target_micro):
+        prog = partial(_interleaved_program, stage_fn, head_loss_fn,
+                       num_stages, v, tables, k_act, k_cot, axis)
+        return jax.shard_map(
+            prog, mesh=mesh,
+            in_specs=(stage_params_specs, P(), P(), P()),
+            out_specs=(P(), stage_params_specs, P(), P()),
+            axis_names={axis}, check_vma=False)(
+                stage_params, other_params, x_micro, target_micro)
+
+    @jax.custom_vjp
+    def loss_int(stage_params, other_params, x_micro, target_micro):
+        loss, _, _, _ = run(stage_params, other_params, x_micro,
+                            target_micro)
+        return loss
+
+    def fwd(stage_params, other_params, x_micro, target_micro):
+        loss, dstage, dother, dx = run(stage_params, other_params,
+                                       x_micro, target_micro)
+        return loss, (dstage, dother, dx, target_micro)
+
+    def bwd(res, g):
+        dstage, dother, dx, target_micro = res
+        scale = lambda t: jax.tree_util.tree_map(lambda v_: v_ * g, t)
+        dtarget = jax.tree_util.tree_map(
+            lambda z: (jnp.zeros(z.shape, jax.dtypes.float0)
+                       if not jnp.issubdtype(z.dtype, jnp.floating)
+                       else jnp.zeros_like(z)),
+            target_micro)
+        return scale(dstage), scale(dother), dx * g, dtarget
+
+    loss_int.defvjp(fwd, bwd)
+    return loss_int

@@ -228,3 +228,130 @@ class DataParallelSchedule(PipeSchedule):
             if micro_batch_id == self.micro_batches - 1:
                 cmds.extend([ReduceGrads(), OptimizerStep()])
             yield cmds
+
+
+# ---------------------------------------------------------------------------
+# interleaved 1F1B (virtual pipeline stages)
+# ---------------------------------------------------------------------------
+
+def _interleaved_rank_order(P: int, v: int, M: int, d: int):
+    """Device d's op sequence for the interleaved 1F1B schedule
+    (Megatron-style: warmup forwards, then chunk-granular 1F1B pairs,
+    then cooldown backwards). Each op is ('F'|'B', chunk, microbatch).
+
+    Virtual stage c*P + d holds the d-th slice of layer-chunk c; the
+    k-th forward on any device cycles chunks every P microbatch slots:
+    chunk = (k %% (P*v)) // P, mb = (k // (P*v))*P + k %% P. Backwards
+    walk the same slots with chunks reversed. M must be a multiple of P
+    (the cycling assumes full groups).
+    """
+    assert M % P == 0, f"interleaved schedule needs M % P == 0, got {M}/{P}"
+    total = M * v
+
+    def fwd_slot(k):
+        return ((k % (P * v)) // P,
+                (k // (P * v)) * P + (k % P))
+
+    def bwd_slot(k):
+        c, m = fwd_slot(k)
+        return v - 1 - c, m
+
+    warmup = min((P - d - 1) * 2 + (v - 1) * P, total)
+    ops = [("F",) + fwd_slot(k) for k in range(warmup)]
+    for j in range(total - warmup):
+        ops.append(("F",) + fwd_slot(warmup + j))
+        ops.append(("B",) + bwd_slot(j))
+    for j in range(total - warmup, total):
+        ops.append(("B",) + bwd_slot(j))
+    return ops
+
+
+def interleaved_1f1b_tables(P: int, v: int, M: int):
+    """Lockstep tick tables for interleaved 1F1B over P devices with v
+    layer chunks per device (virtual stages V = v*P, chunk c of device d
+    is virtual stage c*P + d).
+
+    The per-device op order (_interleaved_rank_order) is scheduled
+    greedily into synchronous ticks: a tick holds at most one F and one
+    B per device, in the device's own order, and an op waits until its
+    producer ran at an EARLIER tick (cross-device messages arrive the
+    tick after they are sent; the last virtual stage's F->B handoff is
+    local and may share a tick). This compiles the reference's
+    interpreted instruction stream (ref: deepspeed/runtime/pipe/
+    schedule.py:182, megatron interleaving) into static arrays an SPMD
+    lax.scan can index — no host control flow at run time.
+
+    Returns a dict of int32 numpy arrays of shape [P, T]:
+      fwd_c/fwd_m/fwd_valid — chunk, microbatch, validity of the tick's F
+      bwd_c/bwd_m/bwd_valid — same for the tick's B
+    """
+    import numpy as np
+
+    V = v * P
+    orders = [_interleaved_rank_order(P, v, M, d) for d in range(P)]
+    ptr = [0] * P
+    done_f = {}                      # (c, m, d) -> tick
+    done_b = {}
+    rows = []                        # per tick: [P] of (fop|None, bop|None)
+
+    def vstage(c, d):
+        return c * P + d
+
+    def f_ready(c, m, d, t):
+        vs = vstage(c, d)
+        if vs == 0:
+            return True
+        pc, pd = (c, d - 1) if d > 0 else (c - 1, P - 1)
+        return done_f.get((pc, m, pd), t) < t
+
+    def b_ready(c, m, d, t):
+        vs = vstage(c, d)
+        if vs == V - 1:              # local head handoff: same tick ok
+            return done_f.get((c, m, d), t + 1) <= t
+        nc, nd = (c, d + 1) if d < P - 1 else (c + 1, 0)
+        return (done_b.get((nc, m, nd), t) < t
+                and done_f.get((c, m, d), t + 1) <= t)
+
+    t = 0
+    while any(ptr[d] < len(orders[d]) for d in range(P)):
+        row = [[None, None] for _ in range(P)]
+        for d in range(P):
+            used_f = used_b = False
+            # up to one F and one B, in this device's own order
+            for _ in range(2):
+                if ptr[d] >= len(orders[d]):
+                    break
+                kind, c, m = orders[d][ptr[d]]
+                if kind == "F" and not used_f and f_ready(c, m, d, t):
+                    done_f[(c, m, d)] = t
+                    row[d][0] = (c, m)
+                    used_f = True
+                    ptr[d] += 1
+                elif kind == "B" and not used_b and b_ready(c, m, d, t):
+                    done_b[(c, m, d)] = t
+                    row[d][1] = (c, m)
+                    used_b = True
+                    ptr[d] += 1
+                else:
+                    break            # in-order: blocked op stalls the rest
+        rows.append(row)
+        t += 1
+        assert t <= 4 * (M * v + 2 * V), "interleaved schedule deadlock"
+
+    T = len(rows)
+    out = {k: np.zeros((P, T), np.int32)
+           for k in ("fwd_c", "fwd_m", "fwd_valid",
+                     "bwd_c", "bwd_m", "bwd_valid")}
+    for tt, row in enumerate(rows):
+        for d in range(P):
+            if row[d][0] is not None:
+                c, m = row[d][0]
+                out["fwd_c"][d, tt] = c
+                out["fwd_m"][d, tt] = m
+                out["fwd_valid"][d, tt] = 1
+            if row[d][1] is not None:
+                c, m = row[d][1]
+                out["bwd_c"][d, tt] = c
+                out["bwd_m"][d, tt] = m
+                out["bwd_valid"][d, tt] = 1
+    return out
