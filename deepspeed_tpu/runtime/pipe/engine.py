@@ -227,6 +227,36 @@ def _one_f_one_b_program(stage_fn: Callable,
     return loss, dstage, dother, dx_micro
 
 
+def _make_stashed_grad_loss(run):
+    """custom_vjp wrapper shared by the 1F1B and interleaved makers:
+    the forward runs the manual fwd+bwd program and stashes the grads
+    as residuals; bwd scales them by the incoming cotangent."""
+
+    @jax.custom_vjp
+    def loss_fn(stage_params, other_params, x_micro, target_micro):
+        loss, _, _, _ = run(stage_params, other_params, x_micro,
+                            target_micro)
+        return loss
+
+    def fwd(stage_params, other_params, x_micro, target_micro):
+        loss, dstage, dother, dx = run(stage_params, other_params,
+                                       x_micro, target_micro)
+        return loss, (dstage, dother, dx, target_micro)
+
+    def bwd(res, g):
+        dstage, dother, dx, target_micro = res
+        scale = lambda t: jax.tree_util.tree_map(lambda v_: v_ * g, t)
+        dtarget = jax.tree_util.tree_map(
+            lambda z: (jnp.zeros(z.shape, jax.dtypes.float0)
+                       if not jnp.issubdtype(z.dtype, jnp.floating)
+                       else jnp.zeros_like(z)),
+            target_micro)
+        return scale(dstage), scale(dother), dx * g, dtarget
+
+    loss_fn.defvjp(fwd, bwd)
+    return loss_fn
+
+
 def make_1f1b_loss_fn(stage_fn: Callable,
                       head_loss_fn: Callable,
                       num_stages: int,
@@ -250,29 +280,7 @@ def make_1f1b_loss_fn(stage_fn: Callable,
             axis_names={axis}, check_vma=False)(
                 stage_params, other_params, x_micro, target_micro)
 
-    @jax.custom_vjp
-    def loss_1f1b(stage_params, other_params, x_micro, target_micro):
-        loss, _, _, _ = run(stage_params, other_params, x_micro,
-                            target_micro)
-        return loss
-
-    def fwd(stage_params, other_params, x_micro, target_micro):
-        loss, dstage, dother, dx = run(stage_params, other_params, x_micro,
-                                       target_micro)
-        return loss, (dstage, dother, dx, target_micro)
-
-    def bwd(res, g):
-        dstage, dother, dx, target_micro = res
-        scale = lambda t: jax.tree_util.tree_map(lambda v: v * g, t)
-        dtarget = jax.tree_util.tree_map(
-            lambda z: (jnp.zeros(z.shape, jax.dtypes.float0)
-                       if not jnp.issubdtype(z.dtype, jnp.floating)
-                       else jnp.zeros_like(z)),
-            target_micro)
-        return scale(dstage), scale(dother), dx * g, dtarget
-
-    loss_1f1b.defvjp(fwd, bwd)
-    return loss_1f1b
+    return _make_stashed_grad_loss(run)
 
 
 def make_pipelined_loss_fn(embed_fn: Callable,
@@ -625,26 +633,4 @@ def make_interleaved_loss_fn(stage_fn, head_loss_fn, num_stages, v,
             axis_names={axis}, check_vma=False)(
                 stage_params, other_params, x_micro, target_micro)
 
-    @jax.custom_vjp
-    def loss_int(stage_params, other_params, x_micro, target_micro):
-        loss, _, _, _ = run(stage_params, other_params, x_micro,
-                            target_micro)
-        return loss
-
-    def fwd(stage_params, other_params, x_micro, target_micro):
-        loss, dstage, dother, dx = run(stage_params, other_params,
-                                       x_micro, target_micro)
-        return loss, (dstage, dother, dx, target_micro)
-
-    def bwd(res, g):
-        dstage, dother, dx, target_micro = res
-        scale = lambda t: jax.tree_util.tree_map(lambda v_: v_ * g, t)
-        dtarget = jax.tree_util.tree_map(
-            lambda z: (jnp.zeros(z.shape, jax.dtypes.float0)
-                       if not jnp.issubdtype(z.dtype, jnp.floating)
-                       else jnp.zeros_like(z)),
-            target_micro)
-        return scale(dstage), scale(dother), dx * g, dtarget
-
-    loss_int.defvjp(fwd, bwd)
-    return loss_int
+    return _make_stashed_grad_loss(run)

@@ -245,7 +245,11 @@ def _interleaved_rank_order(P: int, v: int, M: int, d: int):
     walk the same slots with chunks reversed. M must be a multiple of P
     (the cycling assumes full groups).
     """
-    assert M % P == 0, f"interleaved schedule needs M % P == 0, got {M}/{P}"
+    if M % P:
+        raise ValueError(
+            f"interleaved schedule needs num_micro divisible by the "
+            f"stage count (chunk cycling assumes full groups), got "
+            f"{M} microbatches over {P} stages")
     total = M * v
 
     def fwd_slot(k):
@@ -336,7 +340,10 @@ def interleaved_1f1b_tables(P: int, v: int, M: int):
                     break            # in-order: blocked op stalls the rest
         rows.append(row)
         t += 1
-        assert t <= 4 * (M * v + 2 * V), "interleaved schedule deadlock"
+        if t > 4 * (M * v + 2 * V):
+            raise RuntimeError(
+                "interleaved schedule deadlock — dependency rules and "
+                "rank op order disagree (scheduler bug)")
 
     T = len(rows)
     out = {k: np.zeros((P, T), np.int32)

@@ -100,8 +100,8 @@ def test_rank_order_warmup_structure():
         assert kinds[:warmup] == ["F"] * warmup
         steady = kinds[warmup:warmup + 2 * (M * v - warmup)]
         assert steady == ["F", "B"] * (M * v - warmup)
-    with pytest.raises(AssertionError):
-        _interleaved_rank_order(4, 2, 6, 0)   # M % P != 0
+    with pytest.raises(ValueError, match="divisible"):
+        interleaved_1f1b_tables(4, 2, 6)      # M % P != 0
 
 
 # ---------------------------------------------------------------------------
@@ -127,6 +127,25 @@ def test_interleaved_loss_matches_dense(devices):
                                         num_micro=4,
                                         schedule="interleaved",
                                         virtual_chunks=2)
+    with jax.set_mesh(mesh):
+        got = float(jax.jit(loss_fn)(params, batch, jax.random.PRNGKey(0)))
+    np.testing.assert_allclose(ref, got, rtol=1e-5)
+
+
+def test_interleaved_buffer_wraparound_parity(devices):
+    """num_micro > buffer depth: the act/cot ring-buffer modulo actually
+    wraps (k_act < M) — the trickiest slot arithmetic in the executor."""
+    cfg = _tiny_cfg(n_layers=8)          # 2 stages x 4 chunks x 1 layer
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = np.random.default_rng(4).integers(0, 128, (16, 17))
+    batch = {"tokens": jnp.asarray(tokens.astype(np.int32))}
+    ref = float(gpt.loss_fn(params, dict(batch), jax.random.PRNGKey(0),
+                            cfg, deterministic=True))
+    mesh = make_mesh(MeshSpec(pipe=2, data=-1))
+    loss_fn = gpt.make_pipeline_loss_fn(cfg, mesh, num_stages=2,
+                                        num_micro=8,
+                                        schedule="interleaved",
+                                        virtual_chunks=4)
     with jax.set_mesh(mesh):
         got = float(jax.jit(loss_fn)(params, batch, jax.random.PRNGKey(0)))
     np.testing.assert_allclose(ref, got, rtol=1e-5)
