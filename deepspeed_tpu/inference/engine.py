@@ -30,7 +30,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.models import gpt as gpt_lib
-from deepspeed_tpu.models.gpt import GPTConfig, _layernorm
+from deepspeed_tpu.models.gpt import (GPTConfig, _dense,
+                                      _norm)
 from deepspeed_tpu.parallel import mesh as mesh_lib
 from deepspeed_tpu.parallel import sharding as sharding_lib
 from deepspeed_tpu.utils.logging import log_dist, logger
@@ -51,11 +52,13 @@ def _split_heads(t, B, S, H, Dh):
     return t.reshape(B, S, H, Dh)
 
 
-def _mlp(h, p):
-    h = h @ p["mlp_in"]["kernel"].astype(h.dtype) + p["mlp_in"]["bias"].astype(h.dtype)
-    h = jax.nn.gelu(h, approximate=True)
-    return h @ p["mlp_out"]["kernel"].astype(h.dtype) + \
-        p["mlp_out"]["bias"].astype(h.dtype)
+def _mlp(h, p, cfg):
+    m = _dense(h, p["mlp_in"])
+    if cfg.activation == "swiglu":
+        m = jax.nn.silu(_dense(h, p["mlp_gate"])) * m
+    else:
+        m = jax.nn.gelu(m, approximate=True)
+    return _dense(m, p["mlp_out"])
 
 
 def _block_prefill(x, p, cfg: GPTConfig, kv_mask=None, positions=None):
@@ -67,8 +70,8 @@ def _block_prefill(x, p, cfg: GPTConfig, kv_mask=None, positions=None):
     B, S, D = x.shape
     H, Dh = cfg.n_heads, cfg.head_dim
     Hkv = cfg.kv_heads
-    h = _layernorm(x, p["ln1"]["scale"], p["ln1"]["bias"])
-    qkv = h @ p["qkv"]["kernel"].astype(h.dtype) + p["qkv"]["bias"].astype(h.dtype)
+    h = _norm(x, p["ln1"], cfg)
+    qkv = _dense(h, p["qkv"])
     q, k, v = jnp.split(qkv, [H * Dh, (H + Hkv) * Dh], axis=-1)
     q = _split_heads(q, B, S, H, Dh)
     k = _split_heads(k, B, S, Hkv, Dh)
@@ -79,12 +82,11 @@ def _block_prefill(x, p, cfg: GPTConfig, kv_mask=None, positions=None):
             q, k, positions if positions is not None else jnp.arange(S),
             cfg.rotary_dim)
     attn = gpt_lib._attention(q, k, v, cfg, kv_mask=kv_mask).reshape(B, S, D)
-    attn = attn @ p["attn_out"]["kernel"].astype(attn.dtype) + \
-        p["attn_out"]["bias"].astype(attn.dtype)
+    attn = _dense(attn, p["attn_out"])
     if cfg.parallel_residual:
         return x + attn + _ffn(h, p, cfg), k, v
     x = x + attn
-    h = _layernorm(x, p["ln2"]["scale"], p["ln2"]["bias"])
+    h = _norm(x, p["ln2"], cfg)
     return x + _ffn(h, p, cfg), k, v
 
 
@@ -93,7 +95,7 @@ def _ffn(h, p, cfg):
     ops/transformer/inference/moe_inference.py). MoE runs the same GShard
     top-k dispatch as training, in eval mode (no jitter, aux discarded)."""
     if "moe" not in p:
-        return _mlp(h, p)
+        return _mlp(h, p, cfg)
     from deepspeed_tpu.moe.experts import ffn_expert_fn
     from deepspeed_tpu.moe.sharded_moe import TopKGate, moe_layer_apply
     gate = TopKGate(k=getattr(cfg, "moe_k", 1),
@@ -121,8 +123,8 @@ def _block_decode(x, k_cache, v_cache, pos, p, cfg: GPTConfig,
 
     Hkv = cfg.kv_heads
     group = H // Hkv
-    h = _layernorm(x, p["ln1"]["scale"], p["ln1"]["bias"])
-    qkv = h @ p["qkv"]["kernel"].astype(h.dtype) + p["qkv"]["bias"].astype(h.dtype)
+    h = _norm(x, p["ln1"], cfg)
+    qkv = _dense(h, p["qkv"])
     q, k, v = jnp.split(qkv, [H * Dh, (H + Hkv) * Dh], axis=-1)
     if cfg.rotary_dim:
         from deepspeed_tpu.ops.attention.rotary import apply_rotary
@@ -152,12 +154,11 @@ def _block_decode(x, k_cache, v_cache, pos, p, cfg: GPTConfig,
         scores = jnp.where(cache_mask[:, None, None, :] > 0, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
     attn = jnp.einsum("bkgs,bskd->bkgd", probs, v_cache).reshape(B, 1, D)
-    attn = attn @ p["attn_out"]["kernel"].astype(attn.dtype) + \
-        p["attn_out"]["bias"].astype(attn.dtype)
+    attn = _dense(attn, p["attn_out"])
     if cfg.parallel_residual:
         return x + attn + _ffn(h, p, cfg), k_cache, v_cache
     x = x + attn
-    h = _layernorm(x, p["ln2"]["scale"], p["ln2"]["bias"])
+    h = _norm(x, p["ln2"], cfg)
     return x + _ffn(h, p, cfg), k_cache, v_cache
 
 
@@ -247,7 +248,7 @@ class InferenceEngine:
         return x
 
     def _logits(self, params, x):
-        x = _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+        x = _norm(x, params["ln_f"], self.cfg)
         if self.cfg.tie_embeddings:
             return x @ params["wte"]["embedding"].T
         logits = x @ params["lm_head"]["kernel"]

@@ -95,6 +95,15 @@ class GPTConfig:
     # sliding-window (local) attention: token i attends (i-window, i]
     # only — O(S*window) compute and HBM reads in the flash kernel
     attn_window: Optional[int] = None
+    # --- llama-family architecture knobs -------------------------------
+    # norm: 'layernorm' (GPT-2) or 'rmsnorm' (llama — scale only, no
+    # mean subtraction); activation: 'gelu' or 'swiglu' (gated MLP with
+    # a SEPARATE gate kernel so column-parallel TP shards gate/up
+    # consistently); use_bias=False drops every projection bias
+    norm: str = "layernorm"
+    norm_eps: float = 1e-5                 # llama checkpoints use 1e-6
+    activation: str = "gelu"
+    use_bias: bool = True
 
     @property
     def head_dim(self) -> int:
@@ -130,6 +139,24 @@ PRESETS = {
     "gpt2-8b": dict(n_layers=72, n_heads=32, d_model=3072),
 }
 
+# llama-family architecture: rmsnorm + swiglu + rotary + no biases,
+# untied head, no learned positions (ref capability analog: the policy
+# registry's per-architecture variants, module_inject/replace_policy.py)
+_LLAMA_ARCH = dict(norm="rmsnorm", activation="swiglu", use_bias=False,
+                   use_wpe=False, tie_embeddings=False,
+                   parallel_residual=False, norm_eps=1e-6)
+PRESETS.update({
+    "llama-tiny": dict(n_layers=4, n_heads=8, n_kv_heads=4, d_model=256,
+                       d_ff=688, rotary_dim=32, vocab_size=512,
+                       max_seq_len=256, **_LLAMA_ARCH),
+    "llama-7b": dict(n_layers=32, n_heads=32, d_model=4096, d_ff=11008,
+                     rotary_dim=128, vocab_size=32000, max_seq_len=2048,
+                     **_LLAMA_ARCH),
+    "llama-13b": dict(n_layers=40, n_heads=40, d_model=5120, d_ff=13824,
+                      rotary_dim=128, vocab_size=32000, max_seq_len=2048,
+                      **_LLAMA_ARCH),
+})
+
 
 def preset(name: str, **overrides) -> GPTConfig:
     cfg = dict(PRESETS[name])
@@ -153,23 +180,40 @@ def init_params(rng: jax.Array, cfg: GPTConfig) -> Dict:
         return initializer(key, (L,) + shape, jnp.float32)
 
     ks = jax.random.split(k_layers, 6)
+
+    def norm_p():
+        if cfg.norm == "rmsnorm":
+            return {"scale": jnp.ones((L, d))}
+        return {"scale": jnp.ones((L, d)), "bias": jnp.zeros((L, d))}
+
+    def maybe_bias(entry, width):
+        if cfg.use_bias:
+            entry["bias"] = jnp.zeros((L, width))
+        return entry
+
     params = {
         "wte": {"embedding": init(k_embed, (cfg.vocab_size, d), jnp.float32)},
         "wpe": {"embedding": init(k_pos, (cfg.max_seq_len, d), jnp.float32)},
         "block": {
-            "ln1": {"scale": jnp.ones((L, d)), "bias": jnp.zeros((L, d))},
-            "qkv": {"kernel": stacked(ks[0], (d, cfg.qkv_dim)),
-                    "bias": jnp.zeros((L, cfg.qkv_dim))},
-            "attn_out": {"kernel": stacked(ks[1], (d, d), resid_init),
-                         "bias": jnp.zeros((L, d))},
-            "ln2": {"scale": jnp.ones((L, d)), "bias": jnp.zeros((L, d))},
-            "mlp_in": {"kernel": stacked(ks[2], (d, ff)),
-                       "bias": jnp.zeros((L, ff))},
-            "mlp_out": {"kernel": stacked(ks[3], (ff, d), resid_init),
-                        "bias": jnp.zeros((L, d))},
+            "ln1": norm_p(),
+            "qkv": maybe_bias(
+                {"kernel": stacked(ks[0], (d, cfg.qkv_dim))}, cfg.qkv_dim),
+            "attn_out": maybe_bias(
+                {"kernel": stacked(ks[1], (d, d), resid_init)}, d),
+            "ln2": norm_p(),
+            "mlp_in": maybe_bias(
+                {"kernel": stacked(ks[2], (d, ff))}, ff),
+            "mlp_out": maybe_bias(
+                {"kernel": stacked(ks[3], (ff, d), resid_init)}, d),
         },
-        "ln_f": {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))},
+        "ln_f": ({"scale": jnp.ones((d,))} if cfg.norm == "rmsnorm"
+                 else {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))}),
     }
+    if cfg.activation == "swiglu":
+        params["block"]["mlp_gate"] = maybe_bias(
+            {"kernel": stacked(ks[4], (d, ff))}, ff)
+    if not cfg.use_wpe:
+        del params["wpe"]
     if not cfg.tie_embeddings:
         params["lm_head"] = {"kernel": init(k_head, (d, cfg.vocab_size), jnp.float32)}
     return params
@@ -219,6 +263,26 @@ def remat_policy(name: str, flash: bool = False):
     raise ValueError(f"unknown remat_policy {name!r} (expected "
                      "'selective', 'flash_only', 'offload_flash' or "
                      "'full')")
+
+
+def _norm(x, p, cfg):
+    """Config-dispatched normalization: GPT-2 layernorm or llama rmsnorm
+    (scale-only, no mean subtraction). eps comes from cfg.norm_eps —
+    llama-family checkpoints are trained with 1e-6."""
+    eps = cfg.norm_eps
+    if cfg.norm == "rmsnorm":
+        xf = x.astype(jnp.float32)
+        y = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1,
+                                        keepdims=True) + eps)
+        return (y * p["scale"].astype(jnp.float32)).astype(x.dtype)
+    return _layernorm(x, p["scale"], p["bias"], eps=eps)
+
+
+def _dense(h, p):
+    """h @ kernel (+ bias when the config kept biases)."""
+    y = h @ p["kernel"].astype(h.dtype)
+    b = p.get("bias")
+    return y if b is None else y + b.astype(h.dtype)
 
 
 def _layernorm(x, scale, bias, eps=1e-5):
@@ -340,8 +404,8 @@ def _block(x, layer_params, cfg: GPTConfig, dropout_rng=None,
     else:
         dr_attn = dr_mlp = None
 
-    h = _layernorm(x, p["ln1"]["scale"], p["ln1"]["bias"])
-    qkv = h @ p["qkv"]["kernel"].astype(h.dtype) + p["qkv"]["bias"].astype(h.dtype)
+    h = _norm(x, p["ln1"], cfg)
+    qkv = _dense(h, p["qkv"])
     qkv = checkpoint_name(qkv, "qkv")
     Hkv = cfg.kv_heads
     q, k, v = jnp.split(
@@ -356,8 +420,7 @@ def _block(x, layer_params, cfg: GPTConfig, dropout_rng=None,
             cfg.rotary_dim)
     attn = _attention(q, k, v, cfg, segment_ids=segment_ids).reshape(B, S, D)
     attn = checkpoint_name(attn, "attn")
-    attn = attn @ p["attn_out"]["kernel"].astype(attn.dtype) + \
-        p["attn_out"]["bias"].astype(attn.dtype)
+    attn = _dense(attn, p["attn_out"])
     if not deterministic and cfg.dropout > 0:
         attn = _dropout(attn, cfg.dropout, dr_attn)
 
@@ -366,14 +429,17 @@ def _block(x, layer_params, cfg: GPTConfig, dropout_rng=None,
     mlp_src = h if cfg.parallel_residual else None
     if not cfg.parallel_residual:
         x = x + attn
-        mlp_src = _layernorm(x, p["ln2"]["scale"], p["ln2"]["bias"])
+        mlp_src = _norm(x, p["ln2"], cfg)
 
-    m = mlp_src @ p["mlp_in"]["kernel"].astype(mlp_src.dtype) + \
-        p["mlp_in"]["bias"].astype(mlp_src.dtype)
+    m = _dense(mlp_src, p["mlp_in"])
     m = checkpoint_name(m, "mlp_pre")
-    m = jax.nn.gelu(m, approximate=True)
-    m = m @ p["mlp_out"]["kernel"].astype(m.dtype) + \
-        p["mlp_out"]["bias"].astype(m.dtype)
+    if cfg.activation == "swiglu":
+        # gated MLP: silu(x @ gate) * (x @ up) — separate kernels so
+        # column-parallel TP keeps gate/up halves aligned per shard
+        m = jax.nn.silu(_dense(mlp_src, p["mlp_gate"])) * m
+    else:
+        m = jax.nn.gelu(m, approximate=True)
+    m = _dense(m, p["mlp_out"])
     if not deterministic and cfg.dropout > 0:
         m = _dropout(m, cfg.dropout, dr_mlp)
     if cfg.parallel_residual:
@@ -482,7 +548,7 @@ def forward(params: Dict, tokens: jnp.ndarray, cfg: GPTConfig,
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     (x, _), _ = jax.lax.scan(body, (x, rng), (block, jnp.arange(L)))
 
-    x = _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    x = _norm(x, params["ln_f"], cfg)
     if hidden_only:
         return x
     if cfg.tie_embeddings:
@@ -598,6 +664,8 @@ def gpt_partition_rules() -> list:
         PartitionRule(r"block/attn_out/kernel", P(None, "model", None)),
         PartitionRule(r"block/mlp_in/kernel", P(None, None, "model")),
         PartitionRule(r"block/mlp_in/bias", P(None, "model")),
+        PartitionRule(r"block/mlp_gate/kernel", P(None, None, "model")),
+        PartitionRule(r"block/mlp_gate/bias", P(None, "model")),
         PartitionRule(r"block/mlp_out/kernel", P(None, "model", None)),
         # NOTE: embeddings deliberately NOT model-sharded: a vocab-sharded
         # table makes XLA fully rematerialize the gather (SPMD warning) —
@@ -623,6 +691,8 @@ def gpt_pipeline_partition_rules(tp: bool = False) -> list:
         PartitionRule(r"block/attn_out/bias", P("pipe", None)),
         PartitionRule(r"block/mlp_in/kernel", P("pipe", None, model)),
         PartitionRule(r"block/mlp_in/bias", P("pipe", model)),
+        PartitionRule(r"block/mlp_gate/kernel", P("pipe", None, model)),
+        PartitionRule(r"block/mlp_gate/bias", P("pipe", model)),
         PartitionRule(r"block/mlp_out/kernel", P("pipe", model, None)),
         PartitionRule(r"block/mlp_out/bias", P("pipe", None)),
     ]
@@ -651,8 +721,9 @@ def make_pipeline_loss_fn(cfg: GPTConfig, mesh, num_stages: int,
             targets = tokens[:, 1:]
             tokens = tokens[:, :-1]
         S = tokens.shape[1]
-        x = (other["wte"]["embedding"].astype(cfg.dtype)[tokens] +
-             other["wpe"]["embedding"].astype(cfg.dtype)[:S][None])
+        x = other["wte"]["embedding"].astype(cfg.dtype)[tokens]
+        if cfg.use_wpe:
+            x = x + other["wpe"]["embedding"].astype(cfg.dtype)[:S][None]
         return x, targets
 
     def stage_fn(block_local, x):
@@ -662,17 +733,18 @@ def make_pipeline_loss_fn(cfg: GPTConfig, mesh, num_stages: int,
         return y
 
     def head_loss_fn(other, y, targets):
-        y = _layernorm(y, other["ln_f"]["scale"], other["ln_f"]["bias"])
+        y = _norm(y, other["ln_f"], cfg)
         return _head_nll(other, y, targets, cfg)
 
     # block leaves: rank 2 -> P('pipe'), rank 3 -> P('pipe')
     def spec_of(leaf):
         return P(*(["pipe"] + [None] * (leaf.ndim - 1)))
 
-    import jax.numpy as _jnp
-    dummy = init_params(jax.random.PRNGKey(0),
-                        GPTConfig(vocab_size=8, n_layers=num_stages,
-                                  n_heads=1, d_model=8, max_seq_len=8))
+    import dataclasses
+    dummy = init_params(jax.random.PRNGKey(0), dataclasses.replace(
+        cfg, vocab_size=8, n_layers=num_stages, n_heads=1,
+        n_kv_heads=None, d_model=8, d_ff=None, max_seq_len=8,
+        rotary_dim=None, mesh=None))
     specs = jax.tree_util.tree_map(spec_of, dummy["block"])
 
     if schedule == "interleaved":
@@ -715,7 +787,7 @@ def layered_model(cfg: GPTConfig):
         return _block(x, lp, cfg, deterministic=True)
 
     def head_fn(other, y, targets):
-        y = _layernorm(y, other["ln_f"]["scale"], other["ln_f"]["bias"])
+        y = _norm(y, other["ln_f"], cfg)
         return _head_nll(other, y, targets, cfg)
 
     return LayeredModel(split_params=split_params, embed_fn=embed_fn,
@@ -733,17 +805,29 @@ def host_param_factory(seed: int, cfg: GPTConfig):
     InfinityParamEngine without ever holding more than one layer twice."""
     d, ff = cfg.d_model, cfg.ffn_dim
 
+    def norm_p():
+        if cfg.norm == "rmsnorm":
+            return {"scale": np.ones((d,), np.float32)}
+        return {"scale": np.ones((d,), np.float32),
+                "bias": np.zeros((d,), np.float32)}
+
+    def dense_p(r, shape, std):
+        entry = {"kernel": (r.standard_normal(shape, np.float32) * std)}
+        if cfg.use_bias:
+            entry["bias"] = np.zeros((shape[-1],), np.float32)
+        return entry
+
     def factory(which):
         if which == "other":
             r = np.random.default_rng(seed)
             other = {
                 "wte": {"embedding": (r.standard_normal(
                     (cfg.vocab_size, d), np.float32) * 0.02)},
-                "wpe": {"embedding": (r.standard_normal(
-                    (cfg.max_seq_len, d), np.float32) * 0.02)},
-                "ln_f": {"scale": np.ones((d,), np.float32),
-                         "bias": np.zeros((d,), np.float32)},
+                "ln_f": norm_p(),
             }
+            if cfg.use_wpe:
+                other["wpe"] = {"embedding": (r.standard_normal(
+                    (cfg.max_seq_len, d), np.float32) * 0.02)}
             if not cfg.tie_embeddings:
                 other["lm_head"] = {"kernel": (r.standard_normal(
                     (d, cfg.vocab_size), np.float32) * 0.02)}
@@ -751,24 +835,17 @@ def host_param_factory(seed: int, cfg: GPTConfig):
         i = int(which)
         r = np.random.default_rng(seed + 1 + i)
         resid = 0.02 / np.sqrt(2.0 * cfg.n_layers)
-        return {
-            "ln1": {"scale": np.ones((d,), np.float32),
-                    "bias": np.zeros((d,), np.float32)},
-            "qkv": {"kernel": (r.standard_normal(
-                        (d, cfg.qkv_dim), np.float32) * 0.02),
-                    "bias": np.zeros((cfg.qkv_dim,), np.float32)},
-            "attn_out": {"kernel": (r.standard_normal((d, d), np.float32)
-                                    * resid),
-                         "bias": np.zeros((d,), np.float32)},
-            "ln2": {"scale": np.ones((d,), np.float32),
-                    "bias": np.zeros((d,), np.float32)},
-            "mlp_in": {"kernel": (r.standard_normal((d, ff), np.float32)
-                                  * 0.02),
-                       "bias": np.zeros((ff,), np.float32)},
-            "mlp_out": {"kernel": (r.standard_normal((ff, d), np.float32)
-                                   * resid),
-                        "bias": np.zeros((d,), np.float32)},
+        layer = {
+            "ln1": norm_p(),
+            "qkv": dense_p(r, (d, cfg.qkv_dim), 0.02),
+            "attn_out": dense_p(r, (d, d), resid),
+            "ln2": norm_p(),
+            "mlp_in": dense_p(r, (d, ff), 0.02),
+            "mlp_out": dense_p(r, (ff, d), resid),
         }
+        if cfg.activation == "swiglu":
+            layer["mlp_gate"] = dense_p(r, (d, ff), 0.02)
+        return layer
 
     return factory
 
@@ -776,8 +853,15 @@ def host_param_factory(seed: int, cfg: GPTConfig):
 def num_params(cfg: GPTConfig) -> int:
     d, L, ff, V = cfg.d_model, cfg.n_layers, cfg.ffn_dim, cfg.vocab_size
     qkv = cfg.qkv_dim                  # (H + 2*Hkv) * Dh — GQA-aware
-    per_layer = d * qkv + qkv + d * d + d + 2 * d * ff + ff + d + 4 * d
-    n = V * d + cfg.max_seq_len * d + L * per_layer + 2 * d
+    nb = 1 if cfg.use_bias else 0
+    per_layer = (d * qkv + nb * qkv + d * d + nb * d
+                 + 2 * d * ff + nb * (ff + d)
+                 + (2 if cfg.norm == "layernorm" else 1) * 2 * d)
+    if cfg.activation == "swiglu":
+        per_layer += d * ff + nb * ff  # separate gate kernel
+    n = V * d + L * per_layer + (2 if cfg.norm == "layernorm" else 1) * d
+    if cfg.use_wpe:
+        n += cfg.max_seq_len * d
     if not cfg.tie_embeddings:
         n += d * V
     return n

@@ -1,0 +1,197 @@
+"""Llama-family architecture knobs: rmsnorm + swiglu + no-bias + rotary.
+
+Capability analog of the reference's per-architecture module variants
+(ref: module_inject/replace_policy.py — each policy encodes one
+transformer dialect); here the dialect is a GPTConfig, so every engine
+feature (ZeRO, TP, pipeline, SP, offload) composes with it for free.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import gpt
+from deepspeed_tpu.parallel.mesh import MeshSpec, make_mesh
+
+
+def _cfg(**kw):
+    base = dict(dtype=jnp.float32, use_flash_attention=False, remat=False)
+    base.update(kw)
+    return gpt.preset("llama-tiny", **base)
+
+
+def test_param_structure():
+    cfg = _cfg()
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    assert "wpe" not in params                      # rotary, no learned pos
+    assert "lm_head" in params                      # untied head
+    blk = params["block"]
+    assert "mlp_gate" in blk                        # swiglu gate kernel
+    assert set(blk["ln1"]) == {"scale"}             # rmsnorm: no bias
+    assert set(params["ln_f"]) == {"scale"}
+    for name in ("qkv", "attn_out", "mlp_in", "mlp_gate", "mlp_out"):
+        assert set(blk[name]) == {"kernel"}, name   # use_bias=False
+
+
+def test_rmsnorm_matches_manual():
+    cfg = _cfg()
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 5, 16))
+    scale = jnp.linspace(0.5, 1.5, 16)
+    got = gpt._norm(x, {"scale": scale}, cfg)
+    ref = (x / np.sqrt((np.asarray(x) ** 2).mean(-1, keepdims=True)
+                       + 1e-5)) * scale
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_swiglu_matches_manual():
+    cfg = _cfg()
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    p0 = jax.tree_util.tree_map(lambda x: x[0], params["block"])
+    x = jax.random.normal(jax.random.PRNGKey(2), (1, 4, cfg.d_model))
+    h = gpt._norm(x + 0, p0["ln2"], cfg)
+    up = h @ p0["mlp_in"]["kernel"]
+    gate = h @ p0["mlp_gate"]["kernel"]
+    manual = (jax.nn.silu(gate) * up) @ p0["mlp_out"]["kernel"]
+    # run the whole block and check the MLP branch contributes exactly:
+    # block(x) - x - attn_branch == mlp_branch; easier: call _block with
+    # attention zeroed via zero qkv weights
+    import dataclasses
+    pz = dict(p0)
+    pz["qkv"] = {"kernel": jnp.zeros_like(p0["qkv"]["kernel"])}
+    pz["attn_out"] = {"kernel": jnp.zeros_like(p0["attn_out"]["kernel"])}
+    out = gpt._block(x, pz, cfg, deterministic=True)
+    # with attn == 0: out = x + mlp(norm(x))  (ln2 of x+0)
+    np.testing.assert_allclose(np.asarray(out - x), np.asarray(manual),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_llama_trains_and_loss_decreases(devices):
+    cfg = _cfg()
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt.make_loss_fn(cfg), model_parameters=params,
+        config={"train_batch_size": 8,
+                "zero_optimization": {"stage": 2},
+                "optimizer": {"type": "adamw", "params": {"lr": 3e-3}},
+                "steps_per_print": 1000})
+    toks = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (8, 65)).astype(np.int32)
+    losses = [float(engine.train_batch({"tokens": toks})["loss"])
+              for _ in range(8)]
+    assert losses[-1] < losses[0] - 0.3, losses
+
+
+def test_llama_tensor_parallel_parity(devices):
+    """swiglu under TP: the separate gate kernel keeps gate/up halves
+    aligned per model-shard — sharded loss equals unsharded."""
+    cfg = _cfg()
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    toks = np.random.default_rng(1).integers(
+        0, cfg.vocab_size, (4, 33)).astype(np.int32)
+    ref = float(gpt.loss_fn(params, {"tokens": jnp.asarray(toks)},
+                            jax.random.PRNGKey(0), cfg,
+                            deterministic=True))
+    mesh = make_mesh(MeshSpec(data=4, model=2))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt.make_loss_fn(cfg), model_parameters=params,
+        config={"train_batch_size": 4,
+                "mesh": {"tensor_parallel_size": 2,
+                         "data_parallel_size": 4},
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "steps_per_print": 1000},
+        mesh=mesh, partition_rules=gpt.gpt_partition_rules())
+    got = float(engine.train_batch({"tokens": toks})["loss"])
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+    # the gate kernel really is model-sharded
+    gk = engine.state.params["block"]["mlp_gate"]["kernel"]
+    assert gk.sharding.shard_shape(gk.shape)[-1] == gk.shape[-1] // 2
+
+
+def test_llama_gqa_rotary_ring_sp(devices):
+    """The llama dialect composes with ring sequence parallelism (GQA
+    kv rotation + rotary positions)."""
+    cfg = _cfg(max_seq_len=64, sequence_parallel=True, sp_impl="ring")
+    mesh = make_mesh(MeshSpec(data=2, sequence=4))
+    import dataclasses
+    cfg = dataclasses.replace(cfg, mesh=mesh)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    cfg_dense = dataclasses.replace(cfg, sequence_parallel=False,
+                                    mesh=None)
+    toks = np.random.default_rng(2).integers(
+        0, cfg.vocab_size, (4, 65)).astype(np.int32)
+    ref = float(gpt.loss_fn(params, {"tokens": jnp.asarray(toks)},
+                            jax.random.PRNGKey(0), cfg_dense,
+                            deterministic=True))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt.make_loss_fn(cfg), model_parameters=params,
+        config={"train_batch_size": 4,
+                "mesh": {"sequence_parallel_size": 4,
+                         "data_parallel_size": 2},
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "steps_per_print": 1000},
+        mesh=mesh)
+    got = float(engine.train_batch({"tokens": toks})["loss"])
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_llama_checkpoint_roundtrip(devices, tmp_path):
+    cfg = _cfg()
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    ds = {"train_batch_size": 8,
+          "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+          "steps_per_print": 1000}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt.make_loss_fn(cfg), model_parameters=params, config=ds)
+    toks = np.random.default_rng(3).integers(
+        0, cfg.vocab_size, (8, 33)).astype(np.int32)
+    engine.train_batch({"tokens": toks})
+    engine.save_checkpoint(str(tmp_path))
+    next_loss = float(engine.train_batch({"tokens": toks})["loss"])
+
+    engine2, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt.make_loss_fn(cfg),
+        model_parameters=gpt.init_params(jax.random.PRNGKey(7), cfg),
+        config=ds)
+    engine2.load_checkpoint(str(tmp_path))
+    resumed = float(engine2.train_batch({"tokens": toks})["loss"])
+    np.testing.assert_allclose(resumed, next_loss, rtol=1e-5)
+
+
+def test_llama_decode_matches_full_forward(devices):
+    """llama-dialect inference: token-by-token decode (rmsnorm/swiglu/
+    no-bias blocks + rotary GQA cache) reproduces full-forward greedy."""
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    cfg = _cfg()
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    eng = InferenceEngine(config=cfg, params=params, dtype=jnp.float32)
+    rng = np.random.default_rng(5)
+    tokens = rng.integers(0, cfg.vocab_size, (2, 8)).astype(np.int32)
+    gen = eng.generate(tokens, max_new_tokens=5, temperature=0.0)
+
+    cur = tokens.copy()
+    for _ in range(5):
+        logits = np.asarray(gpt.forward(params, jnp.asarray(cur), cfg))
+        nxt = logits[:, -1].argmax(-1)[:, None].astype(np.int32)
+        cur = np.concatenate([cur, nxt], axis=1)
+    np.testing.assert_array_equal(gen, cur)
+
+
+def test_llama_pipeline_parity(devices):
+    """The llama dialect runs under pipeline parallelism: the shard_map
+    spec tree is built from a dialect-preserving dummy config."""
+    cfg = _cfg()
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    toks = np.random.default_rng(6).integers(
+        0, cfg.vocab_size, (8, 33)).astype(np.int32)
+    batch = {"tokens": jnp.asarray(toks)}
+    ref = float(gpt.loss_fn(params, dict(batch), jax.random.PRNGKey(0),
+                            cfg, deterministic=True))
+    mesh = make_mesh(MeshSpec(pipe=2, data=-1))
+    loss_fn = gpt.make_pipeline_loss_fn(cfg, mesh, num_stages=2,
+                                        num_micro=2)
+    with jax.set_mesh(mesh):
+        got = float(jax.jit(loss_fn)(params, batch, jax.random.PRNGKey(0)))
+    np.testing.assert_allclose(ref, got, rtol=1e-5)
