@@ -80,7 +80,7 @@ def _block_prefill(x, p, cfg: GPTConfig, kv_mask=None, positions=None):
         from deepspeed_tpu.ops.attention.rotary import apply_rotary
         q, k = apply_rotary(
             q, k, positions if positions is not None else jnp.arange(S),
-            cfg.rotary_dim)
+            cfg.rotary_dim, base=cfg.rope_theta)
     attn = gpt_lib._attention(q, k, v, cfg, kv_mask=kv_mask).reshape(B, S, D)
     attn = _dense(attn, p["attn_out"])
     if cfg.parallel_residual:
@@ -130,7 +130,7 @@ def _block_decode(x, k_cache, v_cache, pos, p, cfg: GPTConfig,
         from deepspeed_tpu.ops.attention.rotary import apply_rotary
         rp = pos[None] if row_pos is None else row_pos[:, None]
         q, k = apply_rotary(q.reshape(B, 1, H, Dh), k.reshape(B, 1, Hkv, Dh),
-                            rp, cfg.rotary_dim)
+                            rp, cfg.rotary_dim, base=cfg.rope_theta)
         q = q.reshape(B, 1, H, Dh)
         k = k.reshape(B, 1, Hkv, Dh)
     q = q.reshape(B, Hkv, group, Dh)

@@ -504,3 +504,95 @@ def revert_transformer_layer(*a, **k):  # pragma: no cover
     here: conversion is out-of-place; the source model is untouched."""
     raise NotImplementedError(
         "conversion is out-of-place; the original model object is unchanged")
+
+
+@register_policy("hf_llama")
+class HFLlamaPolicy:
+    """HuggingFace llama-family decoder (Llama/Mistral layout) -> native
+    rmsnorm/swiglu dialect (capability analog of the reference's
+    per-architecture injection policies, module_inject/replace_policy.py).
+
+    HF stores q/k projections in the split-half rotary convention
+    (rotate_half: channel p pairs with p + Dh/2); the native rotary is
+    interleaved (GPT-J style: 2p pairs with 2p+1), so q/k output
+    channels are permuted per head — interleaved 2p <- HF p,
+    2p+1 <- HF p + Dh/2 — after which the two conventions compute
+    identical attention."""
+
+    @staticmethod
+    def matches(model) -> bool:
+        # headless LlamaModel is excluded: llama ties nothing, so there
+        # is no lm_head to synthesize from (unlike HFGPT2Policy's tied
+        # fallback)
+        return type(model).__name__ in ("LlamaForCausalLM",
+                                        "MistralForCausalLM")
+
+    @staticmethod
+    def convert(model) -> Tuple[GPTConfig, Dict]:
+        import jax.numpy as jnp
+        hf = model.config
+        Dh = hf.hidden_size // hf.num_attention_heads
+        n_kv = getattr(hf, "num_key_value_heads", hf.num_attention_heads)
+        cfg = GPTConfig(
+            vocab_size=hf.vocab_size,
+            n_layers=hf.num_hidden_layers,
+            n_heads=hf.num_attention_heads,
+            n_kv_heads=n_kv if n_kv != hf.num_attention_heads else None,
+            d_model=hf.hidden_size,
+            d_ff=hf.intermediate_size,
+            max_seq_len=hf.max_position_embeddings,
+            norm="rmsnorm", norm_eps=hf.rms_norm_eps,
+            activation="swiglu", use_bias=False, use_wpe=False,
+            tie_embeddings=False, rotary_dim=Dh,
+            rope_theta=getattr(hf, "rope_theta", 10000.0),
+            attn_window=getattr(hf, "sliding_window", None))
+        sd = {k: v.detach().cpu().numpy()
+              for k, v in model.state_dict().items()}
+        pre = "model." if any(k.startswith("model.") for k in sd) else ""
+        L = cfg.n_layers
+        half = Dh // 2
+
+        def perm_heads(w, H):
+            """[H*Dh, in] split-half -> interleaved rotary channels."""
+            w = w.reshape(H, Dh, -1)
+            out = np.empty_like(w)
+            out[:, 0::2] = w[:, :half]
+            out[:, 1::2] = w[:, half:]
+            return out.reshape(H * Dh, -1)
+
+        def lin(fmt, perm_h=None):
+            mats = []
+            for i in range(L):
+                w = sd[pre + fmt.format(i)]
+                if perm_h:
+                    w = perm_heads(w, perm_h)
+                mats.append(w.T)          # [out, in] -> [in, out]
+            return jnp.asarray(np.stack(mats))
+
+        def vec(fmt):
+            return jnp.asarray(np.stack([sd[pre + fmt.format(i)]
+                                         for i in range(L)]))
+
+        qkv = jnp.concatenate(
+            [lin("layers.{}.self_attn.q_proj.weight", cfg.n_heads),
+             lin("layers.{}.self_attn.k_proj.weight", cfg.kv_heads),
+             lin("layers.{}.self_attn.v_proj.weight")], axis=-1)
+        params = {
+            "wte": {"embedding": jnp.asarray(sd[pre + "embed_tokens.weight"])},
+            "block": {
+                "ln1": {"scale": vec("layers.{}.input_layernorm.weight")},
+                "qkv": {"kernel": qkv},
+                "attn_out": {
+                    "kernel": lin("layers.{}.self_attn.o_proj.weight")},
+                "ln2": {"scale": vec(
+                    "layers.{}.post_attention_layernorm.weight")},
+                "mlp_gate": {"kernel": lin("layers.{}.mlp.gate_proj.weight")},
+                "mlp_in": {"kernel": lin("layers.{}.mlp.up_proj.weight")},
+                "mlp_out": {"kernel": lin("layers.{}.mlp.down_proj.weight")},
+            },
+            "ln_f": {"scale": jnp.asarray(sd[pre + "norm.weight"])},
+            "lm_head": {"kernel": jnp.asarray(sd["lm_head.weight"].T)},
+        }
+        logger.info(f"injected HF llama: {cfg.n_layers}L/{cfg.d_model}d "
+                    f"kv_heads={cfg.kv_heads} theta={cfg.rope_theta}")
+        return cfg, params

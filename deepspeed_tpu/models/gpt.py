@@ -104,6 +104,7 @@ class GPTConfig:
     norm_eps: float = 1e-5                 # llama checkpoints use 1e-6
     activation: str = "gelu"
     use_bias: bool = True
+    rope_theta: float = 10000.0            # rotary base (llama-3: 5e5)
 
     @property
     def head_dim(self) -> int:
@@ -417,7 +418,7 @@ def _block(x, layer_params, cfg: GPTConfig, dropout_rng=None,
         from deepspeed_tpu.ops.attention.rotary import apply_rotary
         q, k = apply_rotary(
             q, k, positions if positions is not None else jnp.arange(S),
-            cfg.rotary_dim)
+            cfg.rotary_dim, base=cfg.rope_theta)
     attn = _attention(q, k, v, cfg, segment_ids=segment_ids).reshape(B, S, D)
     attn = checkpoint_name(attn, "attn")
     attn = _dense(attn, p["attn_out"])

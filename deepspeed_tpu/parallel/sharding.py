@@ -229,8 +229,10 @@ def megatron_rules() -> List[PartitionRule]:
     return [
         PartitionRule(r"(qkv|query|key|value|wqkv)/kernel", P(None, "model")),
         PartitionRule(r"(attn_out|out_proj|wo)/kernel", P("model", None)),
-        PartitionRule(r"(mlp_in|fc_in|wi|up_proj|gate_proj)/kernel", P(None, "model")),
+        PartitionRule(r"(mlp_in|mlp_gate|fc_in|wi|up_proj|gate_proj)/kernel",
+                      P(None, "model")),
         PartitionRule(r"(mlp_out|fc_out|wo_mlp|down_proj)/kernel", P("model", None)),
         PartitionRule(r"(embed|wte|word_embeddings)/embedding", P("model", None)),
-        PartitionRule(r"(qkv|query|key|value|wqkv|mlp_in|fc_in|wi)/bias", P("model")),
+        PartitionRule(r"(qkv|query|key|value|wqkv|mlp_in|mlp_gate|fc_in|wi)/bias",
+                      P("model")),
     ]

@@ -41,7 +41,7 @@ def test_rmsnorm_matches_manual():
     scale = jnp.linspace(0.5, 1.5, 16)
     got = gpt._norm(x, {"scale": scale}, cfg)
     ref = (x / np.sqrt((np.asarray(x) ** 2).mean(-1, keepdims=True)
-                       + 1e-5)) * scale
+                       + cfg.norm_eps)) * scale
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
 
@@ -195,3 +195,35 @@ def test_llama_pipeline_parity(devices):
     with jax.set_mesh(mesh):
         got = float(jax.jit(loss_fn)(params, batch, jax.random.PRNGKey(0)))
     np.testing.assert_allclose(ref, got, rtol=1e-5)
+
+
+def test_hf_llama_injection(devices):
+    """HF llama (rmsnorm/swiglu/GQA, split-half rotary) through the
+    policy reproduces HF logits — incl. the split-half -> interleaved
+    rotary channel permutation of q/k projections."""
+    transformers = pytest.importorskip("transformers")
+    import torch
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=96, hidden_size=64, intermediate_size=172,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64,
+        rms_norm_eps=1e-6, rope_theta=10000.0)
+    torch.manual_seed(0)
+    hf_model = transformers.LlamaForCausalLM(hf_cfg).eval()
+
+    eng = deepspeed_tpu.init_inference(model=hf_model, dtype=jnp.float32)
+    assert eng.cfg.norm == "rmsnorm" and eng.cfg.activation == "swiglu"
+    assert eng.cfg.kv_heads == 2 and eng.cfg.rotary_dim == 16
+    tokens = np.random.default_rng(0).integers(0, 96, (2, 9)).astype(np.int32)
+    ours = np.asarray(eng.forward(tokens))
+    with torch.no_grad():
+        theirs = hf_model(torch.tensor(tokens.astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-3)
+
+    # and the KV-cache decode path agrees with HF greedy generation
+    gen = eng.generate(tokens, max_new_tokens=4, temperature=0.0)
+    with torch.no_grad():
+        ref = hf_model.generate(
+            torch.tensor(tokens.astype(np.int64)), max_new_tokens=4,
+            do_sample=False).numpy()
+    np.testing.assert_array_equal(gen, ref)
