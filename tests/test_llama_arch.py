@@ -225,5 +225,5 @@ def test_hf_llama_injection(devices):
     with torch.no_grad():
         ref = hf_model.generate(
             torch.tensor(tokens.astype(np.int64)), max_new_tokens=4,
-            do_sample=False).numpy()
+            do_sample=False, eos_token_id=None).numpy()
     np.testing.assert_array_equal(gen, ref)

@@ -1,0 +1,104 @@
+"""Pick the headline config from probe results and write BENCH_HEADLINE.json.
+
+Parses headline_probe JSON lines ({"variant": ..., "preset": ...,
+"tokens_per_s": ...}) out of a log (chip_queue/rig_watch output), keeps
+the gpt2-1.5b family, and — if the best variant beats the incumbent
+default (b16-full-ce) by more than a jitter margin — writes the
+repo-root BENCH_HEADLINE.json that bench.py's _headline_overrides
+consumes. Conservative by construction: no parsable results, no
+incumbent measurement, or a within-margin winner all leave the override
+absent/unchanged so the established config publishes.
+
+Usage: python tools/pick_headline.py LOGFILE [--margin 0.01] [--apply]
+Prints one decision JSON line; only --apply writes the file.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(ROOT, "BENCH_HEADLINE.json")
+INCUMBENT = "b16-full-ce"
+
+
+def parse_results(path):
+    out = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not (line.startswith("{") and '"variant"' in line):
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("preset") != "gpt2-1.5b":
+                continue
+            if not rec.get("tokens_per_s"):
+                continue
+            out[rec["variant"]] = rec          # later lines win
+    return out
+
+
+def overrides_for(rec):
+    """Map a probe result line to bench.py's BENCH_HEADLINE.json keys."""
+    ov = {"batch": rec["batch"],
+          "remat_pol": rec["remat"] if rec["remat"] != "none" else "full",
+          "loss_chunk": rec["loss_chunk"],
+          "flash_block": rec["fwd_blocks"][0],
+          "flash_block_kv": (rec["fwd_blocks"][1]
+                             if rec["fwd_blocks"][1] != rec["fwd_blocks"][0]
+                             else None),
+          "bwd_block_q": rec["bwd_blocks"][0],
+          "bwd_block_kv": rec["bwd_blocks"][1],
+          "chosen_from": rec["variant"],
+          "probe_tokens_per_s": rec["tokens_per_s"],
+          "probe_mfu": rec["mfu"]}
+    return ov
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("log")
+    ap.add_argument("--margin", type=float, default=0.01,
+                    help="fractional tokens/s gain required to flip")
+    ap.add_argument("--apply", action="store_true")
+    args = ap.parse_args()
+
+    res = parse_results(args.log)
+    if not res:
+        print(json.dumps({"decision": "no results parsed"}))
+        return
+    best = max(res.values(), key=lambda r: r["tokens_per_s"])
+    inc = res.get(INCUMBENT)
+    if best["variant"] == INCUMBENT or inc is None:
+        # nothing beats (or nothing measured against) the incumbent —
+        # leave/remove the override so the default publishes
+        if args.apply and os.path.exists(OUT):
+            os.remove(OUT)
+        print(json.dumps({"decision": "keep incumbent",
+                          "best": best["variant"],
+                          "tokens_per_s": best["tokens_per_s"],
+                          "incumbent_measured": inc is not None}))
+        return
+    gain = best["tokens_per_s"] / inc["tokens_per_s"] - 1.0
+    if gain <= args.margin:
+        if args.apply and os.path.exists(OUT):
+            os.remove(OUT)
+        print(json.dumps({"decision": "within margin, keep incumbent",
+                          "best": best["variant"],
+                          "gain": round(gain, 4)}))
+        return
+    ov = overrides_for(best)
+    if args.apply:
+        with open(OUT, "w") as f:
+            json.dump(ov, f, indent=1)
+    print(json.dumps({"decision": "flip", "to": best["variant"],
+                      "gain": round(gain, 4), "applied": args.apply,
+                      "overrides": ov}))
+
+
+if __name__ == "__main__":
+    main()

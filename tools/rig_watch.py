@@ -59,8 +59,22 @@ def main():
 
     t0 = time.time()
     log(event="queue start", items=items)
-    r = subprocess.run([sys.executable, "tools/chip_queue.py"] + items)
-    log(event="queue done", rc=r.returncode, minutes=round((time.time() - t0) / 60, 1))
+    # the queue writes the results file DIRECTLY as its stdout (fresh
+    # per run): the measurements survive a dead watcher, and the
+    # unattended headline decision below reads only this run's lines
+    results_path = "chipq_results.log"
+    with open(results_path, "w") as res:
+        rc = subprocess.run(
+            [sys.executable, "tools/chip_queue.py"] + items,
+            stdout=res, stderr=subprocess.STDOUT).returncode
+    log(event="queue done", rc=rc, results=results_path,
+        minutes=round((time.time() - t0) / 60, 1))
+    if "probe" in items:
+        d = subprocess.run([sys.executable, "tools/pick_headline.py",
+                            results_path, "--apply"],
+                           capture_output=True, text=True)
+        log(event="headline decision", out=d.stdout.strip()[-400:],
+            err=d.stderr.strip()[-400:], rc=d.returncode)
 
 
 if __name__ == "__main__":
