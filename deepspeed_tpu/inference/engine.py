@@ -92,22 +92,32 @@ def _block_prefill(x, p, cfg: GPTConfig, kv_mask=None, positions=None):
 
 def _ffn(h, p, cfg):
     """Dense MLP or MoE FFN for one block (ref MoE inference path:
-    ops/transformer/inference/moe_inference.py). MoE runs the same GShard
-    top-k dispatch as training, in eval mode (no jitter, aux discarded)."""
+    ops/transformer/inference/moe_inference.py).
+
+    The MoE eval path NEVER drops a token (GShard capacity bounds
+    training dispatch; it must not change eval semantics — the gate's
+    1.0-eval-capacity default silently dropped tokens here, caught by
+    the Mixtral HF-parity test) and avoids the no-drop dispatch tensors
+    (capacity = S makes the one-hot combine O(E*S^2)): every expert
+    runs on every token — O(E*T*d) memory, E/k extra expert flops — and
+    tokens mix their top-k renormalized softmax weights, exactly
+    Mixtral's softmax-over-top-k router semantics."""
     if "moe" not in p:
         return _mlp(h, p, cfg)
     from deepspeed_tpu.moe.experts import ffn_expert_fn
-    from deepspeed_tpu.moe.sharded_moe import TopKGate, moe_layer_apply
-    gate = TopKGate(k=getattr(cfg, "moe_k", 1),
-                    capacity_factor=getattr(cfg, "eval_capacity_factor",
-                                            getattr(cfg, "capacity_factor",
-                                                    1.25)),
-                    min_capacity=getattr(cfg, "min_capacity", 4),
-                    noisy_gate_policy=None)
-    y, _aux, _counts = moe_layer_apply(
-        gate, p["moe"]["gate"], p["moe"]["experts"], ffn_expert_fn,
-        h, jax.random.PRNGKey(0), train=False)
-    return y
+    k = getattr(cfg, "moe_k", 1)
+    B, S, D = h.shape
+    ex = p["moe"]["experts"]
+    E = ex["wi"]["kernel"].shape[0]
+    logits = h.reshape(-1, D).astype(jnp.float32) @ p["moe"]["gate"]["wg"]
+    probs = jax.nn.softmax(logits, axis=-1)               # [T, E]
+    top_p, top_i = jax.lax.top_k(probs, k)
+    w = top_p / jnp.clip(top_p.sum(-1, keepdims=True), 1e-9)
+    w_full = jnp.sum(jax.nn.one_hot(top_i, E) * w[..., None], axis=-2)
+    outs = ffn_expert_fn(ex, jnp.broadcast_to(
+        h.reshape(1, -1, D), (E, B * S, D)))              # [E, T, D]
+    y = jnp.einsum("etd,te->td", outs, w_full.astype(h.dtype))
+    return y.reshape(B, S, D)
 
 
 def _block_decode(x, k_cache, v_cache, pos, p, cfg: GPTConfig,

@@ -506,6 +506,40 @@ def revert_transformer_layer(*a, **k):  # pragma: no cover
         "conversion is out-of-place; the original model object is unchanged")
 
 
+def _hf_llama_readers(sd, L, Dh):
+    """Shared readers for HF llama-layout state dicts (used by the llama
+    and mixtral policies): 'model.'-prefix detection, stacked [L, in,
+    out] linears with the optional split-half -> interleaved rotary
+    channel permutation (2p <- p, 2p+1 <- p + Dh/2), and stacked norm
+    scales."""
+    pre = "model." if any(k.startswith("model.") for k in sd) else ""
+    half = Dh // 2
+
+    def perm_heads(w, H):
+        w = w.reshape(H, Dh, -1)
+        out = np.empty_like(w)
+        out[:, 0::2] = w[:, :half]
+        out[:, 1::2] = w[:, half:]
+        return out.reshape(H * Dh, -1)
+
+    def lin(fmt, perm_h=None):
+        import jax.numpy as jnp
+        mats = []
+        for i in range(L):
+            w = sd[pre + fmt.format(i)]
+            if perm_h:
+                w = perm_heads(w, perm_h)
+            mats.append(w.T)
+        return jnp.asarray(np.stack(mats))
+
+    def vec(fmt):
+        import jax.numpy as jnp
+        return jnp.asarray(np.stack([sd[pre + fmt.format(i)]
+                                     for i in range(L)]))
+
+    return pre, lin, vec
+
+
 @register_policy("hf_llama")
 class HFLlamaPolicy:
     """HuggingFace llama-family decoder (Llama/Mistral layout) -> native
@@ -548,30 +582,8 @@ class HFLlamaPolicy:
             attn_window=getattr(hf, "sliding_window", None))
         sd = {k: v.detach().cpu().numpy()
               for k, v in model.state_dict().items()}
-        pre = "model." if any(k.startswith("model.") for k in sd) else ""
         L = cfg.n_layers
-        half = Dh // 2
-
-        def perm_heads(w, H):
-            """[H*Dh, in] split-half -> interleaved rotary channels."""
-            w = w.reshape(H, Dh, -1)
-            out = np.empty_like(w)
-            out[:, 0::2] = w[:, :half]
-            out[:, 1::2] = w[:, half:]
-            return out.reshape(H * Dh, -1)
-
-        def lin(fmt, perm_h=None):
-            mats = []
-            for i in range(L):
-                w = sd[pre + fmt.format(i)]
-                if perm_h:
-                    w = perm_heads(w, perm_h)
-                mats.append(w.T)          # [out, in] -> [in, out]
-            return jnp.asarray(np.stack(mats))
-
-        def vec(fmt):
-            return jnp.asarray(np.stack([sd[pre + fmt.format(i)]
-                                         for i in range(L)]))
+        pre, lin, vec = _hf_llama_readers(sd, L, Dh)
 
         qkv = jnp.concatenate(
             [lin("layers.{}.self_attn.q_proj.weight", cfg.n_heads),
@@ -595,4 +607,91 @@ class HFLlamaPolicy:
         }
         logger.info(f"injected HF llama: {cfg.n_layers}L/{cfg.d_model}d "
                     f"kv_heads={cfg.kv_heads} theta={cfg.rope_theta}")
+        return cfg, params
+
+
+@register_policy("hf_mixtral")
+class HFMixtralPolicy:
+    """HuggingFace Mixtral (llama attention + top-k sparse MoE FFN) ->
+    native MoE decode path (capability analog of the reference's MoE
+    inference modules, ops/transformer/inference/moe_inference.py).
+
+    Router parity: Mixtral weighs experts by softmax over the top-k
+    router logits; the eval path takes the full softmax and
+    renormalizes the k selected probabilities — mathematically the
+    same weights. Token dropping is disabled at eval (engine._ffn runs
+    a dense no-drop expert mix; GShard capacity exists for training
+    efficiency, not eval semantics). q/k rotary channels get the same
+    split-half -> interleaved permutation as HFLlamaPolicy."""
+
+    @staticmethod
+    def matches(model) -> bool:
+        return type(model).__name__ == "MixtralForCausalLM"
+
+    @staticmethod
+    def convert(model) -> Tuple[GPTConfig, Dict]:
+        import jax.numpy as jnp
+        from deepspeed_tpu.models.moe_gpt import MoEGPTConfig
+        hf = model.config
+        Dh = hf.hidden_size // hf.num_attention_heads
+        n_kv = getattr(hf, "num_key_value_heads", hf.num_attention_heads)
+        E = hf.num_local_experts
+        if hf.num_experts_per_tok > 2:
+            raise ValueError(
+                f"Mixtral checkpoint routes top-{hf.num_experts_per_tok} "
+                f"but the gating layer supports top-1/top-2 only")
+        cfg = MoEGPTConfig(
+            vocab_size=hf.vocab_size,
+            n_layers=hf.num_hidden_layers,
+            n_heads=hf.num_attention_heads,
+            n_kv_heads=n_kv if n_kv != hf.num_attention_heads else None,
+            d_model=hf.hidden_size,
+            d_ff=hf.intermediate_size,
+            max_seq_len=hf.max_position_embeddings,
+            norm="rmsnorm", norm_eps=hf.rms_norm_eps,
+            activation="swiglu", use_bias=False, use_wpe=False,
+            tie_embeddings=False, rotary_dim=Dh,
+            rope_theta=getattr(hf, "rope_theta", 10000.0),
+            attn_window=getattr(hf, "sliding_window", None),
+            num_experts=E, moe_k=hf.num_experts_per_tok)
+        sd = {k: v.detach().cpu().numpy()
+              for k, v in model.state_dict().items()}
+        L = cfg.n_layers
+        pre, lin, vec = _hf_llama_readers(sd, L, Dh)
+
+        def experts(w_name):
+            # [L, E, out, in] -> transpose to [L, E, in, out]
+            return jnp.asarray(np.stack(
+                [np.stack([sd[pre + f"layers.{i}.block_sparse_moe."
+                                    f"experts.{e}.{w_name}.weight"].T
+                           for e in range(E)]) for i in range(L)]))
+
+        qkv = jnp.concatenate(
+            [lin("layers.{}.self_attn.q_proj.weight", cfg.n_heads),
+             lin("layers.{}.self_attn.k_proj.weight", cfg.kv_heads),
+             lin("layers.{}.self_attn.v_proj.weight")], axis=-1)
+        params = {
+            "wte": {"embedding": jnp.asarray(sd[pre + "embed_tokens.weight"])},
+            "block": {
+                "ln1": {"scale": vec("layers.{}.input_layernorm.weight")},
+                "qkv": {"kernel": qkv},
+                "attn_out": {
+                    "kernel": lin("layers.{}.self_attn.o_proj.weight")},
+                "ln2": {"scale": vec(
+                    "layers.{}.post_attention_layernorm.weight")},
+                "moe": {
+                    "gate": {"wg": lin(
+                        "layers.{}.block_sparse_moe.gate.weight")},
+                    "experts": {
+                        "wi": {"kernel": experts("w3")},   # up
+                        "wg": {"kernel": experts("w1")},   # gate
+                        "wo": {"kernel": experts("w2")},   # down
+                    },
+                },
+            },
+            "ln_f": {"scale": jnp.asarray(sd[pre + "norm.weight"])},
+            "lm_head": {"kernel": jnp.asarray(sd["lm_head.weight"].T)},
+        }
+        logger.info(f"injected HF Mixtral: {cfg.n_layers}L/{cfg.d_model}d "
+                    f"E={E} k={cfg.moe_k}")
         return cfg, params

@@ -18,7 +18,8 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.models import gpt as gpt_lib
-from deepspeed_tpu.models.gpt import GPTConfig, _attention, _layernorm
+from deepspeed_tpu.models.gpt import (GPTConfig, _attention,
+                                      _dense, _norm)
 from deepspeed_tpu.moe.experts import ffn_expert_fn
 from deepspeed_tpu.moe.layer import MoEConfig
 from deepspeed_tpu.moe.sharded_moe import TopKGate, moe_layer_apply
@@ -30,6 +31,11 @@ class MoEGPTConfig(GPTConfig):
     num_experts: int = 8
     moe_k: int = 1
     capacity_factor: float = 1.25
+    # eval capacity (None = same as training): the gate picks this when
+    # train=False — leaving it at the gate's own 1.0 default silently
+    # dropped tokens in validation (same defect class as the inference
+    # _ffn bug caught by the Mixtral parity test)
+    eval_capacity_factor: Optional[float] = None
     min_capacity: int = 4
     aux_loss_weight: float = 0.01
     noisy_gate_policy: Optional[str] = None
@@ -43,14 +49,25 @@ def init_params(rng: jax.Array, cfg: MoEGPTConfig) -> Dict:
     # replace dense MLP with per-layer expert stacks + gate
     block = base["block"]
     del block["mlp_in"], block["mlp_out"]
+    block.pop("mlp_gate", None)        # swiglu dense gate -> expert wg
+    def expert_p(key, shape):
+        entry = {"kernel": init(key, shape, jnp.float32)}
+        if cfg.use_bias:
+            entry["bias"] = jnp.zeros(shape[:2] + shape[-1:], jnp.float32)
+        return entry
+
+    experts = {
+        "wi": expert_p(ks[1], (L, E, d, ff)),
+        "wo": expert_p(ks[2], (L, E, ff, d)),
+    }
+    if cfg.activation == "swiglu":
+        # llama/mixtral expert dialect: a separate silu gate stack
+        # (ffn_expert_fn dispatches on the "wg" key)
+        experts["wg"] = expert_p(jax.random.fold_in(ks[1], 7),
+                                 (L, E, d, ff))
     block["moe"] = {
         "gate": {"wg": init(ks[0], (L, d, E), jnp.float32)},
-        "experts": {
-            "wi": {"kernel": init(ks[1], (L, E, d, ff), jnp.float32),
-                   "bias": jnp.zeros((L, E, ff), jnp.float32)},
-            "wo": {"kernel": init(ks[2], (L, E, ff, d), jnp.float32),
-                   "bias": jnp.zeros((L, E, d), jnp.float32)},
-        },
+        "experts": experts,
     }
     return base
 
@@ -59,8 +76,10 @@ def num_params(cfg: MoEGPTConfig) -> int:
     """Dense-GPT count with every layer's MLP swapped for the E-expert
     stack + gate (init_params above is the shape source of truth)."""
     d, L, ff, E = cfg.d_model, cfg.n_layers, cfg.ffn_dim, cfg.num_experts
-    dense_mlp = 2 * d * ff + d + ff
-    moe_mlp = E * (2 * d * ff + d + ff) + d * E
+    nb = 1 if cfg.use_bias else 0
+    n_proj = 3 if cfg.activation == "swiglu" else 2
+    dense_mlp = n_proj * d * ff + nb * ((n_proj - 1) * ff + d)
+    moe_mlp = E * (n_proj * d * ff + nb * ((n_proj - 1) * ff + d)) + d * E
     return gpt_lib.num_params(cfg) + L * (moe_mlp - dense_mlp)
 
 
@@ -71,17 +90,20 @@ def _moe_block(x, layer_params, cfg: MoEGPTConfig, rng, train: bool):
     p = layer_params
 
     Hkv = cfg.kv_heads
-    h = _layernorm(x, p["ln1"]["scale"], p["ln1"]["bias"])
-    qkv = h @ p["qkv"]["kernel"].astype(h.dtype) + p["qkv"]["bias"].astype(h.dtype)
+    h = _norm(x, p["ln1"], cfg)
+    qkv = _dense(h, p["qkv"])
     q, k, v = jnp.split(qkv, [H * Dh, (H + Hkv) * Dh], axis=-1)
     attn = _attention(q.reshape(B, S, H, Dh), k.reshape(B, S, Hkv, Dh),
                       v.reshape(B, S, Hkv, Dh), cfg).reshape(B, S, D)
-    attn = attn @ p["attn_out"]["kernel"].astype(attn.dtype) + \
-        p["attn_out"]["bias"].astype(attn.dtype)
+    attn = _dense(attn, p["attn_out"])
     x = x + attn
 
-    h = _layernorm(x, p["ln2"]["scale"], p["ln2"]["bias"])
+    h = _norm(x, p["ln2"], cfg)
     gate = TopKGate(k=cfg.moe_k, capacity_factor=cfg.capacity_factor,
+                    eval_capacity_factor=(cfg.eval_capacity_factor
+                                          if cfg.eval_capacity_factor
+                                          is not None
+                                          else cfg.capacity_factor),
                     min_capacity=cfg.min_capacity,
                     noisy_gate_policy=cfg.noisy_gate_policy)
     y, l_aux, _counts = moe_layer_apply(
@@ -98,7 +120,9 @@ def forward(params: Dict, tokens: jnp.ndarray, cfg: MoEGPTConfig,
     B, S = tokens.shape
     dtype = cfg.dtype
     wte = params["wte"]["embedding"].astype(dtype)
-    x = wte[tokens] + params["wpe"]["embedding"].astype(dtype)[:S][None]
+    x = wte[tokens]
+    if cfg.use_wpe:
+        x = x + params["wpe"]["embedding"].astype(dtype)[:S][None]
     rng = rng if rng is not None else jax.random.PRNGKey(0)
 
     def body(carry, layer):
@@ -113,7 +137,7 @@ def forward(params: Dict, tokens: jnp.ndarray, cfg: MoEGPTConfig,
     (x, aux, _), _ = jax.lax.scan(
         body_fn, (x, jnp.zeros([], jnp.float32), rng), params["block"])
 
-    x = _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    x = _norm(x, params["ln_f"], cfg)
     if hidden_only:
         return x, aux / cfg.n_layers
     logits = x @ wte.T if cfg.tie_embeddings else \
@@ -145,9 +169,9 @@ def moe_gpt_partition_rules(tp: bool = False) -> list:
     over the data axes; attention follows the dense GPT TP rules."""
     model = "model" if tp else None
     rules = [
-        PartitionRule(r"block/moe/experts/(wi|wo)/kernel",
+        PartitionRule(r"block/moe/experts/(wi|wg|wo)/kernel",
                       P(None, ("data", "fsdp"), None, None)),
-        PartitionRule(r"block/moe/experts/(wi|wo)/bias",
+        PartitionRule(r"block/moe/experts/(wi|wg|wo)/bias",
                       P(None, ("data", "fsdp"), None)),
     ]
     if tp:

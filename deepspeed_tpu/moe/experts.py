@@ -23,10 +23,21 @@ def init_ffn_experts(rng, num_experts: int, d_model: int, d_ff: int) -> Dict:
 
 
 def ffn_expert_fn(params: Dict, tokens: jnp.ndarray) -> jnp.ndarray:
-    """tokens: [E, T, d] -> [E, T, d]; one fused einsum per projection."""
+    """tokens: [E, T, d] -> [E, T, d]; one fused einsum per projection.
+
+    Two expert dialects, keyed by the params tree: the GPT-2 style
+    (gelu, biased wi/wo) and the llama/mixtral style (a "wg" gate stack
+    present -> silu(t@wg) * (t@wi) @ wo, biases optional)."""
     dtype = tokens.dtype
-    h = jnp.einsum("etd,edf->etf", tokens, params["wi"]["kernel"].astype(dtype))
-    h = h + params["wi"]["bias"].astype(dtype)[:, None, :]
-    h = jax.nn.gelu(h, approximate=True)
-    y = jnp.einsum("etf,efd->etd", h, params["wo"]["kernel"].astype(dtype))
-    return y + params["wo"]["bias"].astype(dtype)[:, None, :]
+
+    def dense(t, p):
+        y = jnp.einsum("etd,edf->etf", t, p["kernel"].astype(dtype))
+        b = p.get("bias")
+        return y if b is None else y + b.astype(dtype)[:, None, :]
+
+    h = dense(tokens, params["wi"])
+    if "wg" in params:
+        h = jax.nn.silu(dense(tokens, params["wg"])) * h
+    else:
+        h = jax.nn.gelu(h, approximate=True)
+    return dense(h, params["wo"])

@@ -227,3 +227,34 @@ def test_hf_llama_injection(devices):
             torch.tensor(tokens.astype(np.int64)), max_new_tokens=4,
             do_sample=False, eos_token_id=None).numpy()
     np.testing.assert_array_equal(gen, ref)
+
+
+def test_hf_mixtral_injection(devices):
+    """HF Mixtral (llama attention + top-2 sparse MoE) through the
+    policy reproduces HF logits: the renormalized top-2 softmax equals
+    Mixtral's softmax-over-top-k router weights, and the swiglu expert
+    stacks map w1/w3/w2 -> wg/wi/wo."""
+    transformers = pytest.importorskip("transformers")
+    import torch
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=96, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64,
+        num_local_experts=4, num_experts_per_tok=2,
+        rms_norm_eps=1e-6, sliding_window=None)
+    torch.manual_seed(0)
+    hf_model = transformers.MixtralForCausalLM(hf_cfg).eval()
+    # random-init router logits are near-uniform -> expert choice flips
+    # on fp rounding between frameworks; sharpen the router so the test
+    # exercises the weight mapping, not tie-breaking
+    with torch.no_grad():
+        for lyr in hf_model.model.layers:
+            lyr.block_sparse_moe.gate.weight *= 40.0
+
+    eng = deepspeed_tpu.init_inference(model=hf_model, dtype=jnp.float32)
+    assert eng.cfg.num_experts == 4 and eng.cfg.moe_k == 2
+    tokens = np.random.default_rng(0).integers(0, 96, (2, 9)).astype(np.int32)
+    ours = np.asarray(eng.forward(tokens))
+    with torch.no_grad():
+        theirs = hf_model(torch.tensor(tokens.astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-3)

@@ -200,3 +200,32 @@ def test_moe_gpt_with_sequence_parallel(devices):
         l_ref = float(e_ref.train_batch(data)["loss"])
         np.testing.assert_allclose(l_sp, l_ref, rtol=1e-4)
     assert np.isfinite(l_sp)
+
+
+def test_moe_swiglu_expert_dialect(devices):
+    """MoEGPTConfig with the llama dialect: swiglu expert stacks (wg
+    present, biases dropped) train and decrease the loss; num_params
+    stays exact."""
+    from deepspeed_tpu.models import moe_gpt
+    import deepspeed_tpu
+    cfg = moe_gpt.MoEGPTConfig(
+        vocab_size=128, n_layers=2, n_heads=4, d_model=32, max_seq_len=32,
+        dtype=jnp.float32, remat=False, use_flash_attention=False,
+        num_experts=4, moe_k=2, capacity_factor=2.0,
+        norm="rmsnorm", activation="swiglu", use_bias=False,
+        use_wpe=False, rotary_dim=8, tie_embeddings=False)
+    params = moe_gpt.init_params(jax.random.PRNGKey(0), cfg)
+    ex = params["block"]["moe"]["experts"]
+    assert "wg" in ex and "bias" not in ex["wi"]
+    actual = sum(x.size for x in jax.tree.leaves(params))
+    assert actual == moe_gpt.num_params(cfg), (actual,
+                                               moe_gpt.num_params(cfg))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=moe_gpt.make_loss_fn(cfg), model_parameters=params,
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "adamw", "params": {"lr": 3e-3}},
+                "steps_per_print": 1000})
+    toks = np.random.default_rng(0).integers(0, 128, (8, 33)).astype(np.int32)
+    losses = [float(engine.train_batch({"tokens": toks})["loss"])
+              for _ in range(8)]
+    assert losses[-1] < losses[0] - 0.2, losses
