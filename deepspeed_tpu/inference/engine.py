@@ -112,7 +112,13 @@ def _ffn(h, p, cfg):
     logits = h.reshape(-1, D).astype(jnp.float32) @ p["moe"]["gate"]["wg"]
     probs = jax.nn.softmax(logits, axis=-1)               # [T, E]
     top_p, top_i = jax.lax.top_k(probs, k)
-    w = top_p / jnp.clip(top_p.sum(-1, keepdims=True), 1e-9)
+    # weight convention MUST match training's gating: GShard top-1
+    # weighs by the RAW softmax prob (sharded_moe.top1gating); top-2
+    # renormalizes among the selected pair (== Mixtral's
+    # softmax-over-top-k). Renormalizing at k=1 would force 1.0 and
+    # serve different logits than the model trained with.
+    w = (top_p if k == 1
+         else top_p / jnp.clip(top_p.sum(-1, keepdims=True), 1e-9))
     w_full = jnp.sum(jax.nn.one_hot(top_i, E) * w[..., None], axis=-2)
     outs = ffn_expert_fn(ex, jnp.broadcast_to(
         h.reshape(1, -1, D), (E, B * S, D)))              # [E, T, D]

@@ -540,6 +540,30 @@ def _hf_llama_readers(sd, L, Dh):
     return pre, lin, vec
 
 
+def _hf_llama_attn_params(sd, pre, lin, vec, cfg):
+    """The llama-layout pieces shared by the llama and mixtral policies:
+    fused qkv (with the rotary channel permutation), attention output,
+    norms, embeddings and head. The caller adds its FFN (dense swiglu or
+    sparse MoE) under block."""
+    import jax.numpy as jnp
+    qkv = jnp.concatenate(
+        [lin("layers.{}.self_attn.q_proj.weight", cfg.n_heads),
+         lin("layers.{}.self_attn.k_proj.weight", cfg.kv_heads),
+         lin("layers.{}.self_attn.v_proj.weight")], axis=-1)
+    block = {
+        "ln1": {"scale": vec("layers.{}.input_layernorm.weight")},
+        "qkv": {"kernel": qkv},
+        "attn_out": {"kernel": lin("layers.{}.self_attn.o_proj.weight")},
+        "ln2": {"scale": vec("layers.{}.post_attention_layernorm.weight")},
+    }
+    top = {
+        "wte": {"embedding": jnp.asarray(sd[pre + "embed_tokens.weight"])},
+        "ln_f": {"scale": jnp.asarray(sd[pre + "norm.weight"])},
+        "lm_head": {"kernel": jnp.asarray(sd["lm_head.weight"].T)},
+    }
+    return block, top
+
+
 @register_policy("hf_llama")
 class HFLlamaPolicy:
     """HuggingFace llama-family decoder (Llama/Mistral layout) -> native
@@ -584,27 +608,13 @@ class HFLlamaPolicy:
               for k, v in model.state_dict().items()}
         L = cfg.n_layers
         pre, lin, vec = _hf_llama_readers(sd, L, Dh)
-
-        qkv = jnp.concatenate(
-            [lin("layers.{}.self_attn.q_proj.weight", cfg.n_heads),
-             lin("layers.{}.self_attn.k_proj.weight", cfg.kv_heads),
-             lin("layers.{}.self_attn.v_proj.weight")], axis=-1)
-        params = {
-            "wte": {"embedding": jnp.asarray(sd[pre + "embed_tokens.weight"])},
-            "block": {
-                "ln1": {"scale": vec("layers.{}.input_layernorm.weight")},
-                "qkv": {"kernel": qkv},
-                "attn_out": {
-                    "kernel": lin("layers.{}.self_attn.o_proj.weight")},
-                "ln2": {"scale": vec(
-                    "layers.{}.post_attention_layernorm.weight")},
-                "mlp_gate": {"kernel": lin("layers.{}.mlp.gate_proj.weight")},
-                "mlp_in": {"kernel": lin("layers.{}.mlp.up_proj.weight")},
-                "mlp_out": {"kernel": lin("layers.{}.mlp.down_proj.weight")},
-            },
-            "ln_f": {"scale": jnp.asarray(sd[pre + "norm.weight"])},
-            "lm_head": {"kernel": jnp.asarray(sd["lm_head.weight"].T)},
-        }
+        block, top = _hf_llama_attn_params(sd, pre, lin, vec, cfg)
+        block.update({
+            "mlp_gate": {"kernel": lin("layers.{}.mlp.gate_proj.weight")},
+            "mlp_in": {"kernel": lin("layers.{}.mlp.up_proj.weight")},
+            "mlp_out": {"kernel": lin("layers.{}.mlp.down_proj.weight")},
+        })
+        params = {"block": block, **top}
         logger.info(f"injected HF llama: {cfg.n_layers}L/{cfg.d_model}d "
                     f"kv_heads={cfg.kv_heads} theta={cfg.rope_theta}")
         return cfg, params
@@ -666,32 +676,16 @@ class HFMixtralPolicy:
                                     f"experts.{e}.{w_name}.weight"].T
                            for e in range(E)]) for i in range(L)]))
 
-        qkv = jnp.concatenate(
-            [lin("layers.{}.self_attn.q_proj.weight", cfg.n_heads),
-             lin("layers.{}.self_attn.k_proj.weight", cfg.kv_heads),
-             lin("layers.{}.self_attn.v_proj.weight")], axis=-1)
-        params = {
-            "wte": {"embedding": jnp.asarray(sd[pre + "embed_tokens.weight"])},
-            "block": {
-                "ln1": {"scale": vec("layers.{}.input_layernorm.weight")},
-                "qkv": {"kernel": qkv},
-                "attn_out": {
-                    "kernel": lin("layers.{}.self_attn.o_proj.weight")},
-                "ln2": {"scale": vec(
-                    "layers.{}.post_attention_layernorm.weight")},
-                "moe": {
-                    "gate": {"wg": lin(
-                        "layers.{}.block_sparse_moe.gate.weight")},
-                    "experts": {
-                        "wi": {"kernel": experts("w3")},   # up
-                        "wg": {"kernel": experts("w1")},   # gate
-                        "wo": {"kernel": experts("w2")},   # down
-                    },
-                },
+        block, top = _hf_llama_attn_params(sd, pre, lin, vec, cfg)
+        block["moe"] = {
+            "gate": {"wg": lin("layers.{}.block_sparse_moe.gate.weight")},
+            "experts": {
+                "wi": {"kernel": experts("w3")},   # up
+                "wg": {"kernel": experts("w1")},   # gate
+                "wo": {"kernel": experts("w2")},   # down
             },
-            "ln_f": {"scale": jnp.asarray(sd[pre + "norm.weight"])},
-            "lm_head": {"kernel": jnp.asarray(sd["lm_head.weight"].T)},
         }
+        params = {"block": block, **top}
         logger.info(f"injected HF Mixtral: {cfg.n_layers}L/{cfg.d_model}d "
                     f"E={E} k={cfg.moe_k}")
         return cfg, params

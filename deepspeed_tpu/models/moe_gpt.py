@@ -93,8 +93,14 @@ def _moe_block(x, layer_params, cfg: MoEGPTConfig, rng, train: bool):
     h = _norm(x, p["ln1"], cfg)
     qkv = _dense(h, p["qkv"])
     q, k, v = jnp.split(qkv, [H * Dh, (H + Hkv) * Dh], axis=-1)
-    attn = _attention(q.reshape(B, S, H, Dh), k.reshape(B, S, Hkv, Dh),
-                      v.reshape(B, S, Hkv, Dh), cfg).reshape(B, S, D)
+    q = q.reshape(B, S, H, Dh)
+    k = k.reshape(B, S, Hkv, Dh)
+    if cfg.rotary_dim:
+        from deepspeed_tpu.ops.attention.rotary import apply_rotary
+        q, k = apply_rotary(q, k, jnp.arange(S), cfg.rotary_dim,
+                            base=cfg.rope_theta)
+    attn = _attention(q, k, v.reshape(B, S, Hkv, Dh),
+                      cfg).reshape(B, S, D)
     attn = _dense(attn, p["attn_out"])
     x = x + attn
 

@@ -229,3 +229,28 @@ def test_moe_swiglu_expert_dialect(devices):
     losses = [float(engine.train_batch({"tokens": toks})["loss"])
               for _ in range(8)]
     assert losses[-1] < losses[0] - 0.2, losses
+
+
+def test_moe_inference_matches_training_eval_forward(devices):
+    """The inference engine's dense no-drop MoE mix must serve the SAME
+    logits as the training model's eval forward — incl. the top-1 raw-
+    probability weighting convention (GShard top1gating weighs by p1,
+    NOT a renormalized 1.0)."""
+    import dataclasses
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.models import moe_gpt
+    cfg = moe_gpt.MoEGPTConfig(
+        vocab_size=128, n_layers=2, n_heads=4, d_model=32, max_seq_len=32,
+        dtype=jnp.float32, remat=False, use_flash_attention=False,
+        num_experts=4, moe_k=1)
+    params = moe_gpt.init_params(jax.random.PRNGKey(3), cfg)
+    toks = np.random.default_rng(4).integers(0, 128, (2, 10)).astype(np.int32)
+    # no-drop eval reference from the training stack
+    cfg_eval = dataclasses.replace(
+        cfg, eval_capacity_factor=2.0 * cfg.num_experts)
+    ref, _aux = moe_gpt.forward(params, jnp.asarray(toks), cfg_eval,
+                                train=False)
+    eng = InferenceEngine(config=cfg, params=params, dtype=jnp.float32)
+    got = eng.forward(toks)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
