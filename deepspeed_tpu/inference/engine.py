@@ -68,19 +68,9 @@ def _block_prefill(x, p, cfg: GPTConfig, kv_mask=None, positions=None):
     kv_mask: [B, S] prompt validity (left-padded batched prompts);
     positions: optional [B, S] per-row rotary positions."""
     B, S, D = x.shape
-    H, Dh = cfg.n_heads, cfg.head_dim
-    Hkv = cfg.kv_heads
     h = _norm(x, p["ln1"], cfg)
     qkv = _dense(h, p["qkv"])
-    q, k, v = jnp.split(qkv, [H * Dh, (H + Hkv) * Dh], axis=-1)
-    q = _split_heads(q, B, S, H, Dh)
-    k = _split_heads(k, B, S, Hkv, Dh)
-    v = _split_heads(v, B, S, Hkv, Dh)
-    if cfg.rotary_dim:
-        from deepspeed_tpu.ops.attention.rotary import apply_rotary
-        q, k = apply_rotary(
-            q, k, positions if positions is not None else jnp.arange(S),
-            cfg.rotary_dim, base=cfg.rope_theta)
+    q, k, v = gpt_lib._qkv_split_rotary(qkv, cfg, positions, B, S)
     attn = gpt_lib._attention(q, k, v, cfg, kv_mask=kv_mask).reshape(B, S, D)
     attn = _dense(attn, p["attn_out"])
     if cfg.parallel_residual:
@@ -112,12 +102,12 @@ def _ffn(h, p, cfg):
     logits = h.reshape(-1, D).astype(jnp.float32) @ p["moe"]["gate"]["wg"]
     probs = jax.nn.softmax(logits, axis=-1)               # [T, E]
     top_p, top_i = jax.lax.top_k(probs, k)
-    # weight convention MUST match training's gating: GShard top-1
-    # weighs by the RAW softmax prob (sharded_moe.top1gating); top-2
-    # renormalizes among the selected pair (== Mixtral's
-    # softmax-over-top-k). Renormalizing at k=1 would force 1.0 and
-    # serve different logits than the model trained with.
-    w = (top_p if k == 1
+    # weight convention MUST match what the checkpoint trained with
+    # (cfg.gate_weighting): GShard top-1 weighs by the RAW softmax prob
+    # (sharded_moe.top1gating) while Mixtral's softmax-over-top-k
+    # renormalizes (1.0 at k=1); the two agree at k=2
+    gshard = getattr(cfg, "gate_weighting", "gshard") == "gshard"
+    w = (top_p if (k == 1 and gshard)
          else top_p / jnp.clip(top_p.sum(-1, keepdims=True), 1e-9))
     w_full = jnp.sum(jax.nn.one_hot(top_i, E) * w[..., None], axis=-2)
     outs = ffn_expert_fn(ex, jnp.broadcast_to(

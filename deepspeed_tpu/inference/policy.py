@@ -663,7 +663,11 @@ class HFMixtralPolicy:
             tie_embeddings=False, rotary_dim=Dh,
             rope_theta=getattr(hf, "rope_theta", 10000.0),
             attn_window=getattr(hf, "sliding_window", None),
-            num_experts=E, moe_k=hf.num_experts_per_tok)
+            num_experts=E, moe_k=hf.num_experts_per_tok,
+            # Mixtral semantics: softmax over the selected top-k (1.0 at
+            # k=1), and validation must never drop a token
+            gate_weighting="topk_softmax",
+            eval_capacity_factor=2.0 * E)
         sd = {k: v.detach().cpu().numpy()
               for k, v in model.state_dict().items()}
         L = cfg.n_layers

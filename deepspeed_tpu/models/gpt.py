@@ -286,6 +286,24 @@ def _dense(h, p):
     return y if b is None else y + b.astype(h.dtype)
 
 
+def _qkv_split_rotary(qkv, cfg, positions, B, S):
+    """Split a fused qkv projection into per-head q/k/v and apply rotary
+    — the ONE copy of the attention prologue shared by the dense block,
+    the MoE block, and inference prefill (divergent copies previously
+    left rotary dead in the MoE block)."""
+    H, Dh, Hkv = cfg.n_heads, cfg.head_dim, cfg.kv_heads
+    q, k, v = jnp.split(qkv, [H * Dh, (H + Hkv) * Dh], axis=-1)
+    q = q.reshape(B, S, H, Dh)
+    k = k.reshape(B, S, Hkv, Dh)
+    v = v.reshape(B, S, Hkv, Dh)
+    if cfg.rotary_dim:
+        from deepspeed_tpu.ops.attention.rotary import apply_rotary
+        q, k = apply_rotary(
+            q, k, positions if positions is not None else jnp.arange(S),
+            cfg.rotary_dim, base=cfg.rope_theta)
+    return q, k, v
+
+
 def _layernorm(x, scale, bias, eps=1e-5):
     x32 = x.astype(jnp.float32)
     mu = jnp.mean(x32, axis=-1, keepdims=True)
@@ -408,17 +426,7 @@ def _block(x, layer_params, cfg: GPTConfig, dropout_rng=None,
     h = _norm(x, p["ln1"], cfg)
     qkv = _dense(h, p["qkv"])
     qkv = checkpoint_name(qkv, "qkv")
-    Hkv = cfg.kv_heads
-    q, k, v = jnp.split(
-        qkv, [H * Dh, (H + Hkv) * Dh], axis=-1)
-    q = q.reshape(B, S, H, Dh)
-    k = k.reshape(B, S, Hkv, Dh)
-    v = v.reshape(B, S, Hkv, Dh)
-    if cfg.rotary_dim:
-        from deepspeed_tpu.ops.attention.rotary import apply_rotary
-        q, k = apply_rotary(
-            q, k, positions if positions is not None else jnp.arange(S),
-            cfg.rotary_dim, base=cfg.rope_theta)
+    q, k, v = _qkv_split_rotary(qkv, cfg, positions, B, S)
     attn = _attention(q, k, v, cfg, segment_ids=segment_ids).reshape(B, S, D)
     attn = checkpoint_name(attn, "attn")
     attn = _dense(attn, p["attn_out"])

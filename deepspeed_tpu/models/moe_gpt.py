@@ -19,7 +19,8 @@ from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.models import gpt as gpt_lib
 from deepspeed_tpu.models.gpt import (GPTConfig, _attention,
-                                      _dense, _norm)
+                                      _dense, _norm,
+                                      _qkv_split_rotary)
 from deepspeed_tpu.moe.experts import ffn_expert_fn
 from deepspeed_tpu.moe.layer import MoEConfig
 from deepspeed_tpu.moe.sharded_moe import TopKGate, moe_layer_apply
@@ -36,6 +37,11 @@ class MoEGPTConfig(GPTConfig):
     # dropped tokens in validation (same defect class as the inference
     # _ffn bug caught by the Mixtral parity test)
     eval_capacity_factor: Optional[float] = None
+    # combine-weight convention: "gshard" (top-1 weighs by the raw
+    # softmax prob — the reference's top1gating) or "topk_softmax"
+    # (softmax over the selected k, i.e. 1.0 at k=1 — Mixtral). The two
+    # agree at k=2. Serving must match what the checkpoint trained with.
+    gate_weighting: str = "gshard"
     min_capacity: int = 4
     aux_loss_weight: float = 0.01
     noisy_gate_policy: Optional[str] = None
@@ -83,24 +89,17 @@ def num_params(cfg: MoEGPTConfig) -> int:
     return gpt_lib.num_params(cfg) + L * (moe_mlp - dense_mlp)
 
 
-def _moe_block(x, layer_params, cfg: MoEGPTConfig, rng, train: bool):
-    """One transformer block with MoE FFN. x: [B, S, D]."""
+def _moe_block(x, layer_params, cfg: MoEGPTConfig, rng, train: bool,
+               positions=None):
+    """One transformer block with MoE FFN. x: [B, S, D]. positions:
+    optional [B, S] per-row rotary positions (packed batches)."""
     B, S, D = x.shape
-    H, Dh = cfg.n_heads, cfg.head_dim
     p = layer_params
 
-    Hkv = cfg.kv_heads
     h = _norm(x, p["ln1"], cfg)
     qkv = _dense(h, p["qkv"])
-    q, k, v = jnp.split(qkv, [H * Dh, (H + Hkv) * Dh], axis=-1)
-    q = q.reshape(B, S, H, Dh)
-    k = k.reshape(B, S, Hkv, Dh)
-    if cfg.rotary_dim:
-        from deepspeed_tpu.ops.attention.rotary import apply_rotary
-        q, k = apply_rotary(q, k, jnp.arange(S), cfg.rotary_dim,
-                            base=cfg.rope_theta)
-    attn = _attention(q, k, v.reshape(B, S, Hkv, Dh),
-                      cfg).reshape(B, S, D)
+    q, k, v = _qkv_split_rotary(qkv, cfg, positions, B, S)
+    attn = _attention(q, k, v, cfg).reshape(B, S, D)
     attn = _dense(attn, p["attn_out"])
     x = x + attn
 
@@ -121,7 +120,9 @@ def _moe_block(x, layer_params, cfg: MoEGPTConfig, rng, train: bool):
 def forward(params: Dict, tokens: jnp.ndarray, cfg: MoEGPTConfig,
             rng: Optional[jax.Array] = None,
             train: bool = True,
-            hidden_only: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray]:
+            hidden_only: bool = False,
+            positions: Optional[jnp.ndarray] = None,
+            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """-> (logits [B,S,V] — or post-ln_f hidden states —, total_l_aux)."""
     B, S = tokens.shape
     dtype = cfg.dtype
@@ -134,7 +135,8 @@ def forward(params: Dict, tokens: jnp.ndarray, cfg: MoEGPTConfig,
     def body(carry, layer):
         x, aux, r = carry
         r, lr = jax.random.split(r)
-        y, l_aux = _moe_block(x, layer, cfg, lr, train)
+        y, l_aux = _moe_block(x, layer, cfg, lr, train,
+                              positions=positions)
         return (y, aux + l_aux, r), None
 
     body_fn = body
@@ -160,7 +162,11 @@ def loss_fn(params, batch, rng, cfg: MoEGPTConfig, train: bool = True):
     # _head_nll owns the CE math for both paths (dense log_softmax, or
     # the fused chunked CE when cfg.loss_chunk is set)
     from deepspeed_tpu.models.gpt import _head_nll
-    x, l_aux = forward(params, tokens, cfg, rng, train, hidden_only=True)
+    poss = batch.get("positions")
+    if poss is not None and batch.get("targets") is None:
+        poss = poss[:, :-1]
+    x, l_aux = forward(params, tokens, cfg, rng, train, hidden_only=True,
+                       positions=poss)
     return _head_nll(params, x, targets, cfg) + cfg.aux_loss_weight * l_aux
 
 
