@@ -667,7 +667,7 @@ class HFMixtralPolicy:
             # Mixtral semantics: softmax over the selected top-k (1.0 at
             # k=1), and validation must never drop a token
             gate_weighting="topk_softmax",
-            eval_capacity_factor=2.0 * E)
+            eval_capacity_factor=float(E))
         sd = {k: v.detach().cpu().numpy()
               for k, v in model.state_dict().items()}
         L = cfg.n_layers

@@ -279,9 +279,19 @@ def _norm(x, p, cfg):
     return _layernorm(x, p["scale"], p["bias"], eps=eps)
 
 
+def _kernel_of(p, dtype):
+    """The (possibly int8-quantized) weight of a dense entry, in compute
+    dtype. Weight-only int8 entries carry {"q": int8, "scale": fp32
+    per-output-channel} instead of {"kernel"} (inference/engine.py
+    quantize_weights_int8); dequantization fuses into the matmul."""
+    if "q" in p:
+        return p["q"].astype(dtype) * p["scale"].astype(dtype)
+    return p["kernel"].astype(dtype)
+
+
 def _dense(h, p):
     """h @ kernel (+ bias when the config kept biases)."""
-    y = h @ p["kernel"].astype(h.dtype)
+    y = h @ _kernel_of(p, h.dtype)
     b = p.get("bias")
     return y if b is None else y + b.astype(h.dtype)
 
@@ -571,14 +581,16 @@ def forward(params: Dict, tokens: jnp.ndarray, cfg: GPTConfig,
 
 
 def _head_nll(other: Dict, y: jnp.ndarray, targets: jnp.ndarray,
-              cfg: GPTConfig) -> jnp.ndarray:
+              cfg: GPTConfig, loss_mask=None) -> jnp.ndarray:
     """Mean next-token NLL from post-ln_f hidden states (pipeline / layered
-    heads). Honors cfg.loss_chunk (fused chunked CE, ops/cross_entropy.py)."""
+    heads). Honors cfg.loss_chunk (fused chunked CE, ops/cross_entropy.py)
+    and an optional [.., S] loss mask (packed batches)."""
     w, b = _vocab_proj(other, cfg)
     if cfg.loss_chunk:
         from deepspeed_tpu.ops.cross_entropy import chunked_softmax_xent
         return chunked_softmax_xent(y, w, targets, bias=b,
-                                    chunk=cfg.loss_chunk)
+                                    chunk=cfg.loss_chunk,
+                                    loss_mask=loss_mask)
     logits = jax.lax.dot_general(
         y, w, (((y.ndim - 1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)
@@ -586,6 +598,8 @@ def _head_nll(other: Dict, y: jnp.ndarray, targets: jnp.ndarray,
         logits = logits + b.astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
+    if loss_mask is not None:
+        return -(ll * loss_mask).sum() / jnp.maximum(loss_mask.sum(), 1.0)
     return -ll.mean()
 
 

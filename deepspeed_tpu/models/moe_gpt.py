@@ -90,16 +90,18 @@ def num_params(cfg: MoEGPTConfig) -> int:
 
 
 def _moe_block(x, layer_params, cfg: MoEGPTConfig, rng, train: bool,
-               positions=None):
-    """One transformer block with MoE FFN. x: [B, S, D]. positions:
-    optional [B, S] per-row rotary positions (packed batches)."""
+               positions=None, segment_ids=None):
+    """One transformer block with MoE FFN. x: [B, S, D]. positions /
+    segment_ids: optional [B, S] packed-batch metadata (rotary restarts
+    + block-diagonal attention per document)."""
     B, S, D = x.shape
     p = layer_params
 
     h = _norm(x, p["ln1"], cfg)
     qkv = _dense(h, p["qkv"])
     q, k, v = _qkv_split_rotary(qkv, cfg, positions, B, S)
-    attn = _attention(q, k, v, cfg).reshape(B, S, D)
+    attn = _attention(q, k, v, cfg,
+                      segment_ids=segment_ids).reshape(B, S, D)
     attn = _dense(attn, p["attn_out"])
     x = x + attn
 
@@ -122,6 +124,7 @@ def forward(params: Dict, tokens: jnp.ndarray, cfg: MoEGPTConfig,
             train: bool = True,
             hidden_only: bool = False,
             positions: Optional[jnp.ndarray] = None,
+            segment_ids: Optional[jnp.ndarray] = None,
             ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """-> (logits [B,S,V] — or post-ln_f hidden states —, total_l_aux)."""
     B, S = tokens.shape
@@ -136,7 +139,8 @@ def forward(params: Dict, tokens: jnp.ndarray, cfg: MoEGPTConfig,
         x, aux, r = carry
         r, lr = jax.random.split(r)
         y, l_aux = _moe_block(x, layer, cfg, lr, train,
-                              positions=positions)
+                              positions=positions,
+                              segment_ids=segment_ids)
         return (y, aux + l_aux, r), None
 
     body_fn = body
@@ -162,12 +166,17 @@ def loss_fn(params, batch, rng, cfg: MoEGPTConfig, train: bool = True):
     # _head_nll owns the CE math for both paths (dense log_softmax, or
     # the fused chunked CE when cfg.loss_chunk is set)
     from deepspeed_tpu.models.gpt import _head_nll
+    implicit = batch.get("targets") is None
     poss = batch.get("positions")
-    if poss is not None and batch.get("targets") is None:
-        poss = poss[:, :-1]
+    segs = batch.get("segment_ids")
+    mask = batch.get("loss_mask")
+    if implicit:
+        poss = None if poss is None else poss[:, :-1]
+        segs = None if segs is None else segs[:, :-1]
     x, l_aux = forward(params, tokens, cfg, rng, train, hidden_only=True,
-                       positions=poss)
-    return _head_nll(params, x, targets, cfg) + cfg.aux_loss_weight * l_aux
+                       positions=poss, segment_ids=segs)
+    return (_head_nll(params, x, targets, cfg, loss_mask=mask)
+            + cfg.aux_loss_weight * l_aux)
 
 
 def make_loss_fn(cfg: MoEGPTConfig):

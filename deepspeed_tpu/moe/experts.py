@@ -31,7 +31,8 @@ def ffn_expert_fn(params: Dict, tokens: jnp.ndarray) -> jnp.ndarray:
     dtype = tokens.dtype
 
     def dense(t, p):
-        y = jnp.einsum("etd,edf->etf", t, p["kernel"].astype(dtype))
+        from deepspeed_tpu.models.gpt import _kernel_of
+        y = jnp.einsum("etd,edf->etf", t, _kernel_of(p, dtype))
         b = p.get("bias")
         return y if b is None else y + b.astype(dtype)[:, None, :]
 
