@@ -1,0 +1,202 @@
+"""Speculative decoding: a small draft model proposes, the target
+verifies a whole chunk in ONE step.
+
+Beyond the reference's inference stack (its generation is one forward
+per token, ref: deepspeed/inference/engine.py:355); on TPU the economics
+are ideal: the target's chunk-verify step is a [gamma+1]-token matmul —
+MXU-friendly where single-token decode is HBM-bound — so accepted
+tokens cost ~1/(accepted+1) target steps.
+
+Greedy contract (temperature=0): the emitted sequence is EXACTLY what
+target.generate would emit alone — speculation changes latency, never
+output. (Lossless sampled acceptance — the Leviathan et al. rejection
+scheme — would need per-position target/draft prob bookkeeping; the
+greedy path is what this module ships.)
+
+The chunk-verify step is `_extend_fn`: the decode block generalized
+from 1 to G query tokens — queries attend the cache plus the causal
+prefix of their own chunk. Cache slots past a partial acceptance hold
+stale K/V, which is safe by construction: the next round REWRITES those
+positions before any query reads them (position-addressed writes happen
+before attention in the same step).
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.models.gpt import _dense, _norm, _qkv_split_rotary
+
+
+def _block_extend(x, k_cache, v_cache, pos, p, cfg):
+    """Decode block for G new tokens at cache positions [pos, pos+G).
+    x: [B, G, D]; caches [B, S_max, Hkv, Dh]. Causality: query i sees
+    cache slots <= pos + i (its own prefix included)."""
+    B, G, D = x.shape
+    H, Dh = cfg.n_heads, cfg.head_dim
+    Hkv = cfg.kv_heads
+    group = H // Hkv
+    S_max = k_cache.shape[1]
+
+    h = _norm(x, p["ln1"], cfg)
+    qkv = _dense(h, p["qkv"])
+    q, k, v = _qkv_split_rotary(qkv, cfg, pos + jnp.arange(G), B, G)
+    k_cache = jax.lax.dynamic_update_slice_in_dim(k_cache, k, pos, axis=1)
+    v_cache = jax.lax.dynamic_update_slice_in_dim(v_cache, v, pos, axis=1)
+
+    qg = q.reshape(B, G, Hkv, group, Dh)
+    scores = jnp.einsum("bqkgd,bskd->bkgqs", qg,
+                        k_cache).astype(jnp.float32)
+    scores *= cfg.attn_scale if cfg.attn_scale is not None \
+        else 1.0 / np.sqrt(Dh)
+    idx = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, 1, S_max), 4)
+    qi = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, G, 1), 3)
+    scores = jnp.where(idx <= pos + qi, scores, -1e30)
+    if cfg.attn_window is not None:
+        scores = jnp.where(idx > pos + qi - cfg.attn_window, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    attn = jnp.einsum("bkgqs,bskd->bqkgd", probs, v_cache)
+    attn = attn.reshape(B, G, D)
+    attn = _dense(attn, p["attn_out"])
+    if cfg.parallel_residual:
+        from deepspeed_tpu.inference.engine import _ffn
+        return x + attn + _ffn(h, p, cfg), k_cache, v_cache
+    x = x + attn
+    h = _norm(x, p["ln2"], cfg)
+    from deepspeed_tpu.inference.engine import _ffn
+    return x + _ffn(h, p, cfg), k_cache, v_cache
+
+
+def _extend_jit(engine):
+    """The engine-cached compiled verify step (one per engine; jit
+    retraces per distinct chunk width and caches across calls). The
+    cache argument is donated, matching the engine's own decode step —
+    a fresh jit per generate call would recompile the whole model every
+    request and double peak cache HBM."""
+    fn = getattr(engine, "_spec_extend", None)
+    if fn is None:
+        fn = jax.jit(partial(_extend_fn, engine), donate_argnums=(1,))
+        engine._spec_extend = fn
+    return fn
+
+
+def _extend_fn(engine, params, cache, tokens, pos):
+    """G-token target verify step: logits [B, G, V] + updated cache.
+    tokens: [B, G]; pos: scalar first cache index of the chunk."""
+    cfg = engine.cfg
+    G = tokens.shape[1]
+    x = params["wte"]["embedding"][tokens]
+    if cfg.use_wpe:
+        x = x + jax.lax.dynamic_slice_in_dim(
+            params["wpe"]["embedding"], pos, G)[None]
+
+    def body(x, layer):
+        layer_p, kc, vc = layer
+        y, kc, vc = _block_extend(x, kc, vc, pos, layer_p, cfg)
+        return y, (kc, vc)
+
+    x, (ks, vs) = jax.lax.scan(body, x,
+                               (params["block"], cache["k"], cache["v"]))
+    logits = engine._logits(params, x)
+    return logits, {"k": ks, "v": vs}
+
+
+def generate_speculative(target, draft, tokens, max_new_tokens: int = 32,
+                         gamma: int = 4,
+                         return_stats: bool = False):
+    """Greedy speculative generation (see module docstring).
+
+    target/draft: InferenceEngine instances over the SAME vocabulary
+    (the draft is typically a much smaller model). tokens: [B, S] int32
+    prompt (no padding mask support in this path). Returns [B, S+N]
+    tokens — exactly target.generate(..., temperature=0)'s output —
+    plus an acceptance-stats dict when return_stats is set.
+    """
+    assert target.cfg.vocab_size == draft.cfg.vocab_size, \
+        "speculative decoding needs a shared vocabulary"
+    tokens = np.asarray(tokens, np.int32)
+    B, S = tokens.shape
+    assert S + max_new_tokens + gamma + 1 <= min(target.max_seq_len,
+                                                 draft.max_seq_len), \
+        "prompt + new tokens (+ a gamma-sized verify margin) must fit " \
+        "both engines' caches"
+
+    t_logits, t_cache = target._prefill(target.params, jnp.asarray(tokens))
+    d_logits, d_cache = draft._prefill(draft.params, jnp.asarray(tokens))
+    extend_t = _extend_jit(target)
+
+    out = [tokens]
+    # first target token comes straight from the prefill logits
+    cur = np.asarray(jnp.argmax(t_logits[:, -1].astype(jnp.float32), -1))
+    n_emitted = 1
+    n_rounds = 0
+    n_accepted_total = 0
+    pos = S                       # next unwritten cache index, both caches
+
+    while n_emitted <= max_new_tokens:
+        g = int(min(gamma, max_new_tokens - n_emitted + 1))
+        if g == 0:
+            break
+        # ---- draft proposes g tokens autoregressively (the engine's
+        # own compiled, cache-donating decode step) ----
+        proposal = np.zeros((B, g), np.int32)
+        d_tok = cur
+        for i in range(g):
+            dl, d_cache = draft._decode(draft.params, d_cache,
+                                        jnp.asarray(d_tok[:, None]),
+                                        jnp.asarray(pos + i, jnp.int32))
+            d_tok = np.asarray(jnp.argmax(dl[:, -1].astype(jnp.float32),
+                                          -1))
+            proposal[:, i] = d_tok
+        # ---- target verifies [cur, d_1..d_g] — g+1 tokens, ONE step;
+        # a fully-agreeing round emits g+1 tokens (bonus included) ----
+        chunk = np.concatenate([cur[:, None], proposal], axis=1)
+        tl, t_cache = extend_t(target.params, t_cache, jnp.asarray(chunk),
+                               jnp.asarray(pos, jnp.int32))
+        greedy = np.asarray(jnp.argmax(tl.astype(jnp.float32), -1))
+        # greedy[:, j] = target's token AFTER chunk prefix of length
+        # j+1. accepted = #leading draft tokens agreeing with the
+        # target; the batch takes the row minimum so all rows stay in
+        # lockstep (a conservative, correct choice; per-row bookkeeping
+        # would need ragged caches)
+        agree = greedy[:, :-1] == proposal
+        # first disagreement per row (the appended False column makes
+        # argmin return g when a row accepted everything)
+        first_bad = np.argmin(
+            np.concatenate([agree, np.zeros((B, 1), bool)], axis=1),
+            axis=1)
+        n_acc = int(first_bad.min())
+        emit = [cur[:, None]]
+        for i in range(n_acc):
+            emit.append(proposal[:, i][:, None])
+        out.append(np.concatenate(emit, axis=1))
+        cur = greedy[:, n_acc]    # correction (or bonus) token
+        n_emitted += n_acc + 1
+        pos += n_acc + 1
+        n_rounds += 1
+        n_accepted_total += n_acc
+        if n_acc == g:
+            # fully-accepted round: the draft proposed d_g but never
+            # CONSUMED it, so its K/V slot (pos-1) would be a hole that
+            # poisons every later draft proposal — ingest it now
+            # (logits discarded; output correctness never depends on
+            # the draft, but acceptance rates do)
+            _, d_cache = draft._decode(
+                draft.params, d_cache, proposal[:, g - 1][:, None],
+                jnp.asarray(pos - 1, jnp.int32))
+        # rewind both caches logically: stale K/V beyond pos get
+        # rewritten before the next read (see module docstring); the
+        # DRAFT cache must also hold K/V for the accepted chunk — it
+        # does: the draft wrote positions pos-..; mismatched slots are
+        # overwritten next round
+    result = np.concatenate(out + [cur[:, None]], axis=1)
+    result = result[:, :S + max_new_tokens]
+    if return_stats:
+        return result, {"rounds": n_rounds,
+                        "accepted_per_round": (n_accepted_total /
+                                               max(1, n_rounds)),
+                        "target_steps": n_rounds + 1,
+                        "tokens": int(result.shape[1] - S)}
+    return result

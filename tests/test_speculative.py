@@ -1,0 +1,87 @@
+"""Speculative decoding: greedy output must EXACTLY match the target
+alone; a perfect draft accepts everything."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.engine import InferenceEngine
+from deepspeed_tpu.inference.speculative import generate_speculative
+from deepspeed_tpu.models import gpt
+
+
+def _engines(seed_t=0, seed_d=5):
+    cfg_t = gpt.GPTConfig(vocab_size=128, n_layers=4, n_heads=4,
+                          d_model=64, max_seq_len=64, dtype=jnp.float32,
+                          use_flash_attention=False, remat=False)
+    cfg_d = gpt.GPTConfig(vocab_size=128, n_layers=1, n_heads=2,
+                          d_model=32, max_seq_len=64, dtype=jnp.float32,
+                          use_flash_attention=False, remat=False)
+    target = InferenceEngine(
+        config=cfg_t, params=gpt.init_params(jax.random.PRNGKey(seed_t),
+                                             cfg_t), dtype=jnp.float32)
+    draft = InferenceEngine(
+        config=cfg_d, params=gpt.init_params(jax.random.PRNGKey(seed_d),
+                                             cfg_d), dtype=jnp.float32)
+    return target, draft
+
+
+def test_speculative_matches_target_greedy(devices):
+    target, draft = _engines()
+    toks = np.random.default_rng(0).integers(0, 128, (2, 7)).astype(np.int32)
+    ref = target.generate(toks, max_new_tokens=12, temperature=0.0)
+    for gamma in (1, 3, 5):
+        got, stats = generate_speculative(target, draft, toks,
+                                          max_new_tokens=12, gamma=gamma,
+                                          return_stats=True)
+        np.testing.assert_array_equal(got, ref,
+                                      err_msg=f'gamma={gamma}')
+        assert stats["tokens"] == 12
+
+
+def test_speculative_perfect_draft_accepts_everything(devices):
+    """Draft == target: every proposal must be accepted (gamma tokens
+    per verify step), so the loop takes ~N/gamma rounds."""
+    target, _ = _engines()
+    toks = np.random.default_rng(1).integers(0, 128, (1, 5)).astype(np.int32)
+    ref = target.generate(toks, max_new_tokens=12, temperature=0.0)
+    got, stats = generate_speculative(target, target, toks,
+                                      max_new_tokens=12, gamma=4,
+                                      return_stats=True)
+    np.testing.assert_array_equal(got, ref)
+    # 12 tokens in 3 rounds (4+4+2 accepted; the tail round is short):
+    # every proposal accepted, ~N/(gamma+1) target steps
+    assert stats["accepted_per_round"] >= 3.3, stats
+    assert stats["rounds"] <= 3, stats
+
+
+def test_speculative_rejects_vocab_mismatch(devices):
+    target, _ = _engines()
+    cfg_bad = gpt.GPTConfig(vocab_size=96, n_layers=1, n_heads=2,
+                            d_model=32, max_seq_len=64, dtype=jnp.float32,
+                            use_flash_attention=False, remat=False)
+    bad = InferenceEngine(config=cfg_bad,
+                          params=gpt.init_params(jax.random.PRNGKey(2),
+                                                 cfg_bad),
+                          dtype=jnp.float32)
+    with pytest.raises(AssertionError, match="vocabulary"):
+        generate_speculative(target, bad, np.zeros((1, 4), np.int32))
+
+
+def test_speculative_llama_dialect(devices):
+    """Draft/target in the llama dialect (rotary + GQA + rmsnorm)."""
+    cfg = gpt.preset("llama-tiny", dtype=jnp.float32,
+                     use_flash_attention=False, remat=False)
+    target = InferenceEngine(
+        config=cfg, params=gpt.init_params(jax.random.PRNGKey(0), cfg),
+        dtype=jnp.float32)
+    draft = InferenceEngine(
+        config=cfg, params=gpt.init_params(jax.random.PRNGKey(9), cfg),
+        dtype=jnp.float32)
+    toks = np.random.default_rng(3).integers(
+        0, cfg.vocab_size, (2, 6)).astype(np.int32)
+    ref = target.generate(toks, max_new_tokens=10, temperature=0.0)
+    got = generate_speculative(target, draft, toks, max_new_tokens=10,
+                               gamma=3)
+    np.testing.assert_array_equal(got, ref)
