@@ -9,9 +9,11 @@ tokens cost ~1/(accepted+1) target steps.
 
 Greedy contract (temperature=0): the emitted sequence is EXACTLY what
 target.generate would emit alone — speculation changes latency, never
-output. (Lossless sampled acceptance — the Leviathan et al. rejection
-scheme — would need per-position target/draft prob bookkeeping; the
-greedy path is what this module ships.)
+output. Sampled contract (temperature>0): Leviathan et al. rejection
+sampling — accept draft token x with min(1, p(x)/q(x)), resample
+rejections from norm(max(0, p-q)) — whose OUTPUT DISTRIBUTION equals
+sampling the target alone (verified against the exact two-step
+marginal in tests/test_speculative.py).
 
 The chunk-verify step is `_extend_fn`: the decode block generalized
 from 1 to G query tokens — queries attend the cache plus the causal
@@ -104,15 +106,24 @@ def _extend_fn(engine, params, cache, tokens, pos):
 
 
 def generate_speculative(target, draft, tokens, max_new_tokens: int = 32,
-                         gamma: int = 4,
+                         gamma: int = 4, temperature: float = 0.0,
+                         seed: int = 0,
                          return_stats: bool = False):
-    """Greedy speculative generation (see module docstring).
+    """Speculative generation (see module docstring).
 
     target/draft: InferenceEngine instances over the SAME vocabulary
     (the draft is typically a much smaller model). tokens: [B, S] int32
     prompt (no padding mask support in this path). Returns [B, S+N]
-    tokens — exactly target.generate(..., temperature=0)'s output —
-    plus an acceptance-stats dict when return_stats is set.
+    tokens, plus an acceptance-stats dict when return_stats is set.
+
+    temperature=0 (default): greedy — the output EXACTLY equals
+    target.generate(..., temperature=0). temperature>0: lossless
+    sampled speculation (Leviathan et al. rejection scheme) — draft
+    token x is accepted with prob min(1, p(x)/q(x)); a rejection
+    resamples from norm(max(0, p-q)); a full acceptance samples the
+    bonus from p. The OUTPUT DISTRIBUTION equals sampling the target
+    alone (the sample path differs from target.generate's rng stream,
+    so sequences aren't bitwise-comparable — the distribution is).
     """
     assert target.cfg.vocab_size == draft.cfg.vocab_size, \
         "speculative decoding needs a shared vocabulary"
@@ -122,6 +133,28 @@ def generate_speculative(target, draft, tokens, max_new_tokens: int = 32,
                                                  draft.max_seq_len), \
         "prompt + new tokens (+ a gamma-sized verify margin) must fit " \
         "both engines' caches"
+    sampled = temperature > 0.0
+    rng = np.random.default_rng(seed)
+
+    def dist(logits):
+        """[.., V] logits -> fp64 probabilities at `temperature`."""
+        z = np.asarray(logits, np.float64) / temperature
+        z -= z.max(-1, keepdims=True)
+        e = np.exp(z)
+        return e / e.sum(-1, keepdims=True)
+
+    V = target.cfg.vocab_size
+
+    def draw(p):
+        """Sample one token per row from [B, V] probabilities (clamped:
+        fp rounding can leave cumsum[-1] < 1 and u above it)."""
+        c = np.cumsum(p, axis=-1)
+        u = rng.random((p.shape[0], 1))
+        return np.minimum((u > c).sum(-1), V - 1).astype(np.int32)
+
+    def draw1(p):
+        """One sample from a [V] probability vector."""
+        return int(min((rng.random() > np.cumsum(p)).sum(), V - 1))
 
     t_logits, t_cache = target._prefill(target.params, jnp.asarray(tokens))
     d_logits, d_cache = draft._prefill(draft.params, jnp.asarray(tokens))
@@ -129,7 +162,8 @@ def generate_speculative(target, draft, tokens, max_new_tokens: int = 32,
 
     out = [tokens]
     # first target token comes straight from the prefill logits
-    cur = np.asarray(jnp.argmax(t_logits[:, -1].astype(jnp.float32), -1))
+    first = np.asarray(t_logits[:, -1].astype(jnp.float32))
+    cur = draw(dist(first)) if sampled else first.argmax(-1).astype(np.int32)
     n_emitted = 1
     n_rounds = 0
     n_accepted_total = 0
@@ -142,37 +176,85 @@ def generate_speculative(target, draft, tokens, max_new_tokens: int = 32,
         # ---- draft proposes g tokens autoregressively (the engine's
         # own compiled, cache-donating decode step) ----
         proposal = np.zeros((B, g), np.int32)
+        q_dists = (np.zeros((g, B, V), np.float64) if sampled else None)
         d_tok = cur
         for i in range(g):
             dl, d_cache = draft._decode(draft.params, d_cache,
                                         jnp.asarray(d_tok[:, None]),
                                         jnp.asarray(pos + i, jnp.int32))
-            d_tok = np.asarray(jnp.argmax(dl[:, -1].astype(jnp.float32),
-                                          -1))
+            dl = np.asarray(dl[:, -1].astype(jnp.float32))
+            if sampled:
+                q_dists[i] = dist(dl)
+                d_tok = draw(q_dists[i])
+            else:
+                d_tok = dl.argmax(-1).astype(np.int32)
             proposal[:, i] = d_tok
         # ---- target verifies [cur, d_1..d_g] — g+1 tokens, ONE step;
         # a fully-agreeing round emits g+1 tokens (bonus included) ----
         chunk = np.concatenate([cur[:, None], proposal], axis=1)
         tl, t_cache = extend_t(target.params, t_cache, jnp.asarray(chunk),
                                jnp.asarray(pos, jnp.int32))
-        greedy = np.asarray(jnp.argmax(tl.astype(jnp.float32), -1))
-        # greedy[:, j] = target's token AFTER chunk prefix of length
-        # j+1. accepted = #leading draft tokens agreeing with the
-        # target; the batch takes the row minimum so all rows stay in
-        # lockstep (a conservative, correct choice; per-row bookkeeping
-        # would need ragged caches)
-        agree = greedy[:, :-1] == proposal
-        # first disagreement per row (the appended False column makes
-        # argmin return g when a row accepted everything)
-        first_bad = np.argmin(
-            np.concatenate([agree, np.zeros((B, 1), bool)], axis=1),
-            axis=1)
-        n_acc = int(first_bad.min())
+        tl = np.asarray(tl.astype(jnp.float32))   # [B, g+1, V]
+        if sampled:
+            p_dists = dist(tl)                    # [B, g+1, V]
+            # Leviathan acceptance per row: accept draft token i with
+            # prob min(1, p_i(x)/q_i(x))
+            rows = np.arange(B)
+            accept = np.ones((B, g), bool)
+            for i in range(g):
+                px = p_dists[rows, i, proposal[:, i]]
+                qx = q_dists[i][rows, proposal[:, i]]
+                accept[:, i] = rng.random(B) < np.minimum(
+                    1.0, px / np.maximum(qx, 1e-300))
+            first_bad = np.argmin(
+                np.concatenate([accept, np.zeros((B, 1), bool)], axis=1),
+                axis=1)
+            # batch lockstep: stop at the earliest rejection. Cutting a
+            # row's acceptance early stays unbiased — its continuation
+            # is then a fresh sample from p at that position
+            n_acc = int(first_bad.min())
+            nxt = np.zeros(B, np.int32)
+            for b in range(B):
+                if n_acc == g:
+                    # full acceptance everywhere: bonus token from the
+                    # target's next-position distribution
+                    nxt[b] = draw1(p_dists[b, g])
+                elif first_bad[b] == n_acc:
+                    # a genuine rejection at this position: resample
+                    # from the residual norm(max(0, p - q))
+                    res = np.maximum(
+                        0.0, p_dists[b, n_acc] - q_dists[n_acc][b])
+                    tot = res.sum()
+                    p_b = (res / tot if tot > 0
+                           else p_dists[b, n_acc])
+                    nxt[b] = draw1(p_b)
+                else:
+                    # this row ACCEPTED the draft token at the lockstep
+                    # cut — it must be emitted as-is (a fresh sample
+                    # from p here would mix alpha*p with the residual
+                    # and bias the marginal away from p)
+                    nxt[b] = proposal[b, n_acc]
+            cur_next = nxt
+        else:
+            greedy = tl.argmax(-1).astype(np.int32)
+            # greedy[:, j] = target's token AFTER chunk prefix of length
+            # j+1. accepted = #leading draft tokens agreeing with the
+            # target; the batch takes the row minimum so all rows stay
+            # in lockstep (a conservative, correct choice; per-row
+            # bookkeeping would need ragged caches)
+            agree = greedy[:, :-1] == proposal
+            # first disagreement per row (the appended False column
+            # makes argmin return g when a row accepted everything)
+            first_bad = np.argmin(
+                np.concatenate([agree, np.zeros((B, 1), bool)], axis=1),
+                axis=1)
+            n_acc = int(first_bad.min())
+            cur_next = greedy[:, n_acc]   # correction (or bonus) token
         emit = [cur[:, None]]
         for i in range(n_acc):
             emit.append(proposal[:, i][:, None])
         out.append(np.concatenate(emit, axis=1))
-        cur = greedy[:, n_acc]    # correction (or bonus) token
+        cur = cur_next
         n_emitted += n_acc + 1
         pos += n_acc + 1
         n_rounds += 1

@@ -203,3 +203,48 @@ def test_interleaved_rejects_bad_config(devices):
     with pytest.raises(ValueError, match="virtual_chunks"):
         make_pipelined_loss_fn(None, None, None, 4, 2, 4, None, None,
                                schedule="interleaved", virtual_chunks=1)
+
+
+try:
+    from hypothesis import given, settings, strategies as st
+
+    @settings(max_examples=25, deadline=None)
+    @given(P=st.integers(2, 8), v=st.integers(1, 4),
+           groups=st.integers(1, 3))
+    def test_schedule_properties_random(P, v, groups):
+        """Hypothesis sweep of the generator invariants: completeness,
+        dependency order, and the v=1 classic tick count, for random
+        (stages, chunks, microbatch-group) shapes."""
+        M = P * groups
+        tab = interleaved_1f1b_tables(P, v, M)
+        T = tab["fwd_c"].shape[1]
+        V = v * P
+        f_tick, b_tick = {}, {}
+        for d in range(P):
+            seen_f, seen_b = set(), set()
+            for t in range(T):
+                if tab["fwd_valid"][d, t]:
+                    key = (int(tab["fwd_c"][d, t]), int(tab["fwd_m"][d, t]))
+                    assert key not in seen_f
+                    seen_f.add(key)
+                    f_tick[(key[0] * P + d, key[1])] = t
+                if tab["bwd_valid"][d, t]:
+                    key = (int(tab["bwd_c"][d, t]), int(tab["bwd_m"][d, t]))
+                    assert key not in seen_b
+                    seen_b.add(key)
+                    b_tick[(key[0] * P + d, key[1])] = t
+            full = {(c, m) for c in range(v) for m in range(M)}
+            assert seen_f == full and seen_b == full
+        for (vs, m), t in f_tick.items():
+            if vs > 0:
+                assert f_tick[(vs - 1, m)] < t
+        for (vs, m), t in b_tick.items():
+            if vs == V - 1:
+                assert f_tick[(vs, m)] <= t
+            else:
+                assert b_tick[(vs + 1, m)] < t
+                assert f_tick[(vs, m)] <= t
+        if v == 1:
+            assert T == M + 2 * P - 2
+except ImportError:            # pragma: no cover - hypothesis is baked in
+    pass

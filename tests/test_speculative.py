@@ -85,3 +85,88 @@ def test_speculative_llama_dialect(devices):
     got = generate_speculative(target, draft, toks, max_new_tokens=10,
                                gamma=3)
     np.testing.assert_array_equal(got, ref)
+
+
+def test_sampled_identical_engines_always_accept(devices):
+    """p == q makes the acceptance probability exactly 1: sampled
+    speculation with draft == target accepts every proposal."""
+    target, _ = _engines()
+    toks = np.random.default_rng(2).integers(0, 128, (1, 5)).astype(np.int32)
+    got, stats = generate_speculative(target, target, toks,
+                                      max_new_tokens=12, gamma=4,
+                                      temperature=0.8, seed=11,
+                                      return_stats=True)
+    assert got.shape == (1, 17)
+    assert ((got >= 0) & (got < 128)).all()
+    assert stats["rounds"] <= 3, stats      # 4+4+2 accepted, like greedy
+
+
+@pytest.mark.parametrize("B", [1, 2])
+def test_sampled_distribution_matches_target(devices, B):
+    """Losslessness: the second generated token's empirical distribution
+    matches the EXACT two-step target marginal sum_x1 p(x1) p(x2|x1),
+    while the draft's own marginal is far away (negative control).
+    B=2 adds a second row with a DIFFERENT prompt whose rejections force
+    batch-lockstep cuts on row 0 — pinning the accepted-at-the-cut
+    emission rule (a fresh p-sample there biases the marginal)."""
+    cfg_t = gpt.GPTConfig(vocab_size=32, n_layers=2, n_heads=2,
+                          d_model=32, max_seq_len=16, dtype=jnp.float32,
+                          use_flash_attention=False, remat=False,
+                          tie_embeddings=False)
+    cfg_d = gpt.GPTConfig(vocab_size=32, n_layers=1, n_heads=2,
+                          d_model=16, max_seq_len=16, dtype=jnp.float32,
+                          use_flash_attention=False, remat=False,
+                          tie_embeddings=False)
+
+    def sharp_params(key, cfg):
+        # random tiny nets emit ~uniform logits (no statistical power);
+        # an amplified untied head gives each model a sharp, DISTINCT
+        # distribution so bias would be visible
+        prm = gpt.init_params(key, cfg)
+        prm["lm_head"]["kernel"] = prm["lm_head"]["kernel"] * 12.0
+        return prm
+
+    target = InferenceEngine(config=cfg_t,
+                             params=sharp_params(jax.random.PRNGKey(0),
+                                                 cfg_t),
+                             dtype=jnp.float32)
+    draft = InferenceEngine(config=cfg_d,
+                            params=sharp_params(jax.random.PRNGKey(4),
+                                                cfg_d),
+                            dtype=jnp.float32)
+    V, temp = 32, 1.0
+    prompt = np.array([[3, 7, 1]], np.int32)
+    run_prompt = (prompt if B == 1
+                  else np.array([[3, 7, 1], [5, 2, 9]], np.int32))
+
+    def probs(logits):
+        z = np.asarray(logits, np.float64) / temp
+        z -= z.max(-1, keepdims=True)
+        e = np.exp(z)
+        return e / e.sum(-1, keepdims=True)
+
+    def marginal(eng):
+        l1 = np.asarray(eng.forward(prompt))[0, -1]
+        p1 = probs(l1)                                  # [V]
+        batch = np.concatenate(
+            [np.repeat(prompt, V, 0),
+             np.arange(V, dtype=np.int32)[:, None]], axis=1)
+        l2 = np.asarray(eng.forward(batch))[:, -1]      # [V, V]
+        return p1 @ probs(l2)                           # [V]
+
+    exact = marginal(target)
+    control = marginal(draft)
+    assert np.abs(exact - control).sum() / 2 > 0.15     # distinguishable
+
+    N = 1200 if B == 1 else 900
+    counts = np.zeros(V)
+    for i in range(N):
+        got = generate_speculative(target, draft, run_prompt,
+                                   max_new_tokens=2, gamma=2,
+                                   temperature=temp, seed=1000 + i)
+        counts[got[0, -1]] += 1
+    emp = counts / N
+    tv = np.abs(emp - exact).sum() / 2
+    tv_control = np.abs(emp - control).sum() / 2
+    assert tv < (0.12 if B == 1 else 0.14), (tv, tv_control)
+    assert tv < tv_control                              # closer to target

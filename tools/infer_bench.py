@@ -101,11 +101,78 @@ CONFIGS = [
 ]
 
 
+def bench_speculative(name, target_preset, draft_preset, batch,
+                      prompt_len, new_tokens, gamma):
+    """Speculative vs plain greedy decode on the same target: wall-clock
+    tokens/s for identical output (the greedy exactness contract)."""
+    from deepspeed_tpu.models import gpt
+    import deepspeed_tpu
+    from deepspeed_tpu.inference.speculative import generate_speculative
+
+    on_tpu = "tpu" in (jax.devices()[0].platform +
+                       jax.devices()[0].device_kind).lower()
+    mk = lambda preset: gpt.preset(
+        preset, max_seq_len=prompt_len + new_tokens + gamma + 8,
+        dtype=jnp.bfloat16, use_flash_attention=on_tpu)
+    cfg_t, cfg_d = mk(target_preset), mk(draft_preset)
+    if on_tpu:
+        # BOTH engines are resident: guard target and draft footprints
+        from deepspeed_tpu.utils import hbm
+        hbm.guard_infer_config(cfg_t, batch, cfg_t.max_seq_len)
+        hbm.guard_infer_config(cfg_d, batch, cfg_d.max_seq_len)
+    t_eng = deepspeed_tpu.init_inference(
+        model=(cfg_t, gpt.init_params(jax.random.PRNGKey(0), cfg_t)),
+        dtype=jnp.bfloat16)
+    d_eng = deepspeed_tpu.init_inference(
+        model=(cfg_d, gpt.init_params(jax.random.PRNGKey(1), cfg_d)),
+        dtype=jnp.bfloat16)
+    toks = np.random.default_rng(0).integers(
+        0, cfg_t.vocab_size, (batch, prompt_len)).astype(np.int32)
+    # warmup both paths (compiles)
+    t_eng.generate(toks, max_new_tokens=new_tokens)
+    generate_speculative(t_eng, d_eng, toks, max_new_tokens=new_tokens,
+                         gamma=gamma)
+    t0 = time.perf_counter()
+    ref = t_eng.generate(toks, max_new_tokens=new_tokens)
+    plain_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    got, stats = generate_speculative(t_eng, d_eng, toks,
+                                      max_new_tokens=new_tokens,
+                                      gamma=gamma, return_stats=True)
+    spec_s = time.perf_counter() - t0
+    print(json.dumps({
+        "config": name, "target": target_preset, "draft": draft_preset,
+        "batch": batch, "gamma": gamma, "output_identical":
+        bool((got == ref).all()),
+        "plain_tokens_per_s": round(batch * new_tokens / plain_s, 1),
+        "spec_tokens_per_s": round(batch * new_tokens / spec_s, 1),
+        "speedup": round(plain_s / spec_s, 2),
+        "accepted_per_round": round(stats["accepted_per_round"], 2),
+    }), flush=True)
+
+
+SPEC_CONFIGS = [
+    ("spec-large-from-small", dict(target_preset="gpt2-large",
+                                   draft_preset="gpt2-small", batch=1,
+                                   prompt_len=128, new_tokens=64,
+                                   gamma=4)),
+]
+
+
 def main():
     from deepspeed_tpu.utils.hbm import MemoryGuardError
     for name, kw in CONFIGS:
         try:
             bench_config(name, **kw)
+        except MemoryGuardError as e:
+            print(json.dumps({"config": name, "skipped": "memory guard",
+                              "why": str(e)[:300]}), flush=True)
+        except Exception as e:
+            print(json.dumps({"config": name, "error": repr(e)[:200]}),
+                  flush=True)
+    for name, kw in SPEC_CONFIGS:
+        try:
+            bench_speculative(name, **kw)
         except MemoryGuardError as e:
             print(json.dumps({"config": name, "skipped": "memory guard",
                               "why": str(e)[:300]}), flush=True)
