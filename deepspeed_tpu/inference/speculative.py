@@ -182,21 +182,22 @@ def generate_speculative(target, draft, tokens, max_new_tokens: int = 32,
             dl, d_cache = draft._decode(draft.params, d_cache,
                                         jnp.asarray(d_tok[:, None]),
                                         jnp.asarray(pos + i, jnp.int32))
-            dl = np.asarray(dl[:, -1].astype(jnp.float32))
             if sampled:
-                q_dists[i] = dist(dl)
+                q_dists[i] = dist(np.asarray(dl[:, -1].astype(jnp.float32)))
                 d_tok = draw(q_dists[i])
             else:
-                d_tok = dl.argmax(-1).astype(np.int32)
+                # ids only cross the host boundary on the greedy path
+                d_tok = np.asarray(
+                    jnp.argmax(dl[:, -1].astype(jnp.float32), -1),
+                ).astype(np.int32)
             proposal[:, i] = d_tok
         # ---- target verifies [cur, d_1..d_g] — g+1 tokens, ONE step;
         # a fully-agreeing round emits g+1 tokens (bonus included) ----
         chunk = np.concatenate([cur[:, None], proposal], axis=1)
         tl, t_cache = extend_t(target.params, t_cache, jnp.asarray(chunk),
                                jnp.asarray(pos, jnp.int32))
-        tl = np.asarray(tl.astype(jnp.float32))   # [B, g+1, V]
         if sampled:
-            p_dists = dist(tl)                    # [B, g+1, V]
+            p_dists = dist(np.asarray(tl.astype(jnp.float32)))  # [B,g+1,V]
             # Leviathan acceptance per row: accept draft token i with
             # prob min(1, p_i(x)/q_i(x))
             rows = np.arange(B)
@@ -236,7 +237,9 @@ def generate_speculative(target, draft, tokens, max_new_tokens: int = 32,
                     nxt[b] = proposal[b, n_acc]
             cur_next = nxt
         else:
-            greedy = tl.argmax(-1).astype(np.int32)
+            # ids only cross the host boundary on the greedy path
+            greedy = np.asarray(
+                jnp.argmax(tl.astype(jnp.float32), -1)).astype(np.int32)
             # greedy[:, j] = target's token AFTER chunk prefix of length
             # j+1. accepted = #leading draft tokens agreeing with the
             # target; the batch takes the row minimum so all rows stay

@@ -290,8 +290,13 @@ def _kernel_of(p, dtype):
 
 
 def _dense(h, p):
-    """h @ kernel (+ bias when the config kept biases)."""
+    """h @ kernel (+ bias when the config kept biases). A LoRA-adapted
+    entry (runtime/lora.py) adds the low-rank path h @ A @ B * scale —
+    the dense delta is never materialized."""
     y = h @ _kernel_of(p, h.dtype)
+    if "lora_a" in p:
+        y = y + ((h @ p["lora_a"].astype(h.dtype))
+                 @ p["lora_b"].astype(h.dtype))             * p["lora_scale"].astype(h.dtype)
     b = p.get("bias")
     return y if b is None else y + b.astype(h.dtype)
 

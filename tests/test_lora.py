@@ -1,0 +1,132 @@
+"""LoRA fine-tuning: frozen base, trained adapters, mergeable result."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import gpt
+from deepspeed_tpu.runtime import lora
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=128, n_layers=2, n_heads=4, d_model=32,
+                max_seq_len=32, dtype=jnp.float32,
+                use_flash_attention=False, remat=False)
+    base.update(kw)
+    return gpt.GPTConfig(**base)
+
+
+def test_lora_starts_at_base_model(devices):
+    """B = 0 makes the adapted forward EXACTLY the base forward."""
+    cfg = _cfg()
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    adapted = lora.add_lora(params, jax.random.PRNGKey(1), rank=4)
+    toks = np.random.default_rng(0).integers(0, 128, (2, 9)).astype(np.int32)
+    base_out = gpt.forward(params, jnp.asarray(toks), cfg,
+                           jax.random.PRNGKey(0), deterministic=True)
+    lora_out = gpt.forward(adapted, jnp.asarray(toks), cfg,
+                           jax.random.PRNGKey(0), deterministic=True)
+    np.testing.assert_array_equal(np.asarray(base_out),
+                                  np.asarray(lora_out))
+
+
+def test_lora_trains_only_adapters(devices):
+    """Through the engine with the masked optimizer: loss decreases,
+    adapter leaves move, every base leaf stays bit-identical."""
+    cfg = _cfg()
+    params = lora.add_lora(gpt.init_params(jax.random.PRNGKey(0), cfg),
+                           jax.random.PRNGKey(1), rank=8)
+    n_train, n_total = lora.count_trainable(params)
+    # the test model is tiny (embeddings dominate); real models
+    # sit well under 1% adapters
+    assert 0 < n_train < 0.35 * n_total
+    opt = lora.lora_optimizer(
+        __import__("optax").adamw(2e-2), params)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt.make_loss_fn(cfg), model_parameters=params,
+        config={"train_batch_size": 8, "steps_per_print": 1000},
+        optimizer=opt)
+    before = jax.tree_util.tree_map(np.asarray, engine.state.params)
+    toks = np.random.default_rng(0).integers(0, 128, (8, 33)).astype(np.int32)
+    losses = [float(engine.train_batch({"tokens": toks})["loss"])
+              for _ in range(16)]
+    # low-rank adapters on a frozen random base move slowly; the point
+    # is a steady decrease with every base leaf bit-frozen (measured
+    # trajectory drops ~0.13 over 16 steps)
+    assert losses[-1] < losses[0] - 0.1, losses
+    after = engine.state.params
+    labels = lora.lora_label_tree(before)
+    moved = frozen_same = 0
+    for (path, b), a, lab in zip(
+            jax.tree_util.tree_leaves_with_path(before),
+            jax.tree_util.tree_leaves(after),
+            jax.tree_util.tree_leaves(labels)):
+        if lab == "train":
+            moved += int(not np.array_equal(b, np.asarray(a)))
+        else:
+            assert np.array_equal(b, np.asarray(a)), \
+                jax.tree_util.keystr(path)
+            frozen_same += 1
+    assert moved >= 8          # a and b of several adapted projections
+    assert frozen_same > 0
+
+
+def test_lora_merge_matches_adapted_forward(devices):
+    """After training, merge_lora folds the delta: merged == adapted."""
+    cfg = _cfg()
+    params = lora.add_lora(gpt.init_params(jax.random.PRNGKey(0), cfg),
+                           jax.random.PRNGKey(1), rank=4)
+    import optax
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt.make_loss_fn(cfg), model_parameters=params,
+        config={"train_batch_size": 8, "steps_per_print": 1000},
+        optimizer=lora.lora_optimizer(optax.adamw(3e-3), params))
+    toks = np.random.default_rng(1).integers(0, 128, (8, 33)).astype(np.int32)
+    for _ in range(4):
+        engine.train_batch({"tokens": toks})
+    trained = engine.module_state_dict()
+    merged = lora.merge_lora(trained)
+    assert "lora_a" not in merged["block"]["qkv"]
+    x = np.random.default_rng(2).integers(0, 128, (2, 9)).astype(np.int32)
+    a = gpt.forward(trained, jnp.asarray(x), cfg, jax.random.PRNGKey(0),
+                    deterministic=True)
+    m = gpt.forward(merged, jnp.asarray(x), cfg, jax.random.PRNGKey(0),
+                    deterministic=True)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(m),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_lora_llama_dialect_and_int8_serving(devices):
+    """LoRA on the llama dialect (no-bias swiglu entries incl.
+    mlp_gate), merged and served int8."""
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    cfg = gpt.preset("llama-tiny", dtype=jnp.float32,
+                     use_flash_attention=False, remat=False)
+    params = lora.add_lora(gpt.init_params(jax.random.PRNGKey(0), cfg),
+                           jax.random.PRNGKey(1), rank=4)
+    assert "lora_a" in params["block"]["mlp_gate"]
+    merged = lora.merge_lora(params)
+    eng = InferenceEngine(config=cfg, params=merged, dtype=jnp.int8)
+    toks = np.random.default_rng(3).integers(
+        0, cfg.vocab_size, (1, 6)).astype(np.int32)
+    out = eng.generate(toks, max_new_tokens=4, temperature=0.0)
+    assert ((out >= 0) & (out < cfg.vocab_size)).all()
+
+
+def test_lora_optimizer_state_is_adapter_sized(devices):
+    """The memory story: Adam moments exist only for adapter leaves."""
+    import optax
+    cfg = _cfg()
+    params = lora.add_lora(gpt.init_params(jax.random.PRNGKey(0), cfg),
+                           jax.random.PRNGKey(1), rank=4)
+    opt = lora.lora_optimizer(optax.adamw(1e-3), params)
+    state = opt.init(params)
+    n_train, n_total = lora.count_trainable(params)
+    state_elems = sum(
+        x.size for x in jax.tree_util.tree_leaves(state)
+        if hasattr(x, "size"))
+    # mu + nu for adapters only (plus scalar counts) — far below a
+    # full-model Adam state (2 * n_total)
+    assert state_elems < 2.2 * n_train + 64, (state_elems, n_train)

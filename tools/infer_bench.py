@@ -116,10 +116,14 @@ def bench_speculative(name, target_preset, draft_preset, batch,
         dtype=jnp.bfloat16, use_flash_attention=on_tpu)
     cfg_t, cfg_d = mk(target_preset), mk(draft_preset)
     if on_tpu:
-        # BOTH engines are resident: guard target and draft footprints
+        # BOTH engines are resident simultaneously: guard the SUM of
+        # their footprints, not each alone
         from deepspeed_tpu.utils import hbm
-        hbm.guard_infer_config(cfg_t, batch, cfg_t.max_seq_len)
-        hbm.guard_infer_config(cfg_d, batch, cfg_d.max_seq_len)
+        est = hbm.estimate_infer_bytes(cfg_t, batch, cfg_t.max_seq_len)
+        est_d = hbm.estimate_infer_bytes(cfg_d, batch, cfg_d.max_seq_len)
+        for k, v in est_d.contributions.items():
+            est.contributions[f"draft_{k}"] = v
+        hbm._guard(est, None, hbm.DEFAULT_HEADROOM_GIB)
     t_eng = deepspeed_tpu.init_inference(
         model=(cfg_t, gpt.init_params(jax.random.PRNGKey(0), cfg_t)),
         dtype=jnp.bfloat16)
