@@ -130,3 +130,40 @@ def test_lora_optimizer_state_is_adapter_sized(devices):
     # mu + nu for adapters only (plus scalar counts) — far below a
     # full-model Adam state (2 * n_total)
     assert state_elems < 2.2 * n_train + 64, (state_elems, n_train)
+
+
+def test_lora_composes_with_zero3_and_tp(devices):
+    """Adapters ride the default sharding (fsdp on the stacked layer
+    dim) alongside ZeRO-3 base params and Megatron TP rules; training
+    runs and only adapters move."""
+    import optax
+    from deepspeed_tpu.parallel.mesh import MeshSpec, make_mesh
+    cfg = _cfg()
+    params = lora.add_lora(gpt.init_params(jax.random.PRNGKey(0), cfg),
+                           jax.random.PRNGKey(1), rank=8)
+    mesh = make_mesh(MeshSpec(data=2, fsdp=2, model=2))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt.make_loss_fn(cfg), model_parameters=params,
+        config={"train_batch_size": 8,
+                "zero_optimization": {"stage": 3},
+                "mesh": {"data_parallel_size": 2, "zero_parallel_size": 2,
+                         "tensor_parallel_size": 2},
+                "steps_per_print": 1000},
+        optimizer=lora.lora_optimizer(optax.adamw(1e-2), params),
+        mesh=mesh, partition_rules=gpt.gpt_partition_rules())
+    before = jax.tree_util.tree_map(np.asarray, engine.state.params)
+    toks = np.random.default_rng(0).integers(0, 128, (8, 33)).astype(np.int32)
+    losses = [float(engine.train_batch({"tokens": toks})["loss"])
+              for _ in range(6)]
+    assert losses[-1] < losses[0] - 0.05, losses
+    k = engine.state.params["block"]["qkv"]
+    assert k["kernel"].sharding.shard_shape(k["kernel"].shape)[-1] \
+        == k["kernel"].shape[-1] // 2        # TP column shard intact
+    labels = lora.lora_label_tree(before)
+    for (path, b), a, lab in zip(
+            jax.tree_util.tree_leaves_with_path(before),
+            jax.tree_util.tree_leaves(engine.state.params),
+            jax.tree_util.tree_leaves(labels)):
+        if lab == "freeze":
+            assert np.array_equal(b, np.asarray(a)), \
+                jax.tree_util.keystr(path)
