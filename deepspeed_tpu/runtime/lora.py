@@ -104,3 +104,69 @@ def count_trainable(params) -> Tuple[int, int]:
                     jax.tree_util.tree_leaves(labels)) if lab == "train")
     total = sum(x.size for x in jax.tree_util.tree_leaves(params))
     return train, total
+
+
+def adapter_state_dict(params):
+    """Only the adapter leaves, keyed by '/'-joined path — the whole
+    fine-tune in kilobytes-to-megabytes (the base model ships
+    separately, like every LoRA ecosystem expects). Leaves are stored
+    fp32: lossless from bf16 (npz cannot represent bf16 — see
+    checkpointing.write_16bit_model's workaround; adapters are small
+    enough that widening beats a bit-pattern manifest)."""
+    out = {}
+
+    def walk(tree, prefix):
+        if isinstance(tree, dict):
+            for k, v in tree.items():
+                walk(v, prefix + (k,))
+        elif prefix and prefix[-1].startswith("lora_"):
+            out["/".join(prefix)] = np.asarray(
+                jnp.asarray(tree).astype(jnp.float32))
+
+    walk(params, ())
+    return out
+
+
+def save_adapter(params, path: str):
+    """Write the adapters (and only the adapters) to ``path`` (.npz)."""
+    np.savez(path, **adapter_state_dict(params))
+
+
+def load_adapter(params, path: str):
+    """Return ``params`` with the adapters from ``path`` attached —
+    ``params`` may be the bare base model (entries gain lora keys) or an
+    already-adapted tree (entries are overwritten). Shapes must match
+    the base kernels; a mismatched file raises."""
+    out = {k: (dict(v) if isinstance(v, dict) else v)
+           for k, v in params.items()}
+    with np.load(path) as data:
+        for flat in data.files:
+            keys = flat.split("/")
+            entry_keys, leaf = keys[:-1], keys[-1]
+            node = out
+            for k in entry_keys:
+                if not isinstance(node, dict) or k not in node:
+                    raise KeyError(
+                        f"adapter path {flat!r} has no matching entry in "
+                        f"the base params (at {k!r})")
+                node[k] = (dict(node[k]) if isinstance(node[k], dict)
+                           else node[k])
+                node = node[k]
+            if not isinstance(node, dict):
+                raise KeyError(
+                    f"adapter path {flat!r} does not address a dense "
+                    f"entry in the base params")
+            val = data[flat]
+            # int8-served bases carry "q" (kernel's shape) instead
+            kern = node.get("kernel", node.get("q"))
+            if kern is not None and leaf in ("lora_a", "lora_b"):
+                ok = (val.shape[:-1] == kern.shape[:-1]
+                      if leaf == "lora_a"
+                      else (val.shape[:-2] == kern.shape[:-2]
+                            and val.shape[-1] == kern.shape[-1]))
+                if not ok:
+                    raise ValueError(
+                        f"adapter {flat!r} shape {val.shape} does not "
+                        f"match the base kernel's {kern.shape}")
+            node[leaf] = jnp.asarray(val)
+    return out

@@ -167,3 +167,54 @@ def test_lora_composes_with_zero3_and_tp(devices):
         if lab == "freeze":
             assert np.array_equal(b, np.asarray(a)), \
                 jax.tree_util.keystr(path)
+
+
+def test_adapter_save_load_roundtrip(devices, tmp_path):
+    """The adapter file carries ONLY lora leaves (tiny); loading onto a
+    fresh base reproduces the adapted forward exactly."""
+    cfg = _cfg()
+    base = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    adapted = lora.add_lora(base, jax.random.PRNGKey(1), rank=4)
+    # make the adapters non-trivial
+    adapted["block"]["qkv"]["lora_b"] = (
+        adapted["block"]["qkv"]["lora_b"] + 0.3)
+    path = str(tmp_path / "adapter.npz")
+    lora.save_adapter(adapted, path)
+    import os
+    n_train, n_total = lora.count_trainable(adapted)
+    assert os.path.getsize(path) < 16 * n_train + 65536   # adapters only
+
+    restored = lora.load_adapter(base, path)
+    toks = np.random.default_rng(0).integers(0, 128, (2, 9)).astype(np.int32)
+    a = gpt.forward(adapted, jnp.asarray(toks), cfg, jax.random.PRNGKey(0),
+                    deterministic=True)
+    r = gpt.forward(restored, jnp.asarray(toks), cfg, jax.random.PRNGKey(0),
+                    deterministic=True)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(r))
+    # base tree was not mutated
+    assert "lora_a" not in base["block"]["qkv"]
+
+    with pytest.raises(KeyError):
+        bad = {k: v for k, v in base.items() if k != "block"}
+        lora.load_adapter(bad, path)
+
+
+def test_adapter_load_rejects_mismatched_base(devices, tmp_path):
+    """A fan-in/width mismatch (adapter from a different d_model) is a
+    loud error at load time, not a jit-time dot_general failure; bf16
+    trees save losslessly via fp32."""
+    cfg = _cfg()
+    adapted = lora.add_lora(gpt.init_params(jax.random.PRNGKey(0), cfg),
+                            jax.random.PRNGKey(1), rank=4)
+    # bf16 adapters save (fp32 widening) and restore
+    bf16 = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x,
+        adapted)
+    path = str(tmp_path / "a.npz")
+    lora.save_adapter(bf16, path)
+    lora.load_adapter(gpt.init_params(jax.random.PRNGKey(0), cfg), path)
+
+    cfg_small = _cfg(d_model=16, n_heads=2)
+    base_small = gpt.init_params(jax.random.PRNGKey(0), cfg_small)
+    with pytest.raises(ValueError, match="does not match"):
+        lora.load_adapter(base_small, path)
