@@ -136,7 +136,12 @@ def load_adapter(params, path: str):
     """Return ``params`` with the adapters from ``path`` attached —
     ``params`` may be the bare base model (entries gain lora keys) or an
     already-adapted tree (entries are overwritten). Shapes must match
-    the base kernels; a mismatched file raises."""
+    the base kernels; a mismatched file raises.
+
+    Note: ``deepspeed_tpu.initialize`` donates its model_parameters
+    buffers — attach adapters to a FRESHLY constructed/loaded base (or
+    to ``engine.module_state_dict()``), not to a tree previously handed
+    to an engine."""
     out = {k: (dict(v) if isinstance(v, dict) else v)
            for k, v in params.items()}
     with np.load(path) as data:
