@@ -218,3 +218,32 @@ def test_adapter_load_rejects_mismatched_base(devices, tmp_path):
     base_small = gpt.init_params(jax.random.PRNGKey(0), cfg_small)
     with pytest.raises(ValueError, match="does not match"):
         lora.load_adapter(base_small, path)
+
+
+def test_unmerged_adapter_serving_and_int8_base(devices):
+    """The inference engine serves an UNMERGED adapted tree (the _dense
+    low-rank path runs inside prefill/decode), matching the merged
+    model's generation — and composes with an int8-quantized BASE while
+    adapters stay float (QLoRA-style serving)."""
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    cfg = _cfg(max_seq_len=64)
+    adapted = lora.add_lora(gpt.init_params(jax.random.PRNGKey(0), cfg),
+                            jax.random.PRNGKey(1), rank=4)
+    adapted["block"]["qkv"]["lora_b"] = (
+        adapted["block"]["qkv"]["lora_b"] + 0.25)
+    merged = lora.merge_lora(adapted)
+    toks = np.random.default_rng(0).integers(0, 128, (2, 8)).astype(np.int32)
+
+    ref = InferenceEngine(config=cfg, params=merged,
+                          dtype=jnp.float32).generate(
+        toks, max_new_tokens=6, temperature=0.0)
+    raw = InferenceEngine(config=cfg, params=adapted,
+                          dtype=jnp.float32).generate(
+        toks, max_new_tokens=6, temperature=0.0)
+    np.testing.assert_array_equal(raw, ref)
+
+    q_eng = InferenceEngine(config=cfg, params=adapted, dtype=jnp.int8)
+    assert q_eng.params["block"]["qkv"]["q"].dtype == jnp.int8
+    assert "lora_a" in q_eng.params["block"]["qkv"]      # adapters float
+    out = q_eng.generate(toks, max_new_tokens=6, temperature=0.0)
+    assert ((out >= 0) & (out < 128)).all()
