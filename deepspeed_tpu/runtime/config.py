@@ -77,6 +77,37 @@ class BF16Config:
 
 
 @dataclass
+class LoraConfig:
+    """Config-driven LoRA (runtime/lora.py): the engine adapts the
+    param tree and wraps the configured optimizer so only adapter
+    leaves train. Beyond the reference surface (v0.6.4 predates LoRA),
+    but config-shaped like every other feature."""
+    enabled: bool = False
+    rank: int = 8
+    alpha: float = 16.0
+    # dense entries to adapt (missing entries are skipped per-dialect)
+    targets: tuple = ("qkv", "attn_out", "mlp_in", "mlp_gate", "mlp_out")
+    seed: int = 0
+
+    @staticmethod
+    def from_dict(d: Optional[Dict]) -> "LoraConfig":
+        if not d:
+            return LoraConfig()
+        targets = get_scalar_param(
+            d, "targets",
+            ["qkv", "attn_out", "mlp_in", "mlp_gate", "mlp_out"])
+        if isinstance(targets, str):
+            # tuple("qkv") would silently become ('q','k','v')
+            targets = [targets]
+        return LoraConfig(
+            enabled=get_scalar_param(d, "enabled", False),
+            rank=get_scalar_param(d, "rank", 8),
+            alpha=get_scalar_param(d, "alpha", 16.0),
+            targets=tuple(targets),
+            seed=get_scalar_param(d, "seed", 0))
+
+
+@dataclass
 class OffloadConfig:
     """Offload target for params or optimizer state
     (ref: deepspeed/runtime/zero/offload_config.py)."""
@@ -501,6 +532,7 @@ class DeepSpeedConfig:
         bf16_dict = pd.get(C.BFLOAT16, pd.get(C.BFLOAT16_OLD, {}))
         self.bf16 = BF16Config.from_dict(bf16_dict)
         self.zero = ZeroConfig.from_dict(pd.get(C.ZERO_OPTIMIZATION))
+        self.lora = LoraConfig.from_dict(pd.get("lora", {}))
         self.mesh = MeshConfig.from_dict(pd.get(C.MESH))
         self.activation_checkpointing = ActivationCheckpointingConfig.from_dict(
             pd.get(C.ACTIVATION_CHECKPOINTING))

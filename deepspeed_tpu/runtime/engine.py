@@ -166,6 +166,48 @@ class DeepSpeedEngine:
         self.master_dtype = (jnp.bfloat16 if self.memory_efficient_bf16
                              else jnp.float32)
 
+        # --- config-driven LoRA (runtime/lora.py) ---------------------
+        # adapt BEFORE specs/optimizer so adapter leaves shard and the
+        # masked transform sees the final tree
+        if config.lora.enabled:
+            if config.zero.offload_optimizer.enabled:
+                raise ValueError(
+                    "lora + offload_optimizer makes no sense: the host "
+                    "optimizer exists for multi-GB optimizer state, "
+                    "which LoRA removes — drop one of the two")
+            from deepspeed_tpu.runtime import lora as lora_lib
+            if not isinstance(params.get("block"), dict):
+                raise ValueError(
+                    "config-driven lora adapts the models/* layout "
+                    "(a 'block' dict of dense entries); for a custom "
+                    "pytree call runtime.lora.add_lora yourself and "
+                    "pass optimizer=lora_optimizer(...)")
+            adapted_entries = [e for e in params["block"].values()
+                               if isinstance(e, dict) and "lora_a" in e]
+            if adapted_entries:
+                # resume path: the tree is already adapted — the config
+                # knobs must AGREE with it (rank is readable from the
+                # adapter shapes; silently training a different rank
+                # than the config claims would be worse than an error)
+                got_rank = adapted_entries[0]["lora_a"].shape[-1]
+                if got_rank != config.lora.rank:
+                    raise ValueError(
+                        f"params carry rank-{got_rank} adapters but the "
+                        f"config says lora.rank={config.lora.rank}")
+            else:
+                params = lora_lib.add_lora(
+                    params, jax.random.PRNGKey(config.lora.seed),
+                    rank=config.lora.rank, alpha=config.lora.alpha,
+                    targets=config.lora.targets)
+                if not any("lora_a" in e
+                           for e in params["block"].values()
+                           if isinstance(e, dict)):
+                    raise ValueError(
+                        f"lora.targets {config.lora.targets} matched no "
+                        f"dense entry in the model block "
+                        f"({sorted(params['block'])}) — every parameter "
+                        f"would be frozen and training would be a no-op")
+
         # --- shardings ------------------------------------------------
         self.partition_rules = list(partition_rules or [])
         self.param_pspecs = sharding_lib.param_specs(
@@ -205,6 +247,10 @@ class DeepSpeedEngine:
                                     self.param_shardings)
             self.optimizer = optimizer if optimizer is not None \
                 else self._configure_basic_optimizer()
+            if config.lora.enabled:
+                from deepspeed_tpu.runtime import lora as lora_lib
+                self.optimizer = lora_lib.lora_optimizer(
+                    self.optimizer, params)
 
             # optimizer state: shard like ZeRO stage >= 1
             opt_shape = jax.eval_shape(self.optimizer.init, params)

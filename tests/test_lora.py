@@ -247,3 +247,41 @@ def test_unmerged_adapter_serving_and_int8_base(devices):
     assert "lora_a" in q_eng.params["block"]["qkv"]      # adapters float
     out = q_eng.generate(toks, max_new_tokens=6, temperature=0.0)
     assert ((out >= 0) & (out < 128)).all()
+
+
+def test_config_driven_lora(devices):
+    """"lora": {...} in the JSON config adapts the tree and masks the
+    optimizer with no user-side code — like every reference feature."""
+    cfg = _cfg()
+    base = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt.make_loss_fn(cfg), model_parameters=base,
+        config={"train_batch_size": 8,
+                "lora": {"enabled": True, "rank": 8},
+                "optimizer": {"type": "adamw", "params": {"lr": 2e-2}},
+                "steps_per_print": 1000})
+    assert "lora_a" in engine.state.params["block"]["qkv"]
+    before = jax.tree_util.tree_map(np.asarray, engine.state.params)
+    toks = np.random.default_rng(0).integers(0, 128, (8, 33)).astype(np.int32)
+    losses = [float(engine.train_batch({"tokens": toks})["loss"])
+              for _ in range(16)]
+    assert losses[-1] < losses[0] - 0.1, losses
+    labels = lora.lora_label_tree(before)
+    for (path, b), a, lab in zip(
+            jax.tree_util.tree_leaves_with_path(before),
+            jax.tree_util.tree_leaves(engine.state.params),
+            jax.tree_util.tree_leaves(labels)):
+        if lab == "freeze":
+            assert np.array_equal(b, np.asarray(a)), \
+                jax.tree_util.keystr(path)
+
+    with pytest.raises(ValueError, match="lora"):
+        deepspeed_tpu.initialize(
+            model=gpt.make_loss_fn(cfg),
+            model_parameters=gpt.init_params(jax.random.PRNGKey(0), cfg),
+            config={"train_batch_size": 8,
+                    "lora": {"enabled": True},
+                    "zero_optimization": {
+                        "offload_optimizer": {"device": "cpu"}},
+                    "optimizer": {"type": "adamw",
+                                  "params": {"lr": 1e-3}}})
