@@ -107,7 +107,7 @@ def _extend_fn(engine, params, cache, tokens, pos):
 
 def generate_speculative(target, draft, tokens, max_new_tokens: int = 32,
                          gamma: int = 4, temperature: float = 0.0,
-                         seed: int = 0,
+                         top_k: int = 0, seed: int = 0,
                          return_stats: bool = False):
     """Speculative generation (see module docstring).
 
@@ -124,6 +124,10 @@ def generate_speculative(target, draft, tokens, max_new_tokens: int = 32,
     bonus from p. The OUTPUT DISTRIBUTION equals sampling the target
     alone (the sample path differs from target.generate's rng stream,
     so sequences aren't bitwise-comparable — the distribution is).
+    top_k truncates BOTH p and q to their top-k before the accept/
+    resample math, matching generate(top_k=...)'s truncated target
+    process (any proposal q is admissible for unbiasedness; the
+    truncated q keeps the support aligned).
     """
     assert target.cfg.vocab_size == draft.cfg.vocab_size, \
         "speculative decoding needs a shared vocabulary"
@@ -137,9 +141,14 @@ def generate_speculative(target, draft, tokens, max_new_tokens: int = 32,
     rng = np.random.default_rng(seed)
 
     def dist(logits):
-        """[.., V] logits -> fp64 probabilities at `temperature`."""
+        """[.., V] logits -> fp64 probabilities at `temperature`
+        (optionally top_k-truncated, matching generate()'s sampler)."""
         z = np.asarray(logits, np.float64) / temperature
-        z -= z.max(-1, keepdims=True)
+        if top_k > 0:
+            k_eff = min(top_k, z.shape[-1])   # match generate()'s clamp
+            kth = np.sort(z, axis=-1)[..., -k_eff, None]
+            z = np.where(z < kth, -np.inf, z)
+        z = z - z.max(-1, keepdims=True)
         e = np.exp(z)
         return e / e.sum(-1, keepdims=True)
 

@@ -532,7 +532,7 @@ class DeepSpeedConfig:
         bf16_dict = pd.get(C.BFLOAT16, pd.get(C.BFLOAT16_OLD, {}))
         self.bf16 = BF16Config.from_dict(bf16_dict)
         self.zero = ZeroConfig.from_dict(pd.get(C.ZERO_OPTIMIZATION))
-        self.lora = LoraConfig.from_dict(pd.get("lora", {}))
+        self.lora = LoraConfig.from_dict(pd.get(C.LORA))
         self.mesh = MeshConfig.from_dict(pd.get(C.MESH))
         self.activation_checkpointing = ActivationCheckpointingConfig.from_dict(
             pd.get(C.ACTIVATION_CHECKPOINTING))

@@ -302,3 +302,6 @@ COMM_BACKEND_NAME_DEFAULT = "ici"  # "ici" (XLA collectives) or "dcn_compressed"
 DATA_TYPES = "data_types"
 GRAD_ACCUM_DTYPE = "grad_accum_dtype"
 GRAD_ACCUM_DTYPE_DEFAULT = None
+
+# config-driven LoRA section (runtime/lora.py)
+LORA = "lora"

@@ -194,6 +194,22 @@ class DeepSpeedEngine:
                     raise ValueError(
                         f"params carry rank-{got_rank} adapters but the "
                         f"config says lora.rank={config.lora.rank}")
+                got_alpha = float(
+                    jnp.ravel(adapted_entries[0]["lora_scale"])[0]
+                    * got_rank)
+                if abs(got_alpha - config.lora.alpha) > 1e-6:
+                    raise ValueError(
+                        f"params carry alpha={got_alpha:g} adapters but "
+                        f"the config says lora.alpha={config.lora.alpha}")
+                got_targets = sorted(
+                    n for n, e in params["block"].items()
+                    if isinstance(e, dict) and "lora_a" in e)
+                want = sorted(n for n in config.lora.targets
+                              if n in params["block"])
+                if got_targets != want:
+                    raise ValueError(
+                        f"params adapt {got_targets} but the config's "
+                        f"lora.targets resolve to {want}")
             else:
                 params = lora_lib.add_lora(
                     params, jax.random.PRNGKey(config.lora.seed),

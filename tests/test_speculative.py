@@ -105,8 +105,8 @@ def test_sampled_identical_engines_always_accept(devices):
     assert stats["accepted_per_round"] >= 2.0, stats
 
 
-@pytest.mark.parametrize("B", [1, 2])
-def test_sampled_distribution_matches_target(devices, B):
+@pytest.mark.parametrize("B,top_k", [(1, 0), (2, 0), (1, 6)])
+def test_sampled_distribution_matches_target(devices, B, top_k):
     """Losslessness: the second generated token's empirical distribution
     matches the EXACT two-step target marginal sum_x1 p(x1) p(x2|x1),
     while the draft's own marginal is far away (negative control).
@@ -145,7 +145,10 @@ def test_sampled_distribution_matches_target(devices, B):
 
     def probs(logits):
         z = np.asarray(logits, np.float64) / temp
-        z -= z.max(-1, keepdims=True)
+        if top_k > 0:
+            kth = np.sort(z, axis=-1)[..., -top_k, None]
+            z = np.where(z < kth, -np.inf, z)
+        z = z - z.max(-1, keepdims=True)
         e = np.exp(z)
         return e / e.sum(-1, keepdims=True)
 
@@ -167,7 +170,8 @@ def test_sampled_distribution_matches_target(devices, B):
     for i in range(N):
         got = generate_speculative(target, draft, run_prompt,
                                    max_new_tokens=2, gamma=2,
-                                   temperature=temp, seed=1000 + i)
+                                   temperature=temp, top_k=top_k,
+                                   seed=1000 + i)
         counts[got[0, -1]] += 1
     emp = counts / N
     tv = np.abs(emp - exact).sum() / 2
