@@ -16,9 +16,20 @@ def honor_platform_request() -> None:
 
 def on_tpu() -> bool:
     """Whether device 0 is a TPU — the single source of truth for flash
-    eligibility and other hardware gates (models/gpt.py, ops ring)."""
+    eligibility and other hardware gates (models/gpt.py, ops ring).
+
+    Forced-CPU contexts short-circuit WITHOUT touching jax.devices():
+    the session's accelerator plugin initializes the remote backend even
+    when the platform priority list starts with cpu, and a wedged tunnel
+    then hangs the probe (observed r4: backend init hung under
+    JAX_PLATFORMS=cpu)."""
+    import os
+    import jax
+    plats = (getattr(jax.config, "jax_platforms", None)
+             or os.environ.get("JAX_PLATFORMS", ""))
+    if plats and plats.split(",")[0].strip() == "cpu":
+        return False
     try:
-        import jax
         d = jax.devices()[0]
         return "tpu" in (d.platform + d.device_kind).lower()
     except Exception:
