@@ -1,7 +1,9 @@
 from deepspeed_tpu.autotuning.autotuner import Autotuner
-from deepspeed_tpu.autotuning.scheduler import Experiment, ResourceManager
+from deepspeed_tpu.autotuning.scheduler import (
+    Experiment, ExperimentError, ResourceManager, SubprocessRunner)
 from deepspeed_tpu.autotuning.tuner import (
     BaseTuner, GridSearchTuner, ModelBasedTuner, RandomTuner)
 
-__all__ = ["Autotuner", "Experiment", "ResourceManager", "BaseTuner",
-           "GridSearchTuner", "ModelBasedTuner", "RandomTuner"]
+__all__ = ["Autotuner", "Experiment", "ExperimentError", "ResourceManager",
+           "SubprocessRunner", "BaseTuner", "GridSearchTuner",
+           "ModelBasedTuner", "RandomTuner"]

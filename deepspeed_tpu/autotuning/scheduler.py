@@ -2,14 +2,24 @@
 
 Capability match for the reference's ``ResourceManager``
 (ref: deepspeed/autotuning/scheduler.py:35): owns the experiment queue,
-dispatches experiments, records results. The reference launches each
-experiment as a multi-node job over a hostfile; on a TPU host the
-experiment is an in-process engine build + timed steps, so the runner
-is a callable — the queue/records/result-path API stays.
+dispatches experiments, records results. Two dispatch modes:
+
+- an in-process callable (fresh engine + timed steps) for cheap local
+  sweeps, and
+- ``SubprocessRunner`` — each experiment in its own OS process with a
+  wall-clock timeout and OOM/compile-failure classification, the analog
+  of the reference launching every experiment as a separate job
+  (ref: scheduler.py:35 run_job + :183 parse_results). Process
+  isolation is what makes unattended tuning safe here: a diverging
+  candidate, a borderline-HBM compile, or a wedged remote compile
+  helper costs its own timeout, never the tuning loop.
 """
 
 import json
 import os
+import subprocess
+import sys
+import tempfile
 from typing import Any, Callable, Dict, List, Optional
 
 from deepspeed_tpu.utils.logging import logger
@@ -26,6 +36,100 @@ class Experiment:
     def as_record(self) -> Dict[str, Any]:
         return {"name": self.name, "ds_config": self.ds_config,
                 "metric_val": self.metric_val, "error": self.error}
+
+
+class ExperimentError(RuntimeError):
+    """A failed experiment with a classified kind: 'timeout' (hung or
+    over-budget), 'oom' (device/host memory exhaustion), or 'error'
+    (everything else). The tuning loop treats all three as a lost
+    experiment, but the kind is recorded so an unattended sweep's log
+    shows WHY configs were rejected (ref: the reference's per-job
+    error capture in scheduler.py:128 run_job)."""
+
+    def __init__(self, kind: str, detail: str):
+        super().__init__(f"{kind}: {detail}")
+        self.kind = kind
+        self.detail = detail
+
+
+_OOM_MARKERS = ("resource_exhausted", "out of memory", "memoryerror",
+                "oom", "failed to allocate", "hbm limit")
+
+
+class SubprocessRunner:
+    """Run each experiment in its own OS process with a timeout.
+
+    Exactly one of ``cmd`` / ``cmd_builder``:
+    - ``cmd``: argv prefix; the experiment's ds_config is written to a
+      temp JSON file whose path is appended (the reference's pattern of
+      materializing exp_dir/ds_config.json per job, scheduler.py:35).
+    - ``cmd_builder(ds_config) -> argv``: full control (e.g. embedding
+      the spec in a ``python -c`` template).
+
+    The child must print a JSON line ``{"metric": <float>}`` (override
+    with ``parse(stdout) -> float`` for other formats). Non-zero exit,
+    hang, or unparsable output raise ``ExperimentError`` with a
+    classified kind.
+    """
+
+    def __init__(self, cmd: Optional[List[str]] = None, *,
+                 cmd_builder: Optional[Callable[[Dict], List[str]]] = None,
+                 parse: Optional[Callable[[str], float]] = None,
+                 timeout_s: float = 1800.0, env: Optional[Dict] = None,
+                 cwd: Optional[str] = None):
+        assert (cmd is None) != (cmd_builder is None), \
+            "exactly one of cmd / cmd_builder"
+        self.cmd = cmd
+        self.cmd_builder = cmd_builder
+        self.parse = parse or self._parse_metric_line
+        self.timeout_s = timeout_s
+        self.env = env
+        self.cwd = cwd
+        self.last_stdout: str = ""
+
+    @staticmethod
+    def _parse_metric_line(stdout: str) -> float:
+        for line in reversed(stdout.splitlines()):
+            line = line.strip()
+            if line.startswith("{") and '"metric"' in line:
+                try:
+                    return float(json.loads(line)["metric"])
+                except (ValueError, KeyError, TypeError):
+                    continue
+        raise ExperimentError("error", "no {\"metric\": ...} line in output")
+
+    def __call__(self, ds_config: Dict) -> float:
+        tmp = None
+        if self.cmd_builder is not None:
+            argv = self.cmd_builder(ds_config)
+        else:
+            fd, tmp = tempfile.mkstemp(suffix=".json", prefix="ds_exp_")
+            with os.fdopen(fd, "w") as f:
+                json.dump(ds_config, f)
+            argv = list(self.cmd) + [tmp]
+        env = dict(os.environ) if self.env is None else dict(self.env)
+        try:
+            try:
+                r = subprocess.run(argv, capture_output=True, text=True,
+                                   timeout=self.timeout_s, env=env,
+                                   cwd=self.cwd)
+            except subprocess.TimeoutExpired:
+                raise ExperimentError(
+                    "timeout", f"exceeded {self.timeout_s:.0f}s wall clock")
+            self.last_stdout = r.stdout or ""
+            if r.returncode != 0:
+                blob = ((r.stderr or "") + (r.stdout or "")).lower()
+                kind = ("oom" if any(m in blob for m in _OOM_MARKERS)
+                        else "error")
+                raise ExperimentError(
+                    kind, f"rc={r.returncode}: {(r.stderr or '')[-400:]}")
+            return float(self.parse(self.last_stdout))
+        finally:
+            if tmp is not None:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
 
 
 class ResourceManager:

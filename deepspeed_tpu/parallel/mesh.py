@@ -86,7 +86,15 @@ def mesh_from_config(cfg, devices: Optional[Sequence] = None) -> Mesh:
     assert n % fixed == 0, f"{n} devices not divisible by pp*tp*sp={fixed}"
     dp_total = n // fixed
     if cfg.zero.stage == 3:
-        spec = MeshSpec(pipe=m.pipeline_parallel_size, data=1, fsdp=dp_total,
+        # replica_parallel_size splits dp into outer 'data' replicas
+        # (the DCN-crossing axis dcn_compressed rides) x inner 'fsdp'
+        # param shards (PERF.md "Compressed DCN x ZeRO-fsdp")
+        rep = m.replica_parallel_size
+        assert dp_total % rep == 0, (
+            f"replica_parallel_size={rep} does not divide the dp degree "
+            f"{dp_total}")
+        spec = MeshSpec(pipe=m.pipeline_parallel_size, data=rep,
+                        fsdp=dp_total // rep,
                         sequence=m.sequence_parallel_size,
                         model=m.tensor_parallel_size)
     else:

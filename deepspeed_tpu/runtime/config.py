@@ -230,6 +230,10 @@ class MeshConfig:
     pipeline_parallel_size: int = 1
     sequence_parallel_size: int = 1
     expert_parallel_size: int = 1
+    # ZeRO-3 only: number of outer 'data' replicas (the DCN-crossing
+    # axis); the remaining dp degree shards params over 'fsdp' inside
+    # each replica. 1 = the default all-fsdp layout.
+    replica_parallel_size: int = 1
 
     @staticmethod
     def from_dict(d: Optional[Dict]) -> "MeshConfig":
@@ -244,6 +248,8 @@ class MeshConfig:
                 d, C.SEQUENCE_PARALLEL_SIZE, C.SEQUENCE_PARALLEL_SIZE_DEFAULT),
             expert_parallel_size=get_scalar_param(
                 d, C.EXPERT_PARALLEL_SIZE, C.EXPERT_PARALLEL_SIZE_DEFAULT),
+            replica_parallel_size=get_scalar_param(
+                d, C.REPLICA_PARALLEL_SIZE, C.REPLICA_PARALLEL_SIZE_DEFAULT),
         )
 
 

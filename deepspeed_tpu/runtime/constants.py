@@ -159,6 +159,12 @@ SEQUENCE_PARALLEL_SIZE = "sequence_parallel_size"
 SEQUENCE_PARALLEL_SIZE_DEFAULT = 1
 EXPERT_PARALLEL_SIZE = "expert_parallel_size"
 EXPERT_PARALLEL_SIZE_DEFAULT = 1
+# ZeRO-3 only: split the dp degree into replica_parallel_size outer
+# 'data' replicas (the DCN-crossing axis) x fsdp shards inside each
+# replica — the layout dcn_compressed composes with (PERF.md
+# "Compressed DCN x ZeRO-fsdp")
+REPLICA_PARALLEL_SIZE = "replica_parallel_size"
+REPLICA_PARALLEL_SIZE_DEFAULT = 1
 
 #############################################
 # Pipeline engine

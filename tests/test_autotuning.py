@@ -164,3 +164,92 @@ def test_tune_end_to_end(tmp_path, devices):
     at.print_tuning_results()  # must not raise
     # experiment records were persisted
     assert any(f.endswith(".json") for f in os.listdir(tmp_path / "results"))
+
+
+# ------------------------------------- subprocess experiment dispatch
+# (VERDICT r4 #6: the reference schedules every experiment as its own
+#  job with failure capture — ref: autotuning/scheduler.py:35 run_job,
+#  :183 parse_results; here that is SubprocessRunner + classified
+#  ExperimentError kinds)
+
+import subprocess
+import sys
+
+from deepspeed_tpu.autotuning import ExperimentError, SubprocessRunner
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_subprocess_runner_success_and_config_file():
+    """Default mode: ds_config lands in a temp JSON whose path is argv[-1]
+    (the reference's per-job materialized ds_config.json)."""
+    code = ("import json,sys; cfg=json.load(open(sys.argv[1])); "
+            "print(json.dumps({'metric': cfg['mbs'] * 2.0}))")
+    r = SubprocessRunner([sys.executable, "-c", code], timeout_s=60)
+    assert r({"mbs": 4}) == 8.0
+
+
+def test_subprocess_runner_classifies_timeout():
+    r = SubprocessRunner([sys.executable, "-c",
+                          "import time; time.sleep(30)"], timeout_s=1)
+    with pytest.raises(ExperimentError) as ei:
+        r({})
+    assert ei.value.kind == "timeout"
+
+
+def test_subprocess_runner_classifies_oom():
+    code = ("import sys; sys.stderr.write('RESOURCE_EXHAUSTED: failed to "
+            "allocate 9.9G\\n'); sys.exit(1)")
+    r = SubprocessRunner([sys.executable, "-c", code], timeout_s=60)
+    with pytest.raises(ExperimentError) as ei:
+        r({})
+    assert ei.value.kind == "oom"
+
+
+def test_subprocess_runner_failures_dont_kill_the_sweep():
+    """A hung + an OOMing + a healthy experiment: the loop finishes,
+    records the two classified losses, and best() is the survivor."""
+    flaky = {"hang": "import time; time.sleep(30)",
+             "oom": ("import sys; sys.stderr.write('out of memory'); "
+                     "sys.exit(1)"),
+             "ok": "import json; print(json.dumps({'metric': 7.0}))"}
+    r = SubprocessRunner(
+        cmd_builder=lambda cfg: [sys.executable, "-c", flaky[cfg["kind"]]],
+        timeout_s=3)
+    rm = ResourceManager(r)
+    rm.schedule_experiments(
+        [Experiment(k, {"kind": k}) for k in ("hang", "oom", "ok")])
+    rm.run()
+    assert len(rm.finished_experiments) == 3
+    errs = {e.name: e.error for e in rm.finished_experiments}
+    assert "timeout" in errs["hang"] and "oom" in errs["oom"]
+    assert rm.best().name == "ok" and rm.best().metric_val == 7.0
+
+
+def test_autotune_headline_rehearsal_end_to_end(tmp_path):
+    """The chip-drivable tool's whole loop on the CPU backend: guard ->
+    subprocess experiments -> cost-model tuner -> AUTOTUNE_BEST.json.
+    The tiny space's real lever is the micro-batch, so the tuned pick
+    must not be the smallest batch."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "tools/autotune_headline.py", "--rehearse",
+         "--trials", "6", "--early-stop", "6", "--timeout", "240",
+         "--out-dir", str(tmp_path)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
+    assert r.returncode == 0, r.stdout + r.stderr
+    lines = [json.loads(l) for l in r.stdout.splitlines()
+             if l.strip().startswith("{")]
+    summary = lines[-1]
+    assert summary["autotune"] == "done", summary
+    assert summary["ran"] >= 3
+    assert "best" in summary, summary
+    art = json.load(open(tmp_path / "AUTOTUNE_BEST.json"))
+    assert art["chosen_from"] == summary["best"]
+    assert art["tokens_per_s"] == summary["tokens_per_s"]
+    assert art["batch"] > 4, "tuner picked the smallest batch — " \
+                             "cost-model ordering is not working"
+    # per-experiment records persisted (ref parse_results analog)
+    recs = os.listdir(tmp_path / "autotuning_results" / "headline")
+    assert len([f for f in recs if f.endswith(".json")]) >= 3

@@ -209,6 +209,105 @@ def test_two_process_sequence_parallel(impl):
     assert results[0]["losses"][-1] < results[0]["losses"][0]
 
 
+FSDPX_WORKER = textwrap.dedent("""
+    import json, os, sys
+    sys.path.insert(0, os.environ["DSTPU_TEST_REPO"])
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from deepspeed_tpu.utils import distributed as dist
+    dist.init_distributed()
+    rank = dist.get_rank()
+
+    import numpy as np
+    import jax.numpy as jnp
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt
+    from deepspeed_tpu.parallel.mesh import MESH_AXES
+
+    # Mesh where the FSDP axis crosses the process boundary: fsdp
+    # partners (adjacent in the minor mesh dim) live in DIFFERENT
+    # processes, so ZeRO-3's param gathers and the exact grad
+    # reduce-scatter run through real inter-process collectives while
+    # the 1-bit 'data' wire crosses processes too.
+    devs = jax.devices()
+    by_proc = [[d for d in devs if d.process_index == p] for p in (0, 1)]
+    order = [by_proc[0][0], by_proc[1][0], by_proc[0][1], by_proc[1][1]]
+    mesh = jax.sharding.Mesh(
+        np.asarray(order).reshape(1, 2, 2, 1, 1), MESH_AXES)
+
+    cfg = gpt.GPTConfig(vocab_size=128, n_layers=2, n_heads=2, d_model=32,
+                        max_seq_len=32, dtype=jnp.float32,
+                        use_flash_attention=False, remat=False)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt.make_loss_fn(cfg), model_parameters=params,
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "comm_backend_name": "dcn_compressed",
+                "zero_optimization": {"stage": 3,
+                                      "stage3_min_shard_size": 1},
+                "steps_per_print": 10_000},
+        mesh=mesh)
+
+    tokens = np.random.default_rng(0).integers(
+        0, 128, (8, 17)).astype(np.int32)
+    losses = [float(engine.train_batch({"tokens": tokens})["loss"])
+              for _ in range(3)]
+    qkv = engine.state.params["block"]["qkv"]["kernel"]
+    fsdp_cross = [d.process_index for d in qkv.sharding.device_set]
+    print("RESULT " + json.dumps({
+        "rank": rank, "losses": losses,
+        "qkv_shard": list(qkv.sharding.shard_shape(qkv.shape)),
+        "param_procs": sorted(set(fsdp_cross))}))
+""")
+
+
+def test_two_process_dcn_compressed_fsdp_crossing():
+    """Compressed x fsdp with the FSDP axis crossing the process
+    boundary (VERDICT r4 #4): ZeRO-3 param sharding + exact grad
+    reduction over inter-process fsdp collectives, 1-bit error-feedback
+    wire over 'data' — and the trajectory must match the identical
+    single-process global arithmetic, because process placement is a
+    layout choice, not a math change."""
+    results = _spawn(2, worker=FSDPX_WORKER)
+    assert results[0]["losses"] == pytest.approx(results[1]["losses"],
+                                                 rel=1e-5)
+    assert results[0]["losses"][-1] < results[0]["losses"][0]
+    # params genuinely sharded, across BOTH processes
+    assert results[0]["param_procs"] == [0, 1]
+
+    # single-process oracle: same global mesh shape / config / data on 4
+    # of this process's virtual devices
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt
+    from deepspeed_tpu.parallel.mesh import MESH_AXES
+
+    mesh = jax.sharding.Mesh(
+        np.asarray(jax.devices()[:4]).reshape(1, 2, 2, 1, 1), MESH_AXES)
+    cfg = gpt.GPTConfig(vocab_size=128, n_layers=2, n_heads=2, d_model=32,
+                        max_seq_len=32, dtype=jnp.float32,
+                        use_flash_attention=False, remat=False)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt.make_loss_fn(cfg), model_parameters=params,
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "comm_backend_name": "dcn_compressed",
+                "zero_optimization": {"stage": 3,
+                                      "stage3_min_shard_size": 1},
+                "steps_per_print": 10_000},
+        mesh=mesh)
+    tokens = np.random.default_rng(0).integers(
+        0, 128, (8, 17)).astype(np.int32)
+    oracle = [float(engine.train_batch({"tokens": tokens})["loss"])
+              for _ in range(3)]
+    assert results[0]["losses"] == pytest.approx(oracle, rel=1e-5)
+
+
 @pytest.mark.parametrize("stage", ["1", "2"])
 def test_two_process_dcn_compressed(stage):
     """The compressed wire path (comm_backend_name='dcn_compressed')

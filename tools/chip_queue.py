@@ -11,11 +11,18 @@ Items default to the full queue; each prints its JSON line(s) as it lands.
 """
 
 import json
+import os
 import subprocess
 import sys
 import time
 
 HEALTH = (
+    # honor an explicit JAX_PLATFORMS request (the recovery REHEARSAL
+    # probes the CPU backend); with no request this probes the real
+    # accelerator exactly as before
+    "import sys; sys.path.insert(0, '.')\n"
+    "from deepspeed_tpu.utils import honor_platform_request\n"
+    "honor_platform_request()\n"
     "import jax, jax.numpy as jnp\n"
     "print('devices', jax.devices())\n"
     "print('ok', float(jax.jit(lambda a: (a@a).sum())"
@@ -56,14 +63,32 @@ QUEUE = [
     ("longcontext", [sys.executable, "tools/longcontext_bench.py", "chip"],
      4800),
     ("infer", [sys.executable, "tools/infer_bench.py"], 3600),
+    # unattended autotune over the headline family (guard-pruned,
+    # subprocess-isolated experiments; prints probe-format lines so
+    # pick_headline weighs them with the same margin logic)
+    ("autotune", [sys.executable, "tools/autotune_headline.py",
+                  "--trials", "8", "--timeout", "1500"], 13500),
     # the quarantined window compiles, dead last
     ("flash-smoke-window", [sys.executable, "tools/flash_chip_smoke.py",
                             "window", "window+gqa+segs",
                             "ring-blocks-window"], 1800),
+    # CPU-backend rehearsal of the recovery cycle (refuses to run
+    # without DS_REHEARSAL=1, never on a TPU backend) — exercised by
+    # tests/test_rig_recovery.py, never part of the default queue
+    ("probe-rehearsal", [sys.executable, "tools/rehearse_probe.py"], 900),
 ]
+# default drain excludes rehearsal-only items
+DEFAULT_ITEMS = [q[0] for q in QUEUE if q[0] != "probe-rehearsal"]
 
 
 def healthy(timeout=180):
+    # fault injection for the recovery-rehearsal down-path test; shout
+    # so a lingering env var can never masquerade as a dead rig
+    if os.environ.get("DS_CHIP_FORCE_DOWN"):
+        print(json.dumps({"probe": "DS_CHIP_FORCE_DOWN override active — "
+                                   "reporting down WITHOUT probing"}),
+              flush=True)
+        return False
     try:
         r = subprocess.run([sys.executable, "-c", HEALTH],
                            capture_output=True, text=True, timeout=timeout)
@@ -73,8 +98,8 @@ def healthy(timeout=180):
 
 
 def main():
-    wanted = sys.argv[1:]
-    items = [q for q in QUEUE if not wanted or q[0] in wanted]
+    wanted = sys.argv[1:] or DEFAULT_ITEMS
+    items = [q for q in QUEUE if q[0] in wanted]
     for name, cmd, tmo in items:
         # retry the probe a few times before giving an item up — a
         # transient tunnel wedge must not drop a whole measurement set

@@ -26,7 +26,7 @@ from tools._subproc import run_json  # noqa: E402
 
 _D = dict(preset="gpt2-1.5b", batch=16, remat=True, pol="full",
           lc=2048, stage=3, me=True, fb=1024, fbkv=None,
-          bwdq=None, bwdkv=None)
+          bwdq=None, bwdkv=None, seq=1024, steps=8)
 
 
 def _v(**kw):
@@ -89,7 +89,9 @@ s = {spec!r}
 overrides = {{"zero_optimization": {{"stage": s["stage"]}}}}
 if s["me"]:
     overrides["bf16"] = {{"enabled": True, "memory_efficient": True}}
-dt, tps, mfu = run_config(s["preset"], s["batch"], 1024, 8, overrides, True,
+on_tpu = s.get("on_tpu", True)
+dt, tps, mfu = run_config(s["preset"], s["batch"], s["seq"], s["steps"],
+                          overrides, on_tpu,
                           flash_block=s["fb"], flash_block_kv=s["fbkv"],
                           remat_pol=s["pol"], loss_chunk=s["lc"],
                           remat=s["remat"], bwd_block_q=s["bwdq"],
@@ -113,11 +115,12 @@ def guard_variant(name, s, hbm_gib=None):
     import jax.numpy as jnp
     from deepspeed_tpu.models import gpt
     from deepspeed_tpu.utils import hbm
-    cfg = gpt.preset(s["preset"], max_seq_len=1024, dtype=jnp.bfloat16,
+    seq = s.get("seq", 1024)
+    cfg = gpt.preset(s["preset"], max_seq_len=seq, dtype=jnp.bfloat16,
                      remat=s["remat"], remat_policy=s["pol"],
                      loss_chunk=s["lc"])
     est = hbm.estimate_gpt_train_bytes(
-        cfg, s["batch"], 1024, memory_efficient=s["me"],
+        cfg, s["batch"], seq, memory_efficient=s["me"],
         precision="bf16")
     return hbm.check_compile_safe(est, hbm_gib * hbm.GiB)
 

@@ -23,7 +23,7 @@ OUT = os.path.join(ROOT, "BENCH_HEADLINE.json")
 INCUMBENT = "b16-full-ce"
 
 
-def parse_results(path):
+def parse_results(path, allow_rehearsal=False):
     out = {}
     with open(path) as f:
         for line in f:
@@ -35,6 +35,12 @@ def parse_results(path):
             except ValueError:
                 continue
             if rec.get("preset") != "gpt2-1.5b":
+                continue
+            # rehearsal lines carry the headline preset label but FAKE
+            # numbers (tools/rehearse_probe.py); they may only influence
+            # a decision explicitly redirected away from the real
+            # BENCH_HEADLINE.json (--out)
+            if rec.get("rehearsal") and not allow_rehearsal:
                 continue
             if not rec.get("tokens_per_s"):
                 continue
@@ -65,9 +71,15 @@ def main():
     ap.add_argument("--margin", type=float, default=0.01,
                     help="fractional tokens/s gain required to flip")
     ap.add_argument("--apply", action="store_true")
+    ap.add_argument("--out", default=None,
+                    help="write target (default repo BENCH_HEADLINE.json; "
+                         "the recovery rehearsal points this at a tmp path)")
     args = ap.parse_args()
+    global OUT
+    if args.out:
+        OUT = args.out
 
-    res = parse_results(args.log)
+    res = parse_results(args.log, allow_rehearsal=args.out is not None)
     if not res:
         print(json.dumps({"decision": "no results parsed"}))
         return

@@ -14,6 +14,7 @@ trace) — fast enough to leave chip time for targeted follow-ups.
 
 import argparse
 import json
+import os
 import subprocess
 import sys
 import time
@@ -23,8 +24,11 @@ sys.path.insert(0, ".")
 from tools.chip_queue import healthy  # noqa: E402
 
 PHASE1 = ["flash-smoke", "probe", "trace-1.5b"]
-POLL_S = 300          # probe cadence while down
-CONFIRM_S = 45        # gap between the two confirming probes
+# cadence is env-overridable so the recovery cycle can be REHEARSED on
+# the CPU backend (tests/test_rig_recovery.py) at second-scale timings —
+# the automation gets a test before its one shot at the real rig
+POLL_S = int(os.environ.get("DS_RIGWATCH_POLL_S", 300))
+CONFIRM_S = int(os.environ.get("DS_RIGWATCH_CONFIRM_S", 45))
 
 
 def log(**kw):
@@ -34,6 +38,11 @@ def log(**kw):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--deadline-hours", type=float, default=10.0)
+    ap.add_argument("--results", default="chipq_results.log",
+                    help="queue output file (rehearsal uses a tmp path)")
+    ap.add_argument("--pick-out", default=None,
+                    help="override pick_headline's BENCH_HEADLINE.json "
+                         "target (rehearsal only)")
     ap.add_argument("items", nargs="*", default=None)
     args = ap.parse_args()
     items = args.items or PHASE1
@@ -62,17 +71,19 @@ def main():
     # the queue writes the results file DIRECTLY as its stdout (fresh
     # per run): the measurements survive a dead watcher, and the
     # unattended headline decision below reads only this run's lines
-    results_path = "chipq_results.log"
+    results_path = args.results
     with open(results_path, "w") as res:
         rc = subprocess.run(
             [sys.executable, "tools/chip_queue.py"] + items,
             stdout=res, stderr=subprocess.STDOUT).returncode
     log(event="queue done", rc=rc, results=results_path,
         minutes=round((time.time() - t0) / 60, 1))
-    if "probe" in items:
-        d = subprocess.run([sys.executable, "tools/pick_headline.py",
-                            results_path, "--apply"],
-                           capture_output=True, text=True)
+    if any("probe" in it for it in items):
+        cmd = [sys.executable, "tools/pick_headline.py",
+               results_path, "--apply"]
+        if args.pick_out:
+            cmd += ["--out", args.pick_out]
+        d = subprocess.run(cmd, capture_output=True, text=True)
         log(event="headline decision", out=d.stdout.strip()[-400:],
             err=d.stderr.strip()[-400:], rc=d.returncode)
 
