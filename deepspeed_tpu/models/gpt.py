@@ -95,6 +95,11 @@ class GPTConfig:
     # sliding-window (local) attention: token i attends (i-window, i]
     # only — O(S*window) compute and HBM reads in the flash kernel
     attn_window: Optional[int] = None
+    # "banded" (O(S*W) index-map clamps) or "masked" (in-body mask over
+    # plain causal geometry — the Mosaic-proven fallback while the
+    # banded clamp is under the r4 compile-hang quarantine); None
+    # resolves from DS_FLASH_WINDOW_IMPL (default banded)
+    attn_window_impl: Optional[str] = None
     # --- llama-family architecture knobs -------------------------------
     # norm: 'layernorm' (GPT-2) or 'rmsnorm' (llama — scale only, no
     # mean subtraction); activation: 'gelu' or 'swiglu' (gated MLP with
@@ -289,11 +294,34 @@ def _kernel_of(p, dtype):
     return p["kernel"].astype(dtype)
 
 
+def _int8_fused_enabled() -> bool:
+    """DS_INT8_FUSED=1 routes int8 dense entries through the Pallas
+    fused dequant-matmul (ops/int8_matmul.py) instead of trusting XLA
+    to fuse _kernel_of's dequant — the fallback the reference covers
+    with dedicated int8 GEMM kernels (ref: csrc/transformer/inference
+    pt_binding.cpp:866). TPU-only: the kernel needs Mosaic."""
+    import os
+
+    from deepspeed_tpu.utils import on_tpu
+    return os.environ.get("DS_INT8_FUSED") == "1" and on_tpu()
+
+
 def _dense(h, p):
     """h @ kernel (+ bias when the config kept biases). A LoRA-adapted
     entry (runtime/lora.py) adds the low-rank path h @ A @ B * scale —
     the dense delta is never materialized."""
-    y = h @ _kernel_of(p, h.dtype)
+    blocks = None
+    if "q" in p and p["q"].ndim == 2 and _int8_fused_enabled():
+        from deepspeed_tpu.ops.int8_matmul import fit_blocks, int8_matmul
+        blocks = fit_blocks(*p["q"].shape)
+    if blocks is not None:
+        lead, K = h.shape[:-1], h.shape[-1]
+        y = int8_matmul(h.reshape(-1, K), p["q"],
+                        p["scale"].reshape(1, -1),
+                        block_k=blocks[0], block_n=blocks[1])
+        y = y.reshape(*lead, y.shape[-1])
+    else:
+        y = h @ _kernel_of(p, h.dtype)
     if "lora_a" in p:
         y = y + ((h @ p["lora_a"].astype(h.dtype))
                  @ p["lora_b"].astype(h.dtype))             * p["lora_scale"].astype(h.dtype)
@@ -383,6 +411,7 @@ def _attention(q, k, v, cfg: GPTConfig, segment_ids=None, kv_mask=None):
                 block_kv=blocks[1] if blocks else cfg.flash_block_kv,
                 segment_ids=segment_ids, kv_mask=kv_mask,
                 window=cfg.attn_window,
+                window_impl=cfg.attn_window_impl,
                 bwd_block_q=(_effective_block(cfg.flash_block_bwd_q, S)
                              if cfg.flash_block_bwd_q else None),
                 bwd_block_kv=(_effective_block(cfg.flash_block_bwd_kv, S)
@@ -402,7 +431,8 @@ def _attention(q, k, v, cfg: GPTConfig, segment_ids=None, kv_mask=None):
             window=cfg.attn_window, use_flash=blocks is not None,
             block_q=blocks[0] if blocks else 512,
             block_kv=blocks[1] if blocks else 512,
-            layout=cfg.sp_layout)
+            layout=cfg.sp_layout,
+            window_impl=cfg.attn_window_impl)
     blocks = _flash_blocks(cfg, q.shape[1])
     if blocks is not None:
         from deepspeed_tpu.ops.attention.flash import flash_attention
@@ -418,6 +448,7 @@ def _attention(q, k, v, cfg: GPTConfig, segment_ids=None, kv_mask=None):
                                block_q=blocks[0], block_kv=blocks[1],
                                segment_ids=segment_ids, kv_mask=kv_mask,
                                window=cfg.attn_window,
+                               window_impl=cfg.attn_window_impl,
                                bwd_block_q=bwd_q, bwd_block_kv=bwd_kv)
     from deepspeed_tpu.ops.attention.flash import mha_reference
     return mha_reference(q, k, v, causal=True, scale=scale,

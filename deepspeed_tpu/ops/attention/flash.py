@@ -19,6 +19,7 @@ All matmuls hit the MXU in the input dtype with fp32 accumulation
 """
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -29,6 +30,44 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+
+
+def _norm_window(window):
+    """Decode the static ``window`` argument into
+    ``(mask_window, band_window)``.
+
+    ``window`` is either an int — the BANDED implementation: DMA-eliding
+    index-map clamps + band-aware grid skipping + in-body mask — or the
+    tagged tuple ``("masked", int)`` — the FALLBACK that expresses the
+    sliding window purely as an in-body mask over the plain causal
+    geometry. The fallback exists because the banded index-map clamp is
+    the prime suspect in the round-4 on-chip Mosaic compile hang
+    (STATUS.md "Rig situation"; bisect: tools/flash_window_bisect.py):
+    it uses ONLY constructs already proven through real Mosaic (the
+    causal clamp/skip and the causal-mask `where` pattern from the
+    'plain' smoke case). Cost: O(S^2) HBM reads/compute like plain
+    causal instead of O(S*W) — correctness is identical because fully
+    out-of-band blocks wash out of the online softmax exactly like
+    fully-masked kv_mask blocks (see flash_attention docstring)."""
+    if window is None:
+        return None, None
+    if isinstance(window, tuple):
+        impl, w = window
+        assert impl == "masked", f"unknown window impl {impl!r}"
+        return int(w), None
+    return int(window), int(window)
+
+
+def resolve_window_impl(window, window_impl=None):
+    """Tag ``window`` for the masked fallback when requested (explicit
+    arg wins, else DS_FLASH_WINDOW_IMPL, default banded). Shared by
+    every window entry point (flash_attention, ring, ulysses) so the
+    PARITY.md quarantine advice works uniformly."""
+    if window is None or isinstance(window, tuple):
+        return window
+    impl = window_impl or os.environ.get("DS_FLASH_WINDOW_IMPL", "banded")
+    assert impl in ("banded", "masked"), impl
+    return ("masked", int(window)) if impl == "masked" else int(window)
 LANES = 128
 STATS = 8   # lane width for per-row softmax stats (lse/delta) — sublane-aligned
 
@@ -54,6 +93,8 @@ def _causal_kv_index_map(block_q, block_kv, num_kv, window=None, q_off=0):
     the ring loop is unrolled), so all causal/window geometry shifts by
     it."""
 
+    window = _norm_window(window)[1]     # banded geometry only
+
     def kvmap(b, h, qi, ki):
         limit = jnp.minimum((qi * block_q + block_q - 1 + q_off) // block_kv,
                             num_kv - 1)
@@ -69,6 +110,7 @@ def _causal_kv_index_map(block_q, block_kv, num_kv, window=None, q_off=0):
 
 def _band_run(qi, ki, block_q, block_kv, causal, window, q_off=0):
     """Whether grid step (qi, ki) intersects the attention band."""
+    window = _norm_window(window)[1]     # banded geometry only
     run = True
     if causal:
         run = qi * block_q + block_q - 1 + q_off >= ki * block_kv
@@ -124,7 +166,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
             cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ki * block_kv
             s = jnp.where(rows >= cols, s, NEG_INF)
             if window is not None:
-                s = _window_mask(s, rows, cols, window)
+                s = _window_mask(s, rows, cols, _norm_window(window)[0])
         if has_mask:
             s = jnp.where(mask_ref[0, 0][None, :] > 0, s, NEG_INF)
         if has_segs:
@@ -300,7 +342,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ki * block_kv
             s = jnp.where(rows >= cols, s, NEG_INF)
             if window is not None:
-                s = _window_mask(s, rows, cols, window)
+                s = _window_mask(s, rows, cols, _norm_window(window)[0])
         if has_mask:
             s = jnp.where(mask_ref[0, 0][None, :] > 0, s, NEG_INF)
         if has_segs:
@@ -362,7 +404,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ki * block_kv
             s = jnp.where(rows >= cols, s, NEG_INF)
             if window is not None:
-                s = _window_mask(s, rows, cols, window)
+                s = _window_mask(s, rows, cols, _norm_window(window)[0])
         if has_mask:
             s = jnp.where(mask_ref[0, 0][None, :] > 0, s, NEG_INF)
         if has_segs:
@@ -462,13 +504,15 @@ def _flash_bwd(causal, scale, block_q, block_kv, window, res, g, q_off=0,
         # valid for the last kv blocks). With a sliding window the LAST
         # valid q block is bounded too — late steps clamp down the same
         # way.
+        band_w = _norm_window(window)[1]   # banded geometry only
+
         def qmap_kv_outer(b, h, ki, qi):
             first = jnp.clip((ki * block_kv - q_off) // block_q,
                              0, num_q - 1)
             qi = jnp.maximum(qi, first)
-            if window is not None:
+            if band_w is not None:
                 last = jnp.clip(
-                    (ki * block_kv + block_kv - 1 + window - 1 - q_off)
+                    (ki * block_kv + block_kv - 1 + band_w - 1 - q_off)
                     // block_q,
                     0, num_q - 1)
                 qi = jnp.minimum(qi, last)
@@ -591,7 +635,8 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     segment_ids: Optional[jnp.ndarray] = None,
                     window: Optional[int] = None,
                     bwd_block_q: Optional[int] = None,
-                    bwd_block_kv: Optional[int] = None) -> jnp.ndarray:
+                    bwd_block_kv: Optional[int] = None,
+                    window_impl: Optional[str] = None) -> jnp.ndarray:
     """Flash attention over [B, S, H, D] tensors.
 
     Head dims that are sublane-aligned (multiple of 8) run unpadded: Mosaic
@@ -621,6 +666,13 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     window: optional sliding-window size (requires causal): token i
     attends tokens (i-window, i] only — O(S*window) compute AND HBM
     reads (out-of-band blocks' fetches are elided via index-map clamps).
+
+    window_impl: "banded" (default; also via DS_FLASH_WINDOW_IMPL) keeps
+    the O(S*W) index-map clamps; "masked" is the fallback that expresses
+    the window purely as an in-body mask over plain causal geometry —
+    O(S^2) reads, but built ONLY from constructs proven through real
+    Mosaic (see _norm_window; the banded clamp is the r4 compile-hang
+    suspect, quarantined until tools/flash_window_bisect.py clears it).
     """
     B, S, H, D = q.shape
     Hkv = k.shape[2]
@@ -633,7 +685,8 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         assert k.shape[1] == S, "segment_ids requires self-attention (Skv == S)"
     if window is not None:
         assert causal, "sliding window attention requires causal=True"
-        assert window >= 1
+        assert isinstance(window, tuple) or window >= 1
+        window = resolve_window_impl(window, window_impl)
     q, k, v, D, Dp = _pad_heads(q, k, v)
     # kernel-internal layout is [B, H, S, D]
     q = q.transpose(0, 2, 1, 3)

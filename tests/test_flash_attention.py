@@ -232,6 +232,63 @@ def test_sliding_window_model_matches_reference(devices):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.parametrize("window", [32, 100, 256])
+def test_sliding_window_masked_impl_forward_parity(devices, window):
+    """The "masked" fallback (in-body mask over plain causal geometry —
+    the Mosaic-proven construct set; see _norm_window) must match both
+    the dense reference and the banded implementation exactly: the two
+    impls differ only in which blocks are fetched/skipped, never in
+    what any in-band block computes."""
+    q, k, v = _rand_qkv(B=1, S=512, H=2, D=32)
+    masked = F.flash_attention(q, k, v, causal=True, block_q=128,
+                               block_kv=128, window=window,
+                               window_impl="masked")
+    ref = F.mha_reference(q, k, v, causal=True, window=window)
+    np.testing.assert_allclose(np.asarray(masked), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+    banded = F.flash_attention(q, k, v, causal=True, block_q=128,
+                               block_kv=128, window=window,
+                               window_impl="banded")
+    np.testing.assert_allclose(np.asarray(masked), np.asarray(banded),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_sliding_window_masked_impl_grads_parity(devices):
+    q, k, v = _rand_qkv(B=1, S=512, H=2, D=32, seed=11)
+    W = 96
+
+    def loss_m(q, k, v):
+        return (F.flash_attention(q, k, v, causal=True, block_q=128,
+                                  block_kv=128, window=W,
+                                  window_impl="masked") ** 2).sum()
+
+    def loss_r(q, k, v):
+        return (F.mha_reference(q, k, v, causal=True, window=W) ** 2).sum()
+
+    gm = jax.grad(loss_m, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+    for a, b, n in zip(gm, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-3, atol=5e-3, err_msg=n)
+
+
+def test_window_impl_env_default(devices, monkeypatch):
+    """DS_FLASH_WINDOW_IMPL=masked flips the default, so hardware
+    deployments can quarantine the banded kernel without code changes
+    (PARITY.md note)."""
+    q, k, v = _rand_qkv(B=1, S=256, H=2, D=32)
+    monkeypatch.setenv("DS_FLASH_WINDOW_IMPL", "masked")
+    out = F.flash_attention(q, k, v, causal=True, block_q=128,
+                            block_kv=128, window=64)
+    ref = F.mha_reference(q, k, v, causal=True, window=64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+    monkeypatch.setenv("DS_FLASH_WINDOW_IMPL", "bogus")
+    with pytest.raises(AssertionError):
+        F.flash_attention(q, k, v, causal=True, block_q=128,
+                          block_kv=128, window=64)
+
+
 def test_window_gqa_segments_compose(devices):
     """window + GQA + segment_ids in one call — all masks and the
     grouped kv maps compose."""

@@ -447,3 +447,20 @@ def test_ring_property_parity(devices, B, S, heads, window, use_segs,
     np.testing.assert_allclose(np.asarray(out)[defined],
                                np.asarray(ref)[defined],
                                rtol=5e-4, atol=5e-4)
+
+
+def test_ring_window_masked_impl_matches_dense(devices):
+    """window_impl='masked' rides the ring's nondiff window into the
+    flash block leafs (tagged tuple), with the early-stop hop count
+    still computed from the int — parity with dense must hold."""
+    from deepspeed_tpu.ops.attention.flash import mha_reference
+    from deepspeed_tpu.parallel.mesh import MeshSpec, make_mesh
+    mesh = make_mesh(MeshSpec(data=1, sequence=8))
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q, k, v = (jax.random.normal(kk, (2, 64, 4, 16), jnp.float32)
+               for kk in ks)
+    out = ring_attention(q, k, v, mesh, causal=True, window=16,
+                         window_impl="masked")
+    ref = mha_reference(q, k, v, causal=True, window=16)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)

@@ -193,3 +193,17 @@ def test_ulysses_packed_gpt_trains(devices):
         np.testing.assert_allclose(l_sp, l_ref, rtol=1e-4)
     assert np.isfinite(l_sp)
 
+
+
+def test_ulysses_window_masked_impl_matches_dense(devices):
+    """window_impl='masked' (the PARITY.md quarantine fallback) must
+    thread through the SP path too — a config that requests it under
+    Ulysses may never silently compile the banded kernel."""
+    from deepspeed_tpu.ops.attention.flash import mha_reference
+    mesh = make_mesh(MeshSpec(data=1, sequence=8))
+    q, k, v = _qkv(B=2, S=64, H=8, D=16)
+    out = ulysses_attention(q, k, v, mesh, causal=True, window=16,
+                            window_impl="masked")
+    ref = mha_reference(q, k, v, causal=True, window=16)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)

@@ -101,15 +101,17 @@ def main():
         print(json.dumps({"autotune": "no admissible candidates"}))
         return
 
+    # features -> name via content (the feature view is unique per
+    # candidate); injecting the name INTO ds_config would hand the cost
+    # model a pure-noise hashed-string regressor
+    by_feature = {json.dumps(e.ds_config, sort_keys=True, default=str):
+                  e.name for e in exps}
+    assert len(by_feature) == len(exps), "feature views must be unique"
+
     def cmd_builder(feat):
-        # features -> spec via the experiment name (ds_config is the
-        # numeric feature view; the spec dict drives the bench)
-        name = feat["__name__"]
+        name = by_feature[json.dumps(feat, sort_keys=True, default=str)]
         return [sys.executable, "-c",
                 CODE.format(spec=specs[name], name=name)]
-
-    for e in exps:
-        e.ds_config["__name__"] = e.name
 
     def parse(stdout):
         for line in reversed(stdout.splitlines()):

@@ -68,7 +68,18 @@ QUEUE = [
     # pick_headline weighs them with the same margin logic)
     ("autotune", [sys.executable, "tools/autotune_headline.py",
                   "--trials", "8", "--timeout", "1500"], 13500),
-    # the quarantined window compiles, dead last
+    # streamed-tier THROUGHPUT (VERDICT r4 #3): 4B first, then the
+    # offloaded 8B; link bandwidths + transfer floor recorded with the
+    # tunnel caveat
+    ("infinity-4b", [sys.executable, "tools/infinity_bench.py",
+                     "gpt2-4b", "3", "4", "1024"], 3600),
+    ("infinity-8b", [sys.executable, "tools/infinity_bench.py",
+                     "gpt2-8b", "2", "2", "1024"], 4800),
+    # the quarantined window compiles, dead last: FIRST the bisect
+    # (minimized kernels, one construct per subprocess — classifies the
+    # r4 hang instead of reproducing it), then the full smoke cases
+    ("window-bisect", [sys.executable, "tools/flash_window_bisect.py"],
+     7600),
     ("flash-smoke-window", [sys.executable, "tools/flash_chip_smoke.py",
                             "window", "window+gqa+segs",
                             "ring-blocks-window"], 1800),

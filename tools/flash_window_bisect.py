@@ -1,0 +1,118 @@
+"""Bisect the sliding-window Mosaic compile hang (VERDICT r4 #2).
+
+The round-4 on-chip smoke hung the remote Mosaic compile helper for
+~20min on the `window` case and re-wedged the rig (STATUS.md). The
+window path differs from the proven `plain` causal case by exactly
+three static constructs:
+
+  A. the index-map lo-clamp  (_causal_kv_index_map's jnp.maximum(ki, lo)
+     with a negative-dividend floordiv)            -> case "clamp"
+  B. the band-aware grid skip (_band_run's window term) -> case "bandrun"
+  C. the in-body window mask (_window_mask)        -> case "maskonly"
+
+Each case compiles ONE minimized forward kernel with only that
+construct enabled, in its OWN subprocess with a timeout — a hang
+classifies the construct instead of wedging the queue. "control"
+(plain causal) and "full"/"masked" (the two shipping window impls,
+parity-checked vs the jnp reference) bracket the bisection;
+"bwd-full" compiles the backward pair. chip_queue runs this dead-last
+in the quarantined window item.
+
+Usage: python tools/flash_window_bisect.py [case ...]
+"""
+
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+from tools._subproc import run_json  # noqa: E402
+
+CASES = ("control", "maskonly", "clamp", "bandrun", "masked", "full",
+         "bwd-masked", "bwd-full")
+
+CODE = """
+import json, sys
+sys.path.insert(0, '.')
+import jax, jax.numpy as jnp, numpy as np
+from deepspeed_tpu.ops.attention import flash as F
+
+case = {case!r}
+W = 256
+B, H, S, D = 1, 4, 1024, 64
+ks = [jax.random.PRNGKey(i) for i in range(3)]
+q = jax.random.normal(ks[0], (B, S, H, D), jnp.bfloat16)
+k = jax.random.normal(ks[1], (B, S, H, D), jnp.bfloat16)
+v = jax.random.normal(ks[2], (B, S, H, D), jnp.bfloat16)
+
+# per-case construct isolation (single-purpose subprocess: patching the
+# module is the cheapest way to switch one static construct at a time)
+window = W
+parity = True
+if case == "control":
+    window = None
+elif case == "maskonly":          # C only (== the "masked" impl)
+    window = ("masked", W)
+elif case == "clamp":             # A only: clamp active, mask+skip off
+    _orig_map = F._causal_kv_index_map
+    F._band_run = lambda qi, ki, bq, bkv, causal, w, q_off=0: \\
+        (qi * bq + bq - 1 + q_off >= ki * bkv) if causal else True
+    F._window_mask = lambda s, rows, cols, w: s
+    parity = False                # not a correct config; compile-only
+elif case == "bandrun":           # B only: skip active, clamp+mask off
+    _orig = F._causal_kv_index_map
+    F._causal_kv_index_map = \\
+        lambda bq, bkv, nkv, w=None, q_off=0: _orig(bq, bkv, nkv, None,
+                                                    q_off)
+    F._window_mask = lambda s, rows, cols, w: s
+    parity = False
+elif case in ("full", "bwd-full"):
+    window = W
+elif case == "bwd-masked":
+    window = ("masked", W)
+
+grad = case.startswith("bwd-")
+if grad:
+    def f(q, k, v):
+        o = F._flash(q, k, v, None, None, None, True, 0.125, 256, 256,
+                     window, None, None)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+    fn = jax.jit(jax.grad(f, argnums=(0, 1, 2)))
+else:
+    fn = jax.jit(lambda q, k, v: F._flash_fwd(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3), None, None, None, True, 0.125,
+        256, 256, window)[0])
+
+fn.lower(q, k, v).compile()
+out = {{"case": case, "compiled": True}}
+if parity and not grad:
+    o = fn(q, k, v).transpose(0, 2, 1, 3)
+    ref = F.mha_reference(q, k, v, causal=True, scale=0.125, window=W)
+    err = float(jnp.max(jnp.abs(o.astype(jnp.float32)
+                                - ref.astype(jnp.float32))))
+    out["max_abs_err"] = round(err, 5)
+    out["parity"] = err < 0.06
+elif parity and grad:
+    g = fn(q, k, v)
+    out["grads_finite"] = all(bool(jnp.all(jnp.isfinite(
+        x.astype(jnp.float32)))) for x in g)
+print(json.dumps(out))
+"""
+
+
+def main():
+    names = sys.argv[1:] or list(CASES)
+    for case in names:
+        if case not in CASES:
+            print(json.dumps({"case": case, "error": "unknown"}),
+                  flush=True)
+            continue
+        # 900s: far above any sane compile, far below the observed
+        # ~20min helper wedge — a hang classifies the construct
+        run_json([sys.executable, "-c", CODE.format(case=case)], 900,
+                 {"case": case, "verdict": "COMPILE HUNG (classified)"})
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,7 @@ Usage: python tools/infer_bench.py [steps]
 """
 
 import json
+import os
 import sys
 import time
 
@@ -34,15 +35,24 @@ import numpy as np  # noqa: E402
 
 
 def bench_config(name, preset, batch, prompt_len, new_tokens,
-                 n_kv_heads=None, attn_window=None, int8=False):
+                 n_kv_heads=None, attn_window=None, int8=False,
+                 int8_fused=False):
     from deepspeed_tpu.models import gpt
     import deepspeed_tpu
 
     on_tpu = "tpu" in (jax.devices()[0].platform +
                        jax.devices()[0].device_kind).lower()
+    # windowed rows use the "masked" impl: this bench runs in the
+    # NON-quarantined queue item, and the banded window kernel's compile
+    # is the known rig-wedger (PARITY.md note; tools/flash_window_bisect)
     cfg = gpt.preset(preset, max_seq_len=prompt_len + new_tokens + 8,
                      dtype=jnp.bfloat16, use_flash_attention=on_tpu,
-                     n_kv_heads=n_kv_heads, attn_window=attn_window)
+                     n_kv_heads=n_kv_heads, attn_window=attn_window,
+                     attn_window_impl="masked" if attn_window else None)
+    if int8_fused:
+        os.environ["DS_INT8_FUSED"] = "1"
+    else:
+        os.environ.pop("DS_INT8_FUSED", None)
     if on_tpu:
         # refuse borderline-HBM compiles before any backend contact
         # (utils/hbm.py, PERF.md incident log)
@@ -98,6 +108,12 @@ CONFIGS = [
     ("gpt2-medium-b8-int8", dict(preset="gpt2-medium", batch=8,
                                  prompt_len=512, new_tokens=64,
                                  int8=True)),
+    # same row through the Pallas fused dequant-matmul (VERDICT r4 weak
+    # #6): if XLA's dequant fusion already recovers the bandwidth win
+    # this ties the row above; if not, this is the shipping fallback
+    ("gpt2-medium-b8-int8-fused", dict(preset="gpt2-medium", batch=8,
+                                       prompt_len=512, new_tokens=64,
+                                       int8=True, int8_fused=True)),
 ]
 
 
