@@ -4,6 +4,7 @@ Usable as modules (no install step needed):
     python -m deepspeed_tpu.launcher.runner train.py -- args...
     python -m deepspeed_tpu.env_report
     python -m deepspeed_tpu.cli elastic --config ds_config.json [-w WORLD]
+    python -m deepspeed_tpu.cli ssh -H hostfile -- nvidia-smi-equivalent
 """
 
 import argparse
@@ -65,6 +66,44 @@ def zero_to_fp32_main(argv=None):
           f"to {args.output_file}")
 
 
+def ds_ssh_main(argv=None):
+    """(ref: bin/ds_ssh) run a command on every hostfile node, in
+    parallel, with per-host-prefixed output. Exit code is the worst
+    per-host code, so scripts can gate on cluster-wide success."""
+    parser = argparse.ArgumentParser(prog="ds_ssh")
+    parser.add_argument("-H", "--hostfile", default="/job/hostfile")
+    parser.add_argument("--ssh-cmd", default="ssh",
+                        help="transport binary (tests point this at a "
+                             "stub; gcloud users at their ssh wrapper)")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="command to run on every node")
+    args = parser.parse_args(argv)
+    if not args.command:
+        parser.error("no command given")
+    cmd = [c for c in args.command if c != "--"]
+
+    import subprocess
+
+    from deepspeed_tpu.launcher.runner import fetch_hostfile
+    pool = fetch_hostfile(args.hostfile)
+    if not pool:
+        print(f"ds_ssh: no hostfile at {args.hostfile}", file=sys.stderr)
+        sys.exit(2)
+    procs = {h: subprocess.Popen([args.ssh_cmd, h] + cmd,
+                                 stdout=subprocess.PIPE,
+                                 stderr=subprocess.STDOUT, text=True)
+             for h in pool}
+    worst = 0
+    for h, p in procs.items():
+        out, _ = p.communicate()
+        for line in (out or "").splitlines():
+            print(f"[{h}] {line}")
+        if p.returncode:
+            print(f"[{h}] exit {p.returncode}", file=sys.stderr)
+        worst = max(worst, p.returncode)
+    sys.exit(worst)
+
+
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv:
@@ -73,6 +112,8 @@ def main(argv=None):
     cmd, rest = argv[0], argv[1:]
     if cmd == "elastic":
         ds_elastic_main(rest)
+    elif cmd == "ssh":
+        ds_ssh_main(rest)
     elif cmd == "zero_to_fp32":
         zero_to_fp32_main(rest)
     elif cmd == "report":

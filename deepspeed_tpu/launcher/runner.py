@@ -188,6 +188,40 @@ class PDSHRunner(MultiNodeRunner):
         return cmd
 
 
+class SSHRunner(MultiNodeRunner):
+    """Plain-ssh transport: one ssh per host, in parallel, joined by
+    ``wait`` so the launch fails if any node fails. The third transport
+    slot the reference fills with MVAPICH's mpirun_rsh
+    (ref: launcher/multinode_runner.py:156) — MVAPICH itself is an
+    InfiniBand-tuned MPI with no TPU-pod analog (docs/PARITY.md), but
+    the capability it provides there (launch without pdsh or an MPI
+    install, rsh/ssh fan-out) is exactly this runner."""
+
+    def backend_exists(self) -> bool:
+        return shutil.which("ssh") is not None
+
+    def get_cmd(self, environment, active_resources) -> List[str]:
+        import shlex
+        exports = "".join(f"export {k}={shlex.quote(v)}; "
+                          for k, v in self.exports.items())
+        launcher_args = " ".join(self._launcher_args(active_resources))
+        user = " ".join([shlex.quote(self.user_script)]
+                        + [shlex.quote(a) for a in self.user_arguments])
+        per_host = []
+        for host in active_resources:
+            remote = (exports + f"cd {shlex.quote(os.path.abspath('.'))}; "
+                      f"{sys.executable} -m deepspeed_tpu.launcher.launch "
+                      f"{launcher_args} --hostname {host} {user}")
+            per_host.append(f"ssh -o StrictHostKeyChecking=no "
+                            f"{shlex.quote(host)} {shlex.quote(remote)} &")
+        # `wait -n`-free portable join: wait collects every child; the
+        # subshell's exit code is the last wait's, so check each pid
+        script = "pids=(); " + " ".join(
+            p + " pids+=($!);" for p in per_host) + \
+            " rc=0; for p in ${pids[@]}; do wait $p || rc=$?; done; exit $rc"
+        return ["bash", "-c", script]
+
+
 class OpenMPIRunner(MultiNodeRunner):
     """mpirun transport (ref: multinode_runner.py:101): one rank per
     host; jax.distributed picks up OMPI env."""
@@ -223,7 +257,7 @@ def parse_args(args=None):
     parser.add_argument("--master_port", type=int, default=29500)
     parser.add_argument("--master_addr", type=str, default="")
     parser.add_argument("--launcher", type=str, default="pdsh",
-                        choices=["pdsh", "openmpi"])
+                        choices=["pdsh", "openmpi", "ssh"])
     parser.add_argument("--force_multi", action="store_true")
     parser.add_argument("user_script", type=str)
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
@@ -264,7 +298,8 @@ def main(args=None):
             args.user_script,
         ] + list(args.user_args)
     else:
-        runner_cls = {"pdsh": PDSHRunner, "openmpi": OpenMPIRunner}[args.launcher]
+        runner_cls = {"pdsh": PDSHRunner, "openmpi": OpenMPIRunner,
+                      "ssh": SSHRunner}[args.launcher]
         runner = runner_cls(args, world_info)
         if not runner.backend_exists():
             raise RuntimeError(f"launcher backend '{args.launcher}' not found")

@@ -222,3 +222,76 @@ def test_save_16bit_model_roundtrip(devices, tmp_path):
         np.testing.assert_array_equal(node, np.asarray(leaf))
         n += 1
     assert n > 0
+
+
+def test_memory_efficient_bf16_elastic_topology_change(tmp_path, devices):
+    """The HEADLINE training mode (bf16.memory_efficient + ZeRO-3: bf16
+    params + stochastically-rounded bf16 moments) restored across a
+    TOPOLOGY change — 8-way fsdp -> 2-way fsdp on half the devices, the
+    restart-after-shrink scenario (VERDICT r4 #9; ref:
+    stage_1_and_2.py:2002 _restore_from_elastic_fp32_weights /
+    _restore_elastic_base_optimizer_state). The restored engine must
+    continue the loss trajectory: moments and rng are part of the
+    checkpoint, and orbax reshards them onto the new mesh."""
+    import jax
+    from deepspeed_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    cfg = dict(BASE)
+    cfg["bf16"] = {"enabled": True, "memory_efficient": True}
+    cfg["zero_optimization"] = {"stage": 3, "stage3_min_shard_size": 1}
+    engine = _make_engine(cfg)                     # 8-way fsdp mesh
+    for i in range(4):
+        engine.train_batch(random_batch(16, HIDDEN, seed=i % 4))
+    engine.save_checkpoint(str(tmp_path), tag="me8")
+    ref = [float(engine.train_batch(
+        random_batch(16, HIDDEN, seed=i % 4))["loss"])
+        for i in range(4, 7)]
+
+    mesh2 = make_mesh(MeshSpec(data=1, fsdp=2), devices=jax.devices()[:2])
+    params = simple_model_params(hidden_dim=HIDDEN, nlayers=2, seed=99)
+    engine2, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_model_loss, model_parameters=params, config=cfg,
+        mesh=mesh2)
+    path, _ = engine2.load_checkpoint(str(tmp_path), tag="me8")
+    assert path is not None
+    assert engine2.global_steps == 4
+    # moments restored in the memory-efficient dtype, resharded 2-way
+    mom = [x for x in jax.tree_util.tree_leaves(engine2.state.opt_state)
+           if getattr(x, "ndim", 0) == 2]
+    assert mom and all(m.dtype == jax.numpy.bfloat16 for m in mom), \
+        "memory_efficient moments must stay bf16 across elastic restore"
+    got = [float(engine2.train_batch(
+        random_batch(16, HIDDEN, seed=i % 4))["loss"])
+        for i in range(4, 7)]
+    # bf16 + stochastic rounding: the restored rng stream is identical,
+    # but fsdp=2 vs 8 changes reduction order at bf16 precision — allow
+    # bf16-level slack, not drift
+    np.testing.assert_allclose(ref, got, rtol=2e-2, atol=2e-2)
+
+
+def test_fp16_scaler_elastic_topology_change(tmp_path, devices):
+    """Dynamic loss-scale state survives a topology change too (the
+    'scaler state' half of VERDICT r4 #9)."""
+    import jax
+    from deepspeed_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    cfg = dict(BASE)
+    cfg["fp16"] = {"enabled": True, "initial_scale_power": 10,
+                   "loss_scale_window": 2}
+    cfg["zero_optimization"] = {"stage": 3, "stage3_min_shard_size": 1}
+    engine = _make_engine(cfg)
+    for i in range(5):
+        engine.train_batch(random_batch(16, HIDDEN, seed=i % 4))
+    scale = float(engine.state.scale_state.loss_scale)
+    engine.save_checkpoint(str(tmp_path), tag="fp16e")
+
+    mesh2 = make_mesh(MeshSpec(data=1, fsdp=2), devices=jax.devices()[:2])
+    params = simple_model_params(hidden_dim=HIDDEN, nlayers=2, seed=31)
+    engine2, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_model_loss, model_parameters=params, config=cfg,
+        mesh=mesh2)
+    engine2.load_checkpoint(str(tmp_path), tag="fp16e")
+    np.testing.assert_allclose(
+        float(engine2.state.scale_state.loss_scale), scale)
+    m = engine2.train_batch(random_batch(16, HIDDEN, seed=5 % 4))
+    assert np.isfinite(float(m["loss"]))

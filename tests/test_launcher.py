@@ -187,3 +187,49 @@ def test_ds_elastic_cli(tmp_path):
     assert proc.returncode == 0, proc.stderr
     assert "1680" in proc.stdout
     assert "micro batch per chip" in proc.stdout
+
+
+def test_ssh_runner_cmd_shape():
+    """The plain-ssh transport (the reference's MVAPICH slot — see
+    docs/PARITY.md row 60): one ssh per host, parallel, worst-rc join."""
+    from deepspeed_tpu.launcher.runner import SSHRunner
+    args = _args(["--launcher", "ssh"])
+    r = SSHRunner(args, encode_world_info({"w0": [0], "w1": [0]}))
+    r.add_export("XLA_FLAGS", "--xla_dummy")
+    cmd = r.get_cmd({}, {"w0": [0], "w1": [0]})
+    assert cmd[:2] == ["bash", "-c"]
+    script = cmd[2]
+    assert script.count("ssh -o StrictHostKeyChecking=no") == 2
+    assert "--hostname w0" in script and "--hostname w1" in script
+    assert "export XLA_FLAGS=--xla_dummy;" in script
+    assert "wait $p || rc=$?" in script
+    assert "train.py --foo bar" in script
+
+
+def test_ds_ssh_fanout(tmp_path):
+    """cli.py ssh (ref bin/ds_ssh): run a command on every hostfile
+    node; per-host prefixes; worst exit code wins. Transport stubbed
+    with a local script so no real ssh happens."""
+    import stat
+    import subprocess
+    import sys as _sys
+
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("nodeA slots=4\nnodeB slots=4\n")
+    stub = tmp_path / "fakessh"
+    # args: host cmd... — 'fail' on nodeB to prove rc propagation
+    stub.write_text("#!/bin/bash\nhost=$1; shift\n"
+                    "echo \"$host ran: $*\"\n"
+                    "[ \"$host\" = nodeB ] && exit 3\nexit 0\n")
+    stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+
+    r = subprocess.run(
+        [_sys.executable, "-m", "deepspeed_tpu.cli", "ssh",
+         "-H", str(hostfile), "--ssh-cmd", str(stub), "--",
+         "echo", "hi"],
+        capture_output=True, text=True, timeout=60,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert r.returncode == 3, r.stdout + r.stderr
+    assert "[nodeA] nodeA ran: echo hi" in r.stdout
+    assert "[nodeB] nodeB ran: echo hi" in r.stdout
+    assert "exit 3" in r.stderr
