@@ -17,6 +17,7 @@ dispatches experiments, records results. Two dispatch modes:
 
 import json
 import os
+import re
 import subprocess
 import sys
 import tempfile
@@ -53,7 +54,14 @@ class ExperimentError(RuntimeError):
 
 
 _OOM_MARKERS = ("resource_exhausted", "out of memory", "memoryerror",
-                "oom", "failed to allocate", "hbm limit")
+                "failed to allocate", "hbm limit")
+# the bare marker needs word boundaries: "bloom"/"zoom" in a model name
+# or log line must not classify an ordinary failure as out-of-memory
+_OOM_RE = re.compile(r"\boom\b")
+
+
+def _is_oom(blob: str) -> bool:
+    return any(m in blob for m in _OOM_MARKERS) or bool(_OOM_RE.search(blob))
 
 
 class SubprocessRunner:
@@ -119,8 +127,7 @@ class SubprocessRunner:
             self.last_stdout = r.stdout or ""
             if r.returncode != 0:
                 blob = ((r.stderr or "") + (r.stdout or "")).lower()
-                kind = ("oom" if any(m in blob for m in _OOM_MARKERS)
-                        else "error")
+                kind = "oom" if _is_oom(blob) else "error"
                 raise ExperimentError(
                     kind, f"rc={r.returncode}: {(r.stderr or '')[-400:]}")
             return float(self.parse(self.last_stdout))

@@ -80,7 +80,11 @@ def ds_ssh_main(argv=None):
     args = parser.parse_args(argv)
     if not args.command:
         parser.error("no command given")
-    cmd = [c for c in args.command if c != "--"]
+    # drop only the leading separator: a later literal "--" belongs to
+    # the remote command itself
+    cmd = list(args.command)
+    if "--" in cmd:
+        cmd.remove("--")
 
     import subprocess
 
@@ -100,7 +104,9 @@ def ds_ssh_main(argv=None):
             print(f"[{h}] {line}")
         if p.returncode:
             print(f"[{h}] exit {p.returncode}", file=sys.stderr)
-        worst = max(worst, p.returncode)
+        # signal-killed ssh gives a NEGATIVE returncode; abs() keeps it
+        # from comparing below 0 and reporting success
+        worst = max(worst, abs(p.returncode))
     sys.exit(worst)
 
 

@@ -1,0 +1,5 @@
+from deepspeed_tpu.inference.paged_cache import CacheExhausted, PagedKVCache
+from deepspeed_tpu.inference.serving import ServeRequest, ServingEngine
+
+__all__ = ["CacheExhausted", "PagedKVCache", "ServeRequest",
+           "ServingEngine"]

@@ -197,6 +197,123 @@ def _block_decode(x, k_cache, v_cache, pos, p, cfg: GPTConfig,
     return x + _ffn(h, p, cfg), k_cache, v_cache
 
 
+def _gather_blocks(pool, tables):
+    """Gather a block pool [N, block, Hkv, Dh] through block tables
+    [B, NB] into the virtual contiguous cache [B, NB*block, Hkv, Dh].
+    Cache position s of row b lives at pool[tables[b, s // block],
+    s % block] — the PagedAttention indirection, done as one XLA gather
+    so the decode einsums below are unchanged from the static path."""
+    g = pool[tables]
+    B, NB, bs = g.shape[0], g.shape[1], g.shape[2]
+    return g.reshape(B, NB * bs, g.shape[3], g.shape[4])
+
+
+def _block_decode_paged(x, k_pool, v_pool, tables, lengths, active, p,
+                        cfg: GPTConfig):
+    """One block for ONE new token per slot, K/V gathered through block
+    tables — the paged generalization of _block_decode. x: [B, 1, D];
+    pools [N, block, Hkv, Dh]; tables [B, NB]; lengths [B] per-slot
+    cache positions (each slot decodes at its OWN position — the
+    continuous-batching contract); active [B] bool (inactive slots'
+    writes land in trash block 0 and their logits are ignored)."""
+    B, _, D = x.shape
+    H, Dh = cfg.n_heads, cfg.head_dim
+    Hkv = cfg.kv_heads
+    group = H // Hkv
+    bs = k_pool.shape[1]
+    NB = tables.shape[1]
+
+    h = _norm(x, p["ln1"], cfg)
+    qkv = _dense(h, p["qkv"])
+    q, k, v = jnp.split(qkv, [H * Dh, (H + Hkv) * Dh], axis=-1)
+    if cfg.rotary_dim:
+        from deepspeed_tpu.ops.attention.rotary import apply_rotary
+        q, k = apply_rotary(q.reshape(B, 1, H, Dh), k.reshape(B, 1, Hkv, Dh),
+                            lengths[:, None], cfg.rotary_dim,
+                            base=cfg.rope_theta)
+    q = q.reshape(B, Hkv, group, Dh)
+    k = k.reshape(B, Hkv, Dh)
+    v = v.reshape(B, Hkv, Dh)
+
+    # scatter the new token's K/V into each slot's current block
+    blk = jnp.take_along_axis(
+        tables, jnp.clip(lengths // bs, 0, NB - 1)[:, None], axis=1)[:, 0]
+    blk = jnp.where(active, blk, 0)          # inactive -> trash block
+    off = lengths % bs
+    k_pool = k_pool.at[blk, off].set(k)
+    v_pool = v_pool.at[blk, off].set(v)
+
+    kc = _gather_blocks(k_pool, tables)      # [B, NB*bs, Hkv, Dh]
+    vc = _gather_blocks(v_pool, tables)
+    scores = jnp.einsum("bkgd,bskd->bkgs", q, kc).astype(jnp.float32)
+    scores *= cfg.attn_scale if cfg.attn_scale is not None \
+        else 1.0 / np.sqrt(Dh)
+    idx = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, NB * bs), 3)
+    pos = lengths[:, None, None, None]
+    scores = jnp.where(idx <= pos, scores, -1e30)
+    if cfg.attn_window is not None:
+        # block tables keep logical order, so cache-index distance IS
+        # logical distance — same banding as the static decode
+        scores = jnp.where(idx > pos - cfg.attn_window, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    attn = jnp.einsum("bkgs,bskd->bkgd", probs, vc).reshape(B, 1, D)
+    attn = _dense(attn, p["attn_out"])
+    if cfg.parallel_residual:
+        return x + attn + _ffn(h, p, cfg), k_pool, v_pool
+    x = x + attn
+    h = _norm(x, p["ln2"], cfg)
+    return x + _ffn(h, p, cfg), k_pool, v_pool
+
+
+def _block_prefill_paged(x, k_pool, v_pool, table_row, positions, n_valid,
+                         p, cfg: GPTConfig):
+    """Forward one block over a PROMPT CHUNK for one slot, writing the
+    chunk's K/V through the slot's block table and attending over the
+    slot's full cache so far (history from earlier chunks + this chunk)
+    — the prefill-chunking path that keeps decode latency bounded for
+    long prompts. x: [1, C, D]; positions: [C] global cache positions of
+    the chunk tokens; n_valid: how many of the C lanes are real (the
+    chunk is padded to a fixed width so ONE compiled program serves
+    every chunk)."""
+    B, C, D = x.shape
+    H, Dh = cfg.n_heads, cfg.head_dim
+    Hkv = cfg.kv_heads
+    group = H // Hkv
+    bs = k_pool.shape[1]
+    NB = table_row.shape[0]
+
+    h = _norm(x, p["ln1"], cfg)
+    qkv = _dense(h, p["qkv"])
+    q, k, v = gpt_lib._qkv_split_rotary(qkv, cfg, positions[None], B, C)
+
+    valid = jnp.arange(C) < n_valid
+    blk = table_row[jnp.clip(positions // bs, 0, NB - 1)]
+    blk = jnp.where(valid, blk, 0)           # padded lanes -> trash block
+    off = positions % bs
+    k_pool = k_pool.at[blk, off].set(k[0])
+    v_pool = v_pool.at[blk, off].set(v[0])
+
+    kc = k_pool[table_row].reshape(NB * bs, Hkv, Dh)
+    vc = v_pool[table_row].reshape(NB * bs, Hkv, Dh)
+    qg = q[0].reshape(C, Hkv, group, Dh)
+    scores = jnp.einsum("ckgd,skd->ckgs", qg, kc).astype(jnp.float32)
+    scores *= cfg.attn_scale if cfg.attn_scale is not None \
+        else 1.0 / np.sqrt(Dh)
+    sidx = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, NB * bs), 3)
+    qpos = positions[:, None, None, None]
+    scores = jnp.where(sidx <= qpos, scores, -1e30)
+    if cfg.attn_window is not None:
+        scores = jnp.where(sidx > qpos - cfg.attn_window, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    attn = jnp.einsum("ckgs,skd->ckgd", probs, vc).reshape(1, C, D)
+    attn = _dense(attn, p["attn_out"])
+    if cfg.parallel_residual:
+        return x + attn + _ffn(h, p, cfg), k_pool, v_pool
+    x = x + attn
+    h = _norm(x, p["ln2"], cfg)
+    return x + _ffn(h, p, cfg), k_pool, v_pool
+
+
 class InferenceEngine:
     """Generation engine over a GPT-layout parameter pytree.
 
@@ -297,6 +414,14 @@ class InferenceEngine:
             self._prefill = jax.jit(self._prefill_fn)
             self._decode = jax.jit(self._decode_fn, donate_argnums=(1,))
             self._forward = jax.jit(self._forward_fn)
+            # paged-serving programs: the steady-state continuous-batching
+            # loop is exactly these two compiled programs regardless of
+            # arrival pattern; pools are donated so the cache never
+            # doubles in HBM across a step
+            self._prefill_slot = jax.jit(self._prefill_slot_fn,
+                                         donate_argnums=(1, 2))
+            self._decode_slots = jax.jit(self._decode_slots_fn,
+                                         donate_argnums=(1, 2))
         log_dist(f"inference engine: {config.n_layers}L/{config.d_model}d "
                  f"mp={mp_size} dtype={jnp.dtype(dtype).name} "
                  f"{'encoder' if self.is_encoder else 'decoder'}",
@@ -388,6 +513,77 @@ class InferenceEngine:
         if cache_mask is not None:
             out["mask"] = cache_mask
         return logits, out
+
+    def _prefill_slot_fn(self, params, k_pool, v_pool, table_row, tokens,
+                         start, n_valid):
+        """Prefill ONE prompt chunk into one serving slot's paged cache.
+
+        tokens: [C] fixed-width chunk (padded; n_valid real tokens);
+        start: scalar — tokens already cached for this slot (0 for the
+        first chunk, the resume point for later chunks / requeued
+        requests); table_row: [NB] the slot's block table. Returns the
+        logits of the LAST VALID position (meaningful once the final
+        chunk lands) and the updated (donated) pools."""
+        cfg = self.cfg
+        C = tokens.shape[0]
+        positions = start + jnp.arange(C, dtype=jnp.int32)
+        x = params["wte"]["embedding"][tokens][None]
+        if cfg.use_wpe:
+            safe = jnp.clip(positions, 0, self.max_seq_len - 1)
+            x = x + params["wpe"]["embedding"][safe][None]
+
+        def body(x, layer):
+            layer_p, kp, vp = layer
+            y, kp, vp = _block_prefill_paged(x, kp, vp, table_row,
+                                             positions, n_valid, layer_p,
+                                             cfg)
+            return y, (kp, vp)
+
+        x, (ks, vs) = jax.lax.scan(body, x,
+                                   (params["block"], k_pool, v_pool))
+        last = jnp.clip(n_valid - 1, 0, C - 1)
+        x_last = jax.lax.dynamic_slice_in_dim(x, last, 1, axis=1)
+        return self._logits(params, x_last), ks, vs
+
+    def _decode_slots_fn(self, params, k_pool, v_pool, tables, lengths,
+                         tokens, active):
+        """One decode step for EVERY serving slot at once. tokens: [B]
+        (each slot's pending token); lengths: [B] per-slot cache
+        positions; active: [B] (inactive slots run but write to the
+        trash block and their logits are discarded). The slot-batched
+        shape is static, so any mix of requests reuses this one
+        compiled program."""
+        cfg = self.cfg
+        x = params["wte"]["embedding"][tokens[:, None]]
+        if cfg.use_wpe:
+            safe = jnp.clip(lengths, 0, self.max_seq_len - 1)
+            x = x + params["wpe"]["embedding"][safe][:, None]
+
+        def body(x, layer):
+            layer_p, kp, vp = layer
+            y, kp, vp = _block_decode_paged(x, kp, vp, tables, lengths,
+                                            active, layer_p, cfg)
+            return y, (kp, vp)
+
+        x, (ks, vs) = jax.lax.scan(body, x,
+                                   (params["block"], k_pool, v_pool))
+        return self._logits(params, x), ks, vs
+
+    # public wrappers: host-side numpy in, device pools threaded through
+    def prefill_into_slot(self, k_pool, v_pool, table_row, tokens, start,
+                          n_valid):
+        return self._prefill_slot(
+            self.params, k_pool, v_pool,
+            jnp.asarray(table_row, jnp.int32),
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(start, jnp.int32), jnp.asarray(n_valid, jnp.int32))
+
+    def decode_slots(self, k_pool, v_pool, tables, lengths, tokens, active):
+        return self._decode_slots(
+            self.params, k_pool, v_pool,
+            jnp.asarray(tables, jnp.int32),
+            jnp.asarray(lengths, jnp.int32),
+            jnp.asarray(tokens, jnp.int32), jnp.asarray(active, bool))
 
     def _forward_fn(self, params, tokens):
         x = self._embed(params, tokens)

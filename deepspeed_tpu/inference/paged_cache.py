@@ -1,0 +1,180 @@
+"""Block-paged KV-cache: fixed-size blocks + per-request block tables.
+
+The static engine preallocates a ``[L, B, S_max, Hkv, Dh]`` cache, so one
+long request holds ``S_max`` slots for every row and the whole batch's
+memory is ``B * S_max`` tokens regardless of what is actually in flight
+(the reproduction of the reference's global Context workspace, ref:
+ops/transformer/inference/transformer_inference.py:113 softmax_context).
+This module is the PagedAttention answer (Kwon et al., SOSP '23): K/V
+live in a pool of fixed-size blocks ``[L, N_blocks, block, Hkv, Dh]``,
+each serving slot owns an ordered list of block ids (its block table),
+and a free-list allocator hands blocks out on demand — cache memory
+scales with tokens in flight, fragmentation is bounded by one partial
+block per request, and a finished request's blocks return to the pool
+immediately.
+
+Host-side bookkeeping (tables, lengths, the free list) is plain numpy —
+it changes every scheduler iteration and must never trigger a recompile;
+the device arrays (``k``/``v`` pools) thread functionally through the
+engine's donated ``prefill_into_slot`` / ``decode_slots`` programs.
+
+Block id 0 is RESERVED as the trash block: the slot programs route
+writes for masked-out lanes (chunk padding, inactive slots) there, so
+the compiled scatter needs no branch.
+"""
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.models import gpt as gpt_lib
+from deepspeed_tpu.models.gpt import GPTConfig
+
+
+class CacheExhausted(Exception):
+    """The free list cannot cover an allocation — the scheduler's cue to
+    evict-and-requeue instead of OOMing the device."""
+
+
+class PagedKVCache:
+    """Pool + allocator + per-slot block tables.
+
+    num_blocks is the HBM-budget watermark made concrete: either passed
+    directly or derived from ``hbm_budget_bytes`` via the per-token cache
+    cost (models.gpt.kv_bytes_per_token). ``watermark`` free blocks are
+    held back at admission time so every active slot can always grow into
+    its next decode block without immediate eviction.
+    """
+
+    def __init__(self, cfg: GPTConfig, *, num_slots: int,
+                 block_size: int = 16, num_blocks: Optional[int] = None,
+                 hbm_budget_bytes: Optional[int] = None,
+                 dtype=jnp.bfloat16, max_seq_len: Optional[int] = None,
+                 watermark: Optional[int] = None):
+        self.cfg = cfg
+        self.block_size = int(block_size)
+        self.num_slots = int(num_slots)
+        self.blocks_per_slot, self.tokens_per_slot = gpt_lib.decode_geometry(
+            cfg, self.block_size, max_seq_len)
+        self.dtype = jnp.dtype(dtype)
+        self.bytes_per_token = gpt_lib.kv_bytes_per_token(cfg, dtype)
+        if num_blocks is None:
+            if not hbm_budget_bytes:
+                # default pool: the static reservation's worth of blocks
+                # (num_slots full sequences) — usage accounting then shows
+                # how far actual tokens-in-flight undercut it
+                hbm_budget_bytes = (self.num_slots * self.tokens_per_slot
+                                    * self.bytes_per_token)
+            per_block = self.bytes_per_token * self.block_size
+            num_blocks = int(hbm_budget_bytes // per_block)
+        # +1: block 0 is the reserved trash block, never allocated
+        self.num_blocks = int(num_blocks) + 1
+        if self.num_blocks < 2:
+            raise ValueError(
+                f"HBM budget covers {self.num_blocks - 1} blocks; the "
+                f"pool needs at least 1 allocatable block")
+        L, Hkv, Dh = cfg.n_layers, cfg.kv_heads, cfg.head_dim
+        self.k = jnp.zeros((L, self.num_blocks, self.block_size, Hkv, Dh),
+                           dtype)
+        self.v = jnp.zeros_like(self.k)
+        self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+        self._owned: List[List[int]] = [[] for _ in range(num_slots)]
+        self.tables = np.zeros((num_slots, self.blocks_per_slot), np.int32)
+        self.lengths = np.zeros((num_slots,), np.int32)
+        self.active = np.zeros((num_slots,), bool)
+        self.watermark = num_slots if watermark is None else int(watermark)
+        self.peak_used_blocks = 0
+        self.peak_tokens_in_flight = 0
+
+    # -- accounting ----------------------------------------------------
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return (self.num_blocks - 1) - len(self._free)
+
+    @property
+    def tokens_in_flight(self) -> int:
+        return int(self.lengths.sum())
+
+    def used_block_bytes(self) -> int:
+        """Bytes actually held by allocated blocks — what the bench's
+        'paged peak HBM' row reports (scales with tokens in flight,
+        block-quantized)."""
+        return self.used_blocks * self.block_size * self.bytes_per_token
+
+    def static_equivalent_bytes(self, batch: int,
+                                max_seq_len: Optional[int] = None) -> int:
+        """What the static [B, S_max] cache would reserve for the same
+        traffic — the comparison row."""
+        s = max_seq_len or self.cfg.max_seq_len
+        return batch * s * self.bytes_per_token
+
+    def blocks_for(self, n_tokens: int) -> int:
+        return -(-int(n_tokens) // self.block_size)
+
+    def can_admit(self, n_tokens: int) -> bool:
+        """Admission-control check: prompt blocks available AND the
+        watermark reserve stays intact so live slots can keep growing."""
+        return self.free_blocks >= self.blocks_for(n_tokens) + self.watermark
+
+    # -- allocator -----------------------------------------------------
+    def allocate(self, slot: int, n_tokens: int) -> None:
+        """Reserve blocks covering ``n_tokens`` for a fresh slot."""
+        assert not self.active[slot] and not self._owned[slot], slot
+        need = self.blocks_for(n_tokens)
+        if need > self.blocks_per_slot:
+            raise ValueError(
+                f"{n_tokens} tokens need {need} blocks > per-slot table "
+                f"width {self.blocks_per_slot}")
+        if need > self.free_blocks:
+            raise CacheExhausted(
+                f"need {need} blocks, {self.free_blocks} free")
+        ids = [self._free.pop() for _ in range(need)]
+        self._owned[slot] = ids
+        self.tables[slot, :] = 0
+        self.tables[slot, :need] = ids
+        self.lengths[slot] = 0
+        self.active[slot] = True
+        self._mark()
+
+    def ensure_capacity(self, slot: int, n_tokens: int) -> None:
+        """Grow the slot's table until it covers ``n_tokens`` (append)."""
+        assert self.active[slot], slot
+        need = self.blocks_for(n_tokens)
+        if need > self.blocks_per_slot:
+            raise ValueError(
+                f"{n_tokens} tokens exceed the per-slot capacity "
+                f"{self.tokens_per_slot}")
+        while len(self._owned[slot]) < need:
+            if not self._free:
+                raise CacheExhausted(
+                    f"slot {slot} needs a block for token "
+                    f"{n_tokens}; free list empty")
+            bid = self._free.pop()
+            self.tables[slot, len(self._owned[slot])] = bid
+            self._owned[slot].append(bid)
+        self._mark()
+
+    def advance(self, slot: int, n_tokens: int) -> None:
+        """Record ``n_tokens`` newly written to the slot's cache."""
+        new_len = int(self.lengths[slot]) + int(n_tokens)
+        assert new_len <= len(self._owned[slot]) * self.block_size, \
+            (slot, new_len, len(self._owned[slot]))
+        self.lengths[slot] = new_len
+        self.peak_tokens_in_flight = max(self.peak_tokens_in_flight,
+                                         self.tokens_in_flight)
+
+    def free(self, slot: int) -> None:
+        """Return every block the slot owns to the free list."""
+        self._free.extend(reversed(self._owned[slot]))
+        self._owned[slot] = []
+        self.tables[slot, :] = 0
+        self.lengths[slot] = 0
+        self.active[slot] = False
+
+    def _mark(self):
+        self.peak_used_blocks = max(self.peak_used_blocks, self.used_blocks)

@@ -1,0 +1,289 @@
+"""Continuous-batching serving scheduler over the paged KV-cache.
+
+The static engine runs ONE fixed batch to completion: every row pays for
+the slowest request, and a new arrival waits for the whole batch to
+drain. This scheduler implements iteration-level (continuous) batching
+as in Orca (Yu et al., OSDI '22): a fixed set of decode SLOTS, and on
+every iteration
+
+1. **admission** — queued requests claim free slots if the paged cache
+   can cover their prompt while keeping the watermark reserve;
+2. **prefill** — newly admitted requests prefill their prompt into
+   their slot in fixed-width CHUNKS (one chunk per iteration per slot),
+   so a long prompt never stalls the running decode batch for more than
+   one chunk's latency;
+3. **decode** — all decoding slots advance one token through the single
+   compiled ``decode_slots`` program, each at its own position.
+
+On cache exhaustion mid-decode the scheduler EVICTS the most recently
+admitted request instead of OOMing: its blocks return to the pool and
+the request requeues (front of the queue) with prompt+generated as its
+new prompt — recompute-on-resume reproduces the exact pre-eviction
+state, so greedy outputs are untouched (vLLM's recompute preemption).
+
+The steady state is two compiled programs (prefill chunk, slot decode)
+regardless of arrival pattern; all scheduling state is host numpy.
+
+Greedy parity contract (tested): for any arrival pattern, every
+request's output is token-for-token identical to a solo
+``InferenceEngine.generate`` run of its prompt.
+"""
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.inference.paged_cache import CacheExhausted, PagedKVCache
+from deepspeed_tpu.utils.logging import logger
+
+
+@dataclass
+class ServeRequest:
+    """One generation request. ``out`` accumulates generated token ids;
+    ``token_times`` the scheduler-clock stamp of each emitted token (the
+    bench derives per-token latency percentiles from these)."""
+    rid: Any
+    prompt: np.ndarray
+    max_new_tokens: int = 32
+    eos_id: Optional[int] = None
+    out: List[int] = field(default_factory=list)
+    state: str = "queued"            # queued | prefill | decode | done
+    token_times: List[float] = field(default_factory=list)
+    submitted_at: Optional[float] = None
+    first_token_at: Optional[float] = None
+    finished_at: Optional[float] = None
+    evictions: int = 0
+    _admit_seq: int = -1             # eviction picks the youngest
+    _work: Optional[np.ndarray] = None   # prompt (+generated, on resume)
+
+    @property
+    def tokens(self) -> np.ndarray:
+        """prompt + generated, the generate()-shaped result row."""
+        return np.concatenate(
+            [self.prompt, np.asarray(self.out, np.int32)])
+
+
+class ServingEngine:
+    """Continuous-batching front end for an ``InferenceEngine``.
+
+    ``num_blocks``/``hbm_budget_bytes`` bound the paged cache (the HBM
+    watermark); ``num_slots`` bounds the decode batch; ``prefill_chunk``
+    bounds how much prompt work one iteration may do (decode latency
+    stays O(chunk) under long-prompt arrivals).
+    """
+
+    def __init__(self, engine, *, num_slots: int = 4, block_size: int = 16,
+                 num_blocks: Optional[int] = None,
+                 hbm_budget_bytes: Optional[int] = None,
+                 prefill_chunk: int = 64, temperature: float = 0.0,
+                 top_k: int = 0, seed: int = 0):
+        if engine.is_encoder:
+            raise ValueError("serving needs a causal decoder engine")
+        self.engine = engine
+        self.cache = PagedKVCache(
+            engine.cfg, num_slots=num_slots, block_size=block_size,
+            num_blocks=num_blocks, hbm_budget_bytes=hbm_budget_bytes,
+            dtype=engine.dtype, max_seq_len=engine.max_seq_len)
+        self.num_slots = num_slots
+        self.prefill_chunk = int(prefill_chunk)
+        self.temperature = temperature
+        self.top_k = top_k
+        self._rng = jax.random.PRNGKey(seed)
+        self.queue: deque = deque()
+        self.slots: List[Optional[ServeRequest]] = [None] * num_slots
+        self.finished: List[ServeRequest] = []
+        self._progress = np.zeros((num_slots,), np.int64)  # prefilled toks
+        self._admit_counter = 0
+        self.stats = {"steps": 0, "occupancy_sum": 0, "peak_occupancy": 0,
+                      "evictions": 0, "admitted": 0, "completed": 0,
+                      "prefill_chunks": 0, "decode_steps": 0}
+
+    # -- API -----------------------------------------------------------
+    def submit(self, req: ServeRequest, now: float = 0.0) -> None:
+        total = len(req.prompt) + req.max_new_tokens
+        if total > self.engine.max_seq_len:
+            raise ValueError(
+                f"request {req.rid}: prompt {len(req.prompt)} + "
+                f"max_new {req.max_new_tokens} exceeds max_seq_len "
+                f"{self.engine.max_seq_len}")
+        if self.cache.blocks_for(total) > self.cache.num_blocks - 1:
+            raise ValueError(
+                f"request {req.rid} needs more blocks than the whole pool")
+        req.submitted_at = now
+        req._work = np.asarray(req.prompt, np.int32)
+        self.queue.append(req)
+
+    @property
+    def busy(self) -> bool:
+        return bool(self.queue) or any(s is not None for s in self.slots)
+
+    def step(self, now: Optional[float] = None) -> int:
+        """One scheduler iteration: admit, prefill chunks, decode.
+        Returns the number of decoding slots this iteration (the
+        occupancy sample)."""
+        if now is None:
+            now = float(self.stats["steps"])
+        self._admit()
+        self._prefill_step(now)
+        occ = self._decode_step(now)
+        self.stats["steps"] += 1
+        self.stats["occupancy_sum"] += occ
+        self.stats["peak_occupancy"] = max(self.stats["peak_occupancy"], occ)
+        return occ
+
+    def run(self, requests=None, max_steps: int = 1_000_000,
+            wall_clock: bool = False) -> Dict[Any, np.ndarray]:
+        """Drain: submit ``requests`` (if given) and step until idle.
+        Returns {rid: prompt+generated} like stacked generate() rows."""
+        done: Dict[Any, np.ndarray] = {}
+        for r in (requests or []):
+            self.submit(r)
+        steps = 0
+        while self.busy:
+            self.step(time.perf_counter() if wall_clock else None)
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(f"serving did not drain in {max_steps} "
+                                   f"steps (queue {len(self.queue)})")
+        for r in self.finished:
+            done[r.rid] = r.tokens
+        return done
+
+    # -- phases ----------------------------------------------------------
+    def _admit(self) -> None:
+        # FIFO head-of-line: no queue jumping, so a preempted-and-
+        # requeued request (appendleft) resumes before newer arrivals
+        while self.queue:
+            slot = next((i for i, s in enumerate(self.slots) if s is None),
+                        None)
+            if slot is None:
+                break
+            req = self.queue[0]
+            occupied = any(s is not None for s in self.slots)
+            if occupied:
+                ok = self.cache.can_admit(len(req._work))
+            else:
+                # idle engine: skip the watermark so a lone request that
+                # fits the pool always makes progress (no livelock)
+                ok = (self.cache.blocks_for(len(req._work))
+                      <= self.cache.free_blocks)
+            if not ok:
+                break
+            self.queue.popleft()
+            self.cache.allocate(slot, len(req._work))
+            self.slots[slot] = req
+            self._progress[slot] = 0
+            req.state = "prefill"
+            req._admit_seq = self._admit_counter
+            self._admit_counter += 1
+            self.stats["admitted"] += 1
+
+    def _prefill_step(self, now: float) -> None:
+        for slot, req in enumerate(self.slots):
+            if req is None or req.state != "prefill":
+                continue
+            done = int(self._progress[slot])
+            n = min(self.prefill_chunk, len(req._work) - done)
+            chunk = np.zeros((self.prefill_chunk,), np.int32)
+            chunk[:n] = req._work[done:done + n]
+            logits, self.cache.k, self.cache.v = \
+                self.engine.prefill_into_slot(
+                    self.cache.k, self.cache.v, self.cache.tables[slot],
+                    chunk, done, n)
+            self.cache.advance(slot, n)
+            self._progress[slot] = done + n
+            self.stats["prefill_chunks"] += 1
+            if self._progress[slot] == len(req._work):
+                # final chunk: its last-position logits yield the next
+                # token (== generate()'s prefill sample; on resume, the
+                # recomputed position is exactly the pre-eviction one)
+                self._emit(slot, req, logits, now)
+                if req.state != "done":
+                    req.state = "decode"
+
+    def _decode_step(self, now: float) -> int:
+        # every decoding slot needs room for ONE more token; exhaustion
+        # evicts the youngest request rather than OOMing the pool
+        for slot, req in enumerate(self.slots):
+            if req is None or req.state != "decode":
+                continue
+            while True:
+                try:
+                    self.cache.ensure_capacity(
+                        slot, int(self.cache.lengths[slot]) + 1)
+                    break
+                except CacheExhausted:
+                    if not self._evict_one(exclude=slot):
+                        # last resort: preempt this very request
+                        self._preempt(slot)
+                        break
+        live = [i for i, r in enumerate(self.slots)
+                if r is not None and r.state == "decode"]
+        if not live:
+            return 0
+        tokens = np.zeros((self.num_slots,), np.int32)
+        active = np.zeros((self.num_slots,), bool)
+        for i in live:
+            tokens[i] = self.slots[i].out[-1]
+            active[i] = True
+        logits, self.cache.k, self.cache.v = self.engine.decode_slots(
+            self.cache.k, self.cache.v, self.cache.tables,
+            self.cache.lengths, tokens, active)
+        self.stats["decode_steps"] += 1
+        for i in live:
+            self.cache.advance(i, 1)
+            self._emit(i, self.slots[i], logits[i:i + 1], now)
+        return len(live)
+
+    # -- helpers ---------------------------------------------------------
+    def _emit(self, slot: int, req: ServeRequest, logits, now: float) -> None:
+        self._rng, r = jax.random.split(self._rng)
+        tok = int(np.asarray(self.engine._sample(
+            logits, r, self.temperature, self.top_k))[0])
+        req.out.append(tok)
+        req.token_times.append(now)
+        if req.first_token_at is None:
+            req.first_token_at = now
+        if (len(req.out) >= req.max_new_tokens
+                or (req.eos_id is not None and tok == req.eos_id)):
+            req.state = "done"
+            req.finished_at = now
+            self.cache.free(slot)
+            self.slots[slot] = None
+            self.finished.append(req)
+            self.stats["completed"] += 1
+
+    def _evict_one(self, exclude: int) -> bool:
+        """Preempt the most recently admitted live request (LIFO — the
+        oldest work is closest to done) other than ``exclude``."""
+        victim = None
+        for i, r in enumerate(self.slots):
+            if i == exclude or r is None:
+                continue
+            if victim is None or r._admit_seq > self.slots[victim]._admit_seq:
+                victim = i
+        if victim is None:
+            return False
+        self._preempt(victim)
+        return True
+
+    def _preempt(self, slot: int) -> None:
+        """Free the slot and requeue its request for recompute-on-resume:
+        the new working prompt is prompt+generated, whose re-prefill
+        reproduces the pre-eviction cache and next-token logits exactly."""
+        req = self.slots[slot]
+        logger.info(f"serving: evicting request {req.rid} from slot {slot} "
+                    f"({self.cache.free_blocks} blocks free)")
+        req._work = req.tokens
+        req.state = "queued"
+        req.evictions += 1
+        self.stats["evictions"] += 1
+        self.cache.free(slot)
+        self.slots[slot] = None
+        self.queue.appendleft(req)
+

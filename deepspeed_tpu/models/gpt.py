@@ -554,7 +554,17 @@ def forward(params: Dict, tokens: jnp.ndarray, cfg: GPTConfig,
                    "sequence" if cfg.sequence_parallel else None, None)
 
     def _pin(t):
-        from jax.sharding import AxisType, get_abstract_mesh
+        try:
+            from jax.sharding import AxisType, get_abstract_mesh
+        except ImportError:
+            # jax<=0.4.x has no AxisType/abstract-mesh introspection:
+            # apply the constraint and fall back where the trace context
+            # rejects it (no mesh in scope, or a shard_map manual region
+            # — both raise at trace time on those versions)
+            try:
+                return jax.lax.with_sharding_constraint(t, carry_spec)
+            except Exception:
+                return t
         m = get_abstract_mesh()
         if m is None or m.empty or not {"data", "fsdp"} <= set(m.axis_names):
             return t  # no engine mesh in context (e.g. raw single-device)
@@ -907,6 +917,28 @@ def host_param_factory(seed: int, cfg: GPTConfig):
         return layer
 
     return factory
+
+
+def kv_bytes_per_token(cfg: GPTConfig, dtype=jnp.bfloat16) -> int:
+    """Bytes of K+V cache ONE token occupies across all layers — the
+    paged-cache allocator's budget unit (inference/paged_cache.py). The
+    static engine pays this for `max_batch x S_max` slots up front; the
+    paged cache pays it per token actually in flight."""
+    return int(2 * cfg.n_layers * cfg.kv_heads * cfg.head_dim
+               * jnp.dtype(dtype).itemsize)
+
+
+def decode_geometry(cfg: GPTConfig, block_size: int,
+                    max_seq_len: Optional[int] = None) -> Tuple[int, int]:
+    """(blocks_per_slot, tokens_per_slot) for a block-paged KV cache over
+    this config: the per-request block table is sized to cover the model's
+    maximum sequence, rounded up to whole blocks. Shared by the paged
+    cache, the serving scheduler and the engine's slot programs so all
+    three agree on the gathered cache's virtual length."""
+    assert block_size >= 1
+    s = max_seq_len or cfg.max_seq_len
+    nb = -(-s // block_size)
+    return nb, nb * block_size
 
 
 def num_params(cfg: GPTConfig) -> int:

@@ -29,6 +29,10 @@ from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax<=0.4.x spells it TPUCompilerParams
+_CompilerParams = getattr(pltpu, 'CompilerParams', None) \
+    or pltpu.TPUCompilerParams
+
 NEG_INF = -1e30
 
 
@@ -66,7 +70,11 @@ def resolve_window_impl(window, window_impl=None):
     if window is None or isinstance(window, tuple):
         return window
     impl = window_impl or os.environ.get("DS_FLASH_WINDOW_IMPL", "banded")
-    assert impl in ("banded", "masked"), impl
+    if impl not in ("banded", "masked"):
+        # ValueError, not assert: this validates user input (env var /
+        # config) and must survive python -O
+        raise ValueError(f"unknown window impl {impl!r}: "
+                         f"expected 'banded' or 'masked'")
     return ("masked", int(window)) if impl == "masked" else int(window)
 LANES = 128
 STATS = 8   # lane width for per-row softmax stats (lse/delta) — sublane-aligned
@@ -296,7 +304,7 @@ def _flash_fwd(q, k, v, mask, qsegs, ksegs, causal, scale, block_q, block_kv,
             pltpu.VMEM((block_q, D), jnp.float32),
         ],
         out_shape=out_shape,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
     )(*operands)
     return o, lse[..., 0]
@@ -489,7 +497,7 @@ def _flash_bwd(causal, scale, block_q, block_kv, window, res, g, q_off=0,
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         out_shape=jax.ShapeDtypeStruct(
             (B, H, S, D), jnp.float32 if out_fp32 else q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
     )(*operands)
 
@@ -565,7 +573,7 @@ def _flash_bwd(causal, scale, block_q, block_kv, window, res, g, q_off=0,
                 (B, H, Skv, D),
                 jnp.float32 if (group > 1 or out_fp32) else v.dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
     )(*operands)
 

@@ -75,6 +75,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from deepspeed_tpu.ops.attention.flash import (
     NEG_INF, _norm_window, _pad_heads, flash_block_bwd_t,
     flash_block_fwd_t, resolve_window_impl)
+from deepspeed_tpu.utils.jax_compat import axis_size, shard_map
 
 
 def _largest_divisor(n: int, cap: int) -> int:
@@ -275,7 +276,7 @@ def _rotate(xs, axis, perm):
 
 def _ring_fwd_inner(q, k, v, segs, kvm, axis, causal, scale, window,
                     use_flash, block_q, block_kv, chunk, layout):
-    n = jax.lax.axis_size(axis)
+    n = axis_size(axis)
     idx = jax.lax.axis_index(axis)
     B, S_loc, H, D = q.shape
     zig = layout == "zigzag"
@@ -409,7 +410,7 @@ def _ring_core_bwd(axis, causal, scale, window, use_flash, block_q,
                    block_kv, chunk, layout, res, g):
     q, k, v, segs, kvm, o, lse = res
     do = g
-    n = jax.lax.axis_size(axis)
+    n = axis_size(axis)
     idx = jax.lax.axis_index(axis)
     B, S_loc, H, D = q.shape
     Hkv = k.shape[2]
@@ -631,7 +632,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     in_specs = [spec, spec, spec,
                 None if segment_ids is None else tok_spec,
                 None if kv_mask is None else tok_spec]
-    mapped = jax.shard_map(
+    mapped = shard_map(
         inner, mesh=mesh,
         in_specs=tuple(in_specs),
         out_specs=spec,

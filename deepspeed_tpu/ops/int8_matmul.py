@@ -25,6 +25,10 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax<=0.4.x spells it TPUCompilerParams
+_CompilerParams = getattr(pltpu, 'CompilerParams', None) \
+    or pltpu.TPUCompilerParams
+
 
 def _dq_matmul_kernel(x_ref, q_ref, s_ref, o_ref, acc, *, num_k: int):
     ki = pl.program_id(2)
@@ -76,7 +80,7 @@ def int8_matmul(x: jnp.ndarray, q: jnp.ndarray, scale: jnp.ndarray,
                                lambda mi, ni, ki: (mi, ni)),
         scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
         out_shape=jax.ShapeDtypeStruct((Mp, N), x.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(x, q, scale)

@@ -29,6 +29,10 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax<=0.4.x spells it TPUCompilerParams
+_CompilerParams = getattr(pltpu, 'CompilerParams', None) \
+    or pltpu.TPUCompilerParams
+
 NEG_INF = -1e30
 LANES = 128
 
@@ -223,7 +227,7 @@ def _bs_pallas_fwd(q, k, v, lut, nnz, block, causal, scale):
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
     )(jnp.asarray(lut), jnp.asarray(nnz), q, k, v)

@@ -98,6 +98,12 @@ def mesh_from_config(cfg, devices: Optional[Sequence] = None) -> Mesh:
                         sequence=m.sequence_parallel_size,
                         model=m.tensor_parallel_size)
     else:
+        if m.replica_parallel_size > 1:
+            raise ValueError(
+                f"replica_parallel_size={m.replica_parallel_size} requires "
+                f"zero.stage=3 (it splits dp into data replicas x fsdp "
+                f"shards); stage {cfg.zero.stage} has no fsdp axis and "
+                f"would silently ignore it")
         spec = MeshSpec(pipe=m.pipeline_parallel_size, data=dp_total, fsdp=1,
                         sequence=m.sequence_parallel_size,
                         model=m.tensor_parallel_size)

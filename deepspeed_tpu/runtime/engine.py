@@ -37,6 +37,7 @@ from deepspeed_tpu.runtime.lr_schedules import get_lr_schedule
 from deepspeed_tpu.runtime.utils import (clip_by_global_norm, count_parameters,
                                          global_norm)
 from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.jax_compat import shard_map
 from deepspeed_tpu.utils.timer import (NoopTimer, SynchronizedWallClockTimer,
                                        ThroughputTimer, TRAIN_BATCH_TIMER)
 
@@ -722,7 +723,7 @@ class DeepSpeedEngine:
             espec = tuple(P("data") for _ in err_leaves)
             pspec = jax.tree_util.tree_map(lambda _: P(), params)
             bspec = jax.tree_util.tree_map(lambda _: P("data"), batch)
-            out = jax.shard_map(
+            out = shard_map(
                 local_fn, mesh=mesh,
                 in_specs=(pspec, bspec, espec),
                 out_specs=(gspecs, espec, P(), P()),
@@ -1026,7 +1027,11 @@ class DeepSpeedEngine:
         from deepspeed_tpu.utils.trace import annotation
         # mesh in context: models can pin activation layouts with bare
         # PartitionSpecs (gpt.py scan-carry constraint) during tracing
-        with annotation("ds.train_batch"), jax.set_mesh(self.mesh):
+        # jax.set_mesh is the 0.5+ spelling; older jax enters the Mesh
+        # itself as the context manager to the same effect
+        with annotation("ds.train_batch"), \
+                (jax.set_mesh(self.mesh) if hasattr(jax, "set_mesh")
+                 else self.mesh):
             if self.offload_enabled:
                 metrics = self._offload_train_batch(batch)
             else:

@@ -1,0 +1,46 @@
+"""Version bridge for jax APIs that moved between 0.4.x and 0.5+.
+
+The codebase targets the 0.5+ spellings; this module maps them onto
+what an older installed jax actually provides so the same source runs
+on both. Keep every bridge here (one import site to delete when the
+floor moves past 0.5).
+"""
+
+import jax
+
+
+def axis_size(axis_name):
+    """``jax.lax.axis_size`` (0.5+); 0.4.x gets it from ``psum(1, axis)``,
+    which the tracer folds to the same static int inside a manual region."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+              check_vma=True):
+    """``jax.shard_map`` with the 0.5+ keyword surface.
+
+    On 0.4.x this lowers to ``jax.experimental.shard_map.shard_map``:
+    ``axis_names`` (the MANUAL axes) becomes its complement ``auto``,
+    and ``check_vma`` maps to the old ``check_rep``.
+    """
+    if hasattr(jax, "shard_map"):
+        kw = {} if axis_names is None else {"axis_names": axis_names}
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma, **kw)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    # a size-1 axis splits nothing: treating it as manual instead of
+    # auto is an identity, so only size>1 auto axes are truly partial
+    auto = (frozenset() if axis_names is None
+            else frozenset(a for a in mesh.axis_names
+                           if a not in axis_names and mesh.shape[a] > 1))
+    if auto:
+        # 0.4.x ``auto=`` (partial-manual) is experimental enough that the
+        # XLA lowering can abort the whole process — refuse cleanly instead
+        raise NotImplementedError(
+            f"shard_map over a subset of mesh axes (manual {set(axis_names)} "
+            f"of {set(mesh.axis_names)}) needs jax>=0.5; this jax "
+            f"{jax.__version__} only supports full-manual shard_map")
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=check_vma)

@@ -160,7 +160,23 @@ def test_bert_mlm_loss_chunked_parity():
 # property-based chunked-CE invariants (hypothesis)
 # ---------------------------------------------------------------------------
 
-from hypothesis import given, settings, strategies as st
+try:
+    from hypothesis import given, settings, strategies as st
+except ModuleNotFoundError:  # environment without hypothesis: collect the
+    # rest of the module and skip just the property tests
+    import pytest as _pytest
+
+    def given(*a, **k):
+        return _pytest.mark.skip(reason="hypothesis not installed")
+
+    def settings(*a, **k):
+        return lambda f: f
+
+    class _NoStrategies:
+        def __getattr__(self, name):
+            return lambda *a, **k: None
+
+    st = _NoStrategies()
 
 
 @settings(max_examples=25, deadline=None)

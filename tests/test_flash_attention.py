@@ -284,7 +284,7 @@ def test_window_impl_env_default(devices, monkeypatch):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-3, atol=2e-3)
     monkeypatch.setenv("DS_FLASH_WINDOW_IMPL", "bogus")
-    with pytest.raises(AssertionError):
+    with pytest.raises(ValueError, match="window impl"):
         F.flash_attention(q, k, v, causal=True, block_q=128,
                           block_kv=128, window=64)
 

@@ -224,7 +224,23 @@ def test_guard_wrappers_raise():
 # property-based estimator invariants (hypothesis)
 # ---------------------------------------------------------------------------
 
-from hypothesis import given, settings, strategies as st
+try:
+    from hypothesis import given, settings, strategies as st
+except ModuleNotFoundError:  # environment without hypothesis: collect the
+    # rest of the module and skip just the property tests
+    import pytest as _pytest
+
+    def given(*a, **k):
+        return _pytest.mark.skip(reason="hypothesis not installed")
+
+    def settings(*a, **k):
+        return lambda f: f
+
+    class _NoStrategies:
+        def __getattr__(self, name):
+            return lambda *a, **k: None
+
+    st = _NoStrategies()
 
 
 @settings(max_examples=30, deadline=None)

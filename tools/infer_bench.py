@@ -179,6 +179,117 @@ SPEC_CONFIGS = [
 ]
 
 
+def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
+                  prompt_lens=(8, 48), new_tokens=24, num_slots=4,
+                  block_size=16, num_blocks=None, prefill_chunk=32,
+                  int8=False, int8_fused=False, seed=0):
+    """Continuous-batching serving row: synthetic Poisson arrivals driven
+    through ServingEngine.step, wall-clock tokens/s, per-token (TPOT)
+    latency percentiles from the scheduler's token timestamps, decode-
+    slot utilization, and the paged-vs-static KV HBM accounting.
+
+    Arrivals are in SCHEDULER-STEP units (deterministic under ``seed``):
+    request i is submitted before the first step >= its exponential-gap
+    cumsum. ``preset=None`` runs a CPU-smoke-sized model.
+    """
+    from deepspeed_tpu.models import gpt
+    import deepspeed_tpu
+    from deepspeed_tpu.inference.serving import ServeRequest, ServingEngine
+
+    on_tpu = "tpu" in (jax.devices()[0].platform +
+                       jax.devices()[0].device_kind).lower()
+    max_seq = prompt_lens[1] + new_tokens + 8
+    if preset:
+        cfg = gpt.preset(preset, max_seq_len=max_seq, dtype=jnp.bfloat16,
+                         use_flash_attention=on_tpu)
+    else:
+        cfg = gpt.GPTConfig(vocab_size=512, n_layers=4, n_heads=8,
+                            d_model=256, max_seq_len=max_seq,
+                            use_flash_attention=False, remat=False,
+                            dtype=jnp.float32)
+    if int8_fused:
+        os.environ["DS_INT8_FUSED"] = "1"
+    else:
+        os.environ.pop("DS_INT8_FUSED", None)
+    act_dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    eng = deepspeed_tpu.init_inference(
+        model=(cfg, gpt.init_params(jax.random.PRNGKey(0), cfg)),
+        dtype=jnp.int8 if int8 else act_dtype)
+    srv = ServingEngine(eng, num_slots=num_slots, block_size=block_size,
+                        num_blocks=num_blocks, prefill_chunk=prefill_chunk)
+
+    rng = np.random.default_rng(seed)
+    arrive = np.floor(np.cumsum(
+        rng.exponential(mean_gap_steps, num_requests))).astype(int)
+    reqs = [ServeRequest(
+        rid=i,
+        prompt=rng.integers(0, cfg.vocab_size,
+                            rng.integers(*prompt_lens)).astype(np.int32),
+        max_new_tokens=new_tokens) for i in range(num_requests)]
+
+    # warmup: compile both slot programs before the timed drive
+    w = ServingEngine(eng, num_slots=num_slots, block_size=block_size,
+                      num_blocks=num_blocks, prefill_chunk=prefill_chunk)
+    w.run([ServeRequest(rid="w", prompt=reqs[0].prompt.copy(),
+                        max_new_tokens=2)])
+
+    t0 = time.perf_counter()
+    step = 0
+    nxt = 0
+    while nxt < num_requests or srv.busy:
+        while nxt < num_requests and arrive[nxt] <= step:
+            srv.submit(reqs[nxt], now=time.perf_counter())
+            nxt += 1
+        srv.step(now=time.perf_counter())
+        step += 1
+    wall_s = time.perf_counter() - t0
+
+    tpot_ms = np.concatenate(
+        [np.diff(r.token_times) for r in srv.finished
+         if len(r.token_times) > 1]) * 1e3
+    gen_tokens = sum(len(r.out) for r in srv.finished)
+    st = srv.stats
+    cache = srv.cache
+    blk_bytes = gpt.kv_bytes_per_token(cfg, cache.dtype) * block_size
+    print(json.dumps({
+        "config": name, "preset": preset or "cpu-smoke",
+        "num_requests": num_requests, "new_tokens": new_tokens,
+        "num_slots": num_slots, "block_size": block_size,
+        "tokens_per_s": round(gen_tokens / wall_s, 1),
+        "tpot_ms_p50": round(float(np.percentile(tpot_ms, 50)), 3),
+        "tpot_ms_p99": round(float(np.percentile(tpot_ms, 99)), 3),
+        "mean_occupancy": round(st["occupancy_sum"]
+                                / max(st["decode_steps"], 1), 2),
+        "peak_occupancy": st["peak_occupancy"],
+        "slot_utilization": round(st["occupancy_sum"]
+                                  / (max(st["steps"], 1) * num_slots), 2),
+        "evictions": st["evictions"],
+        "peak_kv_bytes_paged": int(cache.peak_used_blocks * blk_bytes),
+        "static_kv_bytes": int(cache.static_equivalent_bytes(num_slots)),
+        "completed": st["completed"],
+    }), flush=True)
+
+
+SERVE_CONFIGS = [
+    # CPU-verifiable smoke: staggered Poisson arrivals must batch
+    # (mean_occupancy > 1) and the paged footprint must undercut the
+    # static num_slots x S_max reservation
+    ("serve-smoke", dict(num_requests=12, mean_gap_steps=2.0,
+                         prompt_lens=(8, 40), new_tokens=16, num_slots=4,
+                         block_size=8, prefill_chunk=16)),
+    # on-chip rows: bf16 and weight-only int8 through the same scheduler
+    # (int8-fused additionally routes dense through ops/int8_matmul)
+    ("serve-gpt2-medium", dict(preset="gpt2-medium", num_requests=32,
+                               mean_gap_steps=1.5, prompt_lens=(64, 384),
+                               new_tokens=64, num_slots=8,
+                               block_size=16, prefill_chunk=128)),
+    ("serve-gpt2-medium-int8-fused", dict(
+        preset="gpt2-medium", num_requests=32, mean_gap_steps=1.5,
+        prompt_lens=(64, 384), new_tokens=64, num_slots=8,
+        block_size=16, prefill_chunk=128, int8=True, int8_fused=True)),
+]
+
+
 def main():
     from deepspeed_tpu.utils.hbm import MemoryGuardError
     for name, kw in CONFIGS:
@@ -193,6 +304,15 @@ def main():
     for name, kw in SPEC_CONFIGS:
         try:
             bench_speculative(name, **kw)
+        except MemoryGuardError as e:
+            print(json.dumps({"config": name, "skipped": "memory guard",
+                              "why": str(e)[:300]}), flush=True)
+        except Exception as e:
+            print(json.dumps({"config": name, "error": repr(e)[:200]}),
+                  flush=True)
+    for name, kw in SERVE_CONFIGS:
+        try:
+            bench_serving(name, **kw)
         except MemoryGuardError as e:
             print(json.dumps({"config": name, "skipped": "memory guard",
                               "why": str(e)[:300]}), flush=True)
