@@ -209,13 +209,18 @@ def _gather_blocks(pool, tables):
 
 
 def _block_decode_paged(x, k_pool, v_pool, tables, lengths, active, p,
-                        cfg: GPTConfig):
-    """One block for ONE new token per slot, K/V gathered through block
+                        cfg: GPTConfig, impl: str = "gather"):
+    """One block for ONE new token per slot, K/V addressed through block
     tables — the paged generalization of _block_decode. x: [B, 1, D];
     pools [N, block, Hkv, Dh]; tables [B, NB]; lengths [B] per-slot
     cache positions (each slot decodes at its OWN position — the
     continuous-batching contract); active [B] bool (inactive slots'
-    writes land in trash block 0 and their logits are ignored)."""
+    writes land in trash block 0 and their logits are ignored).
+
+    impl="gather" materializes the virtual cache with _gather_blocks
+    (the bit-reference, portable everywhere); impl="pallas" attends
+    THROUGH the table with the flash-decode kernel (ops/attention/
+    paged.py) — one pool-block DMA per occupied block, no dense copy."""
     B, _, D = x.shape
     H, Dh = cfg.n_heads, cfg.head_dim
     Hkv = cfg.kv_heads
@@ -235,28 +240,40 @@ def _block_decode_paged(x, k_pool, v_pool, tables, lengths, active, p,
     k = k.reshape(B, Hkv, Dh)
     v = v.reshape(B, Hkv, Dh)
 
-    # scatter the new token's K/V into each slot's current block
+    # scatter the new token's K/V into each slot's current block; a slot
+    # whose block budget is exhausted (lengths == NB*bs) would CLAMP to
+    # the last block's live data — route it to the trash block instead
+    # (serving.py finishes such slots before they reach here; the mask
+    # is the engine-side belt to that suspender)
+    in_cap = lengths < NB * bs
     blk = jnp.take_along_axis(
         tables, jnp.clip(lengths // bs, 0, NB - 1)[:, None], axis=1)[:, 0]
-    blk = jnp.where(active, blk, 0)          # inactive -> trash block
+    blk = jnp.where(jnp.logical_and(active, in_cap), blk, 0)
     off = lengths % bs
     k_pool = k_pool.at[blk, off].set(k)
     v_pool = v_pool.at[blk, off].set(v)
 
-    kc = _gather_blocks(k_pool, tables)      # [B, NB*bs, Hkv, Dh]
-    vc = _gather_blocks(v_pool, tables)
-    scores = jnp.einsum("bkgd,bskd->bkgs", q, kc).astype(jnp.float32)
-    scores *= cfg.attn_scale if cfg.attn_scale is not None \
+    scale = cfg.attn_scale if cfg.attn_scale is not None \
         else 1.0 / np.sqrt(Dh)
-    idx = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, NB * bs), 3)
-    pos = lengths[:, None, None, None]
-    scores = jnp.where(idx <= pos, scores, -1e30)
-    if cfg.attn_window is not None:
-        # block tables keep logical order, so cache-index distance IS
-        # logical distance — same banding as the static decode
-        scores = jnp.where(idx > pos - cfg.attn_window, scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-    attn = jnp.einsum("bkgs,bskd->bkgd", probs, vc).reshape(B, 1, D)
+    if impl == "pallas":
+        from deepspeed_tpu.ops.attention.paged import paged_decode_attention
+        attn = paged_decode_attention(
+            q, k_pool, v_pool, tables, lengths, scale=float(scale),
+            window=cfg.attn_window).reshape(B, 1, D)
+    else:
+        kc = _gather_blocks(k_pool, tables)  # [B, NB*bs, Hkv, Dh]
+        vc = _gather_blocks(v_pool, tables)
+        scores = jnp.einsum("bkgd,bskd->bkgs", q, kc).astype(jnp.float32)
+        scores *= scale
+        idx = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, NB * bs), 3)
+        pos = lengths[:, None, None, None]
+        scores = jnp.where(idx <= pos, scores, -1e30)
+        if cfg.attn_window is not None:
+            # block tables keep logical order, so cache-index distance IS
+            # logical distance — same banding as the static decode
+            scores = jnp.where(idx > pos - cfg.attn_window, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        attn = jnp.einsum("bkgs,bskd->bkgd", probs, vc).reshape(B, 1, D)
     attn = _dense(attn, p["attn_out"])
     if cfg.parallel_residual:
         return x + attn + _ffn(h, p, cfg), k_pool, v_pool
@@ -327,7 +344,8 @@ class InferenceEngine:
                  dtype=jnp.bfloat16, max_seq_len: Optional[int] = None,
                  mesh: Optional[Mesh] = None,
                  replace_with_kernel_inject: bool = True,
-                 checkpoint: Optional[str] = None, **kwargs):
+                 checkpoint: Optional[str] = None,
+                 decode_impl: Optional[str] = None, **kwargs):
         if model is not None and (config is None or params is None):
             from deepspeed_tpu.inference.policy import resolve_model
             config, params = resolve_model(model)
@@ -346,6 +364,11 @@ class InferenceEngine:
         self.max_seq_len = max_seq_len or config.max_seq_len
         self.mp_size = mp_size
         self.latency_ms: Dict[str, float] = {}
+        # paged decode attention path: "pallas" (flash-decode through the
+        # block table) or "gather" (dense reference); default resolves
+        # DS_PAGED_DECODE_IMPL then platform (pallas on TPU)
+        from deepspeed_tpu.ops.attention.paged import resolve_decode_impl
+        self.decode_impl = resolve_decode_impl(decode_impl)
 
         if mesh is None:
             n = len(jax.devices())
@@ -420,8 +443,12 @@ class InferenceEngine:
             # doubles in HBM across a step
             self._prefill_slot = jax.jit(self._prefill_slot_fn,
                                          donate_argnums=(1, 2))
+            # impl is static: each attention path ("gather" | "pallas")
+            # is its own compiled program; a serving run pins one impl so
+            # steady state remains two programs
             self._decode_slots = jax.jit(self._decode_slots_fn,
-                                         donate_argnums=(1, 2))
+                                         donate_argnums=(1, 2),
+                                         static_argnums=(7,))
         log_dist(f"inference engine: {config.n_layers}L/{config.d_model}d "
                  f"mp={mp_size} dtype={jnp.dtype(dtype).name} "
                  f"{'encoder' if self.is_encoder else 'decoder'}",
@@ -546,13 +573,15 @@ class InferenceEngine:
         return self._logits(params, x_last), ks, vs
 
     def _decode_slots_fn(self, params, k_pool, v_pool, tables, lengths,
-                         tokens, active):
+                         tokens, active, impl="gather"):
         """One decode step for EVERY serving slot at once. tokens: [B]
         (each slot's pending token); lengths: [B] per-slot cache
         positions; active: [B] (inactive slots run but write to the
         trash block and their logits are discarded). The slot-batched
         shape is static, so any mix of requests reuses this one
-        compiled program."""
+        compiled program. impl is a STATIC jit argument ("gather" |
+        "pallas") selecting the attention path per compiled program —
+        see _block_decode_paged."""
         cfg = self.cfg
         x = params["wte"]["embedding"][tokens[:, None]]
         if cfg.use_wpe:
@@ -562,7 +591,8 @@ class InferenceEngine:
         def body(x, layer):
             layer_p, kp, vp = layer
             y, kp, vp = _block_decode_paged(x, kp, vp, tables, lengths,
-                                            active, layer_p, cfg)
+                                            active, layer_p, cfg,
+                                            impl=impl)
             return y, (kp, vp)
 
         x, (ks, vs) = jax.lax.scan(body, x,
@@ -578,12 +608,14 @@ class InferenceEngine:
             jnp.asarray(tokens, jnp.int32),
             jnp.asarray(start, jnp.int32), jnp.asarray(n_valid, jnp.int32))
 
-    def decode_slots(self, k_pool, v_pool, tables, lengths, tokens, active):
+    def decode_slots(self, k_pool, v_pool, tables, lengths, tokens, active,
+                     impl=None):
         return self._decode_slots(
             self.params, k_pool, v_pool,
             jnp.asarray(tables, jnp.int32),
             jnp.asarray(lengths, jnp.int32),
-            jnp.asarray(tokens, jnp.int32), jnp.asarray(active, bool))
+            jnp.asarray(tokens, jnp.int32), jnp.asarray(active, bool),
+            self.decode_impl if impl is None else impl)
 
     def _forward_fn(self, params, tokens):
         x = self._embed(params, tokens)

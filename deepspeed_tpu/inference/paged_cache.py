@@ -116,6 +116,13 @@ class PagedKVCache:
     def blocks_for(self, n_tokens: int) -> int:
         return -(-int(n_tokens) // self.block_size)
 
+    def at_capacity(self, slot: int) -> bool:
+        """True when the slot's cache has consumed its whole block
+        budget: the next decode write would CLAMP into the last live
+        block (inference/engine.py masks it to the trash block), so the
+        scheduler must finish the request before the kernel runs."""
+        return int(self.lengths[slot]) >= self.tokens_per_slot
+
     def can_admit(self, n_tokens: int) -> bool:
         """Admission-control check: prompt blocks available AND the
         watermark reserve stays intact so live slots can keep growing."""

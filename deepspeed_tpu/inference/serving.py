@@ -81,10 +81,21 @@ class ServingEngine:
                  num_blocks: Optional[int] = None,
                  hbm_budget_bytes: Optional[int] = None,
                  prefill_chunk: int = 64, temperature: float = 0.0,
-                 top_k: int = 0, seed: int = 0):
+                 top_k: int = 0, seed: int = 0,
+                 decode_impl: Optional[str] = None):
         if engine.is_encoder:
             raise ValueError("serving needs a causal decoder engine")
         self.engine = engine
+        # decode attention path ("pallas" flash-decode through the block
+        # table | "gather" dense reference); defaults to the engine's
+        # resolved choice so env/platform selection applies uniformly.
+        # Pinned for the run: impl is a static jit arg, so ONE impl keeps
+        # steady state at two compiled programs.
+        if decode_impl is None:
+            self.decode_impl = engine.decode_impl
+        else:
+            from deepspeed_tpu.ops.attention.paged import resolve_decode_impl
+            self.decode_impl = resolve_decode_impl(decode_impl)
         self.cache = PagedKVCache(
             engine.cfg, num_slots=num_slots, block_size=block_size,
             num_blocks=num_blocks, hbm_budget_bytes=hbm_budget_bytes,
@@ -212,6 +223,19 @@ class ServingEngine:
         for slot, req in enumerate(self.slots):
             if req is None or req.state != "decode":
                 continue
+            if self.cache.at_capacity(slot):
+                # block budget exhausted: the kernel's next cache write
+                # would clamp into the slot's LAST LIVE block — finish
+                # (truncate) the request before it reaches the kernel.
+                # Eviction is no escape: the resume prompt is just as
+                # long, so a preempted slot would requeue forever.
+                logger.warning(
+                    f"serving: request {req.rid} hit the per-slot block "
+                    f"budget ({self.cache.tokens_per_slot} tokens) in "
+                    f"slot {slot}; finishing with {len(req.out)} of "
+                    f"{req.max_new_tokens} tokens")
+                self._finish(slot, req, now)
+                continue
             while True:
                 try:
                     self.cache.ensure_capacity(
@@ -233,7 +257,7 @@ class ServingEngine:
             active[i] = True
         logits, self.cache.k, self.cache.v = self.engine.decode_slots(
             self.cache.k, self.cache.v, self.cache.tables,
-            self.cache.lengths, tokens, active)
+            self.cache.lengths, tokens, active, impl=self.decode_impl)
         self.stats["decode_steps"] += 1
         for i in live:
             self.cache.advance(i, 1)
@@ -241,6 +265,15 @@ class ServingEngine:
         return len(live)
 
     # -- helpers ---------------------------------------------------------
+    def _finish(self, slot: int, req: ServeRequest, now: float) -> None:
+        """Retire a request: blocks back to the pool, slot reopened."""
+        req.state = "done"
+        req.finished_at = now
+        self.cache.free(slot)
+        self.slots[slot] = None
+        self.finished.append(req)
+        self.stats["completed"] += 1
+
     def _emit(self, slot: int, req: ServeRequest, logits, now: float) -> None:
         self._rng, r = jax.random.split(self._rng)
         tok = int(np.asarray(self.engine._sample(
@@ -251,12 +284,7 @@ class ServingEngine:
             req.first_token_at = now
         if (len(req.out) >= req.max_new_tokens
                 or (req.eos_id is not None and tok == req.eos_id)):
-            req.state = "done"
-            req.finished_at = now
-            self.cache.free(slot)
-            self.slots[slot] = None
-            self.finished.append(req)
-            self.stats["completed"] += 1
+            self._finish(slot, req, now)
 
     def _evict_one(self, exclude: int) -> bool:
         """Preempt the most recently admitted live request (LIFO — the

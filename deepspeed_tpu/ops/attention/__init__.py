@@ -1,0 +1,5 @@
+from deepspeed_tpu.ops.attention.paged import (  # noqa: F401
+    paged_decode_attention,
+    paged_decode_reference,
+    resolve_decode_impl,
+)

@@ -1,0 +1,274 @@
+"""Paged-attention decode kernel — Pallas TPU flash-decode through the
+block table.
+
+The serving engine's gather path (`inference/engine.py _gather_blocks`)
+materializes the WHOLE virtual cache ``[B, NB*block, Hkv, Dh]`` out of
+the block pool every layer, every decoded token, then masks everything
+past ``lengths``: per token that is O(S_max) HBM reads plus an
+equal-size HBM write of the transient gathered copy, x2 (K, V) xL
+layers — decode is gather-bound and the paged cache's memory win is
+undone by a dense copy that exists only to feed two einsums.
+
+This kernel attends THROUGH the block table instead (vLLM's
+PagedAttention, Kwon et al. 2023, with FlashAttention-2's online
+softmax, Dao 2023):
+
+- block tables and per-slot lengths ride in as scalar-prefetch operands
+  (``pltpu.PrefetchScalarGridSpec``), so the K/V BlockSpec index_map
+  dereferences ``tables[b, j]`` BEFORE the grid step runs and each step
+  DMAs exactly one pool block ``[block, Hkv, Dh]`` from HBM — no dense
+  gather copy ever exists;
+- grid ``(B, NB)`` with the KV (block) dimension innermost; fp32
+  running max / sum / accumulator live in VMEM scratch across the
+  sequential block iterations (the FA2 online softmax);
+- ``pl.when`` skips blocks entirely past ``lengths[b]`` — and, with a
+  sliding ``window``, blocks entirely below the band start — while the
+  index_map CLAMPS skipped steps to the nearest in-band block so their
+  index equals a neighbor step's and Mosaic elides the DMA (the same
+  causal-clamp trick as ops/attention/flash.py): per-token HBM traffic
+  is O(actual length), not O(S_max);
+- GQA: the kv-head loop is unrolled IN the kernel body (Hkv is static
+  and small), packing the ``group = H // Hkv`` query heads that share a
+  kv head into one MXU matmul per head. Folding the head loop into the
+  body — rather than a (B, Hkv, NB) grid — means one pool block fetch
+  serves ALL kv heads (the pool's native layout is
+  ``[N, block, Hkv, Dh]``, so a per-head grid would re-DMA each block
+  Hkv times or force a full-pool relayout);
+- the final partial block is masked by position exactly like the gather
+  path, so the two implementations are numerically interchangeable (the
+  gather path stays the bit-reference, see docs/PARITY.md).
+
+The gather path remains the reference implementation and the non-TPU
+default; tests drive this kernel in interpret mode under
+``JAX_PLATFORMS=cpu`` (tests/test_paged_attention.py).
+"""
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# jax<=0.4.x spells it TPUCompilerParams
+_CompilerParams = getattr(pltpu, 'CompilerParams', None) \
+    or pltpu.TPUCompilerParams
+
+NEG_INF = -1e30
+LANES = 128
+
+
+def resolve_decode_impl(impl: Optional[str] = None) -> str:
+    """Resolve the paged-decode implementation switch.
+
+    Explicit argument wins, else the ``DS_PAGED_DECODE_IMPL`` env var,
+    else the platform default: ``"pallas"`` on TPU, ``"gather"``
+    elsewhere (the gather path is the reference implementation and the
+    portable fallback). Shared by InferenceEngine and ServingEngine so
+    env overrides work uniformly."""
+    if impl is None:
+        impl = os.environ.get("DS_PAGED_DECODE_IMPL") or None
+    if impl is None:
+        from deepspeed_tpu.utils import on_tpu
+        impl = "pallas" if on_tpu() else "gather"
+    if impl not in ("pallas", "gather"):
+        # ValueError, not assert: validates user input (env var / config)
+        # and must survive python -O
+        raise ValueError(f"unknown paged decode impl {impl!r}: "
+                         f"expected 'pallas' or 'gather'")
+    return impl
+
+
+def paged_hbm_bytes_per_token(cfg, num_slots: int, mean_len: float,
+                              max_len: int, dtype=jnp.bfloat16,
+                              impl: str = "pallas") -> int:
+    """Analytic HBM bytes the attention cache path moves per decoded
+    token (all layers, K+V) — the PERF.md comparison unit.
+
+    gather: reads the whole ``[B, NB*block, ...]`` virtual cache out of
+    the pool AND writes the transient gathered copy, then the einsums
+    read the copy again — 3 passes over ``num_slots * max_len`` tokens.
+    pallas: reads only the occupied blocks of each live slot, once."""
+    per_tok = int(2 * cfg.n_layers * cfg.kv_heads * cfg.head_dim
+                  * jnp.dtype(dtype).itemsize)
+    if impl == "gather":
+        return 3 * num_slots * int(max_len) * per_tok
+    return int(num_slots * mean_len) * per_tok
+
+
+def _kv_index_map(bs: int, nb: int, window: Optional[int]):
+    """Block index map for the K/V pools when the grid is (b, j) and the
+    pools are scalar-prefetch-addressed: step (b, j) fetches pool block
+    ``tables[b, clamp(j)]``. Steps past the slot's last occupied block
+    clamp DOWN to it, steps below the sliding-window band clamp UP to
+    the band's first block — either way the skipped step's index equals
+    a run step's (or its neighbor's), so Mosaic elides the DMA exactly
+    like the causal clamp in ops/attention/flash.py."""
+    def imap(b, j, tables_ref, lengths_ref):
+        pos = lengths_ref[b]
+        hi = jnp.minimum(pos // bs, nb - 1)
+        jj = jnp.minimum(j, hi)
+        if window is not None:
+            lo = jnp.clip((pos - window + 1) // bs, 0, nb - 1)
+            jj = jnp.maximum(jj, lo)
+        return (tables_ref[b, jj], 0, 0, 0)
+
+    return imap
+
+
+def _paged_decode_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref,
+                         o_ref, m_scratch, l_scratch, acc_scratch, *,
+                         bs: int, n_kv: int, group: int, scale: float,
+                         window: Optional[int], nb: int):
+    """One (slot, pool-block) grid step of flash-decode.
+
+    q_ref: [1, H, Dh] (H = n_kv * group, grouped head-major); k_ref /
+    v_ref: [1, bs, Hkv, Dh] — ONE pool block, already table-indirected
+    by the index_map; scratch: running max / sum / fp32 accumulator per
+    query head, persistent across the j (block) iterations of slot b."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    pos = lengths_ref[b]
+    hi = jnp.minimum(pos // bs, nb - 1)      # last occupied block
+
+    @pl.when(j == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    run = j <= hi
+    if window is not None:
+        lo = jnp.clip((pos - window + 1) // bs, 0, nb - 1)
+        run = jnp.logical_and(run, j >= lo)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]                          # [H, Dh]
+        k = k_ref[0]                          # [bs, Hkv, Dh]
+        v = v_ref[0]
+        # positions of this block's slots in the slot's virtual cache;
+        # the final partial block masks by position exactly like the
+        # gather path (idx <= pos, and the window band below it)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (group, bs), 1) + j * bs
+        valid = cols <= pos
+        if window is not None:
+            valid = jnp.logical_and(valid, cols > pos - window)
+
+        for h in range(n_kv):                 # static unroll: Hkv is small
+            rows = slice(h * group, (h + 1) * group)
+            qh = q[rows, :]                   # [group, Dh] — one MXU matmul
+            kh = k[:, h, :]                   # [bs, Dh]     covers the whole
+            vh = v[:, h, :]                   # GQA group of this kv head
+            s = jax.lax.dot_general(
+                qh, kh, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale   # [group, bs]
+            s = jnp.where(valid, s, NEG_INF)
+
+            m_prev = m_scratch[rows, :1]                     # [group, 1]
+            m_cur = jnp.max(s, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            p = jnp.exp(s - m_new)                           # [group, bs]
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = alpha * l_scratch[rows, :1] \
+                + jnp.sum(p, axis=-1, keepdims=True)
+            acc_scratch[rows, :] = acc_scratch[rows, :] * alpha \
+                + jax.lax.dot_general(
+                    p.astype(vh.dtype), vh, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+            m_scratch[rows, :] = jnp.broadcast_to(
+                m_new, (group, m_scratch.shape[1]))
+            l_scratch[rows, :] = jnp.broadcast_to(
+                l_new, (group, l_scratch.shape[1]))
+
+    @pl.when(j == hi)
+    def _finish():
+        l = l_scratch[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scratch[:] / l_safe).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
+                           v_pool: jnp.ndarray, tables: jnp.ndarray,
+                           lengths: jnp.ndarray, *, scale: float,
+                           window: Optional[int] = None,
+                           interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Flash-decode one new token per serving slot THROUGH the block
+    table — no dense cache materialization.
+
+    q: [B, Hkv, group, Dh] post-rotary queries (grouped per shared kv
+    head); k_pool / v_pool: [N, block, Hkv, Dh] pools (the new token's
+    K/V must already be scattered in at position ``lengths[b]``);
+    tables: [B, NB] int32 block tables (trash-block-0 convention for
+    unused entries); lengths: [B] int32 per-slot cache positions (slot b
+    attends positions <= lengths[b], banded by ``window`` when set).
+
+    Returns [B, Hkv, group, Dh] in q's dtype. ``interpret`` defaults to
+    True off-TPU so the same call tests on CPU (interpret mode) and
+    compiles through Mosaic on chip."""
+    B, n_kv, group, Dh = q.shape
+    N, bs, Hkv, Dh_p = k_pool.shape
+    assert (n_kv, Dh) == (Hkv, Dh_p), (q.shape, k_pool.shape)
+    assert v_pool.shape == k_pool.shape, (v_pool.shape, k_pool.shape)
+    nb = tables.shape[1]
+    H = n_kv * group
+    if interpret is None:
+        from deepspeed_tpu.utils import on_tpu
+        interpret = not on_tpu()
+
+    kvmap = _kv_index_map(bs, nb, window)
+
+    def qmap(b, j, tables_ref, lengths_ref):
+        return (b, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, nb),
+        in_specs=[
+            pl.BlockSpec((1, H, Dh), qmap),
+            pl.BlockSpec((1, bs, Hkv, Dh), kvmap),
+            pl.BlockSpec((1, bs, Hkv, Dh), kvmap),
+        ],
+        out_specs=pl.BlockSpec((1, H, Dh), qmap),
+        scratch_shapes=[
+            pltpu.VMEM((H, LANES), jnp.float32),
+            pltpu.VMEM((H, LANES), jnp.float32),
+            pltpu.VMEM((H, Dh), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _paged_decode_kernel, bs=bs, n_kv=n_kv, group=group,
+        scale=float(scale), window=window, nb=nb)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, Dh), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(jnp.asarray(tables, jnp.int32), jnp.asarray(lengths, jnp.int32),
+      q.reshape(B, H, Dh), k_pool, v_pool)
+    return out.reshape(B, n_kv, group, Dh)
+
+
+def paged_decode_reference(q, k_pool, v_pool, tables, lengths, *, scale,
+                           window=None):
+    """Dense gather reference of :func:`paged_decode_attention` for the
+    parity tests — the same math as the engine's gather path
+    (inference/engine.py _block_decode_paged), minus the model around
+    it."""
+    B, n_kv, group, Dh = q.shape
+    bs = k_pool.shape[1]
+    nb = tables.shape[1]
+    kc = k_pool[tables].reshape(B, nb * bs, n_kv, Dh)
+    vc = v_pool[tables].reshape(B, nb * bs, n_kv, Dh)
+    s = jnp.einsum("bkgd,bskd->bkgs", q, kc).astype(jnp.float32) * scale
+    idx = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, nb * bs), 3)
+    pos = lengths[:, None, None, None]
+    s = jnp.where(idx <= pos, s, NEG_INF)
+    if window is not None:
+        s = jnp.where(idx > pos - window, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bkgs,bskd->bkgd", p, vc)

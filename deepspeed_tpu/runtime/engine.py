@@ -1081,15 +1081,22 @@ class DeepSpeedEngine:
 
     def _flush_monitor_buffer(self):
         buffered, self._monitor_buffer = self._monitor_buffer, []
+        if not buffered:
+            return
+        # ONE device_get for the whole buffer: three float() per buffered
+        # step would issue 3*len(buffered) blocking transfers (each a full
+        # RPC roundtrip on a remote-dispatch runtime); fetching the pytree
+        # at once pays a single sync for the flush
+        scalars = jax.device_get([(loss, lr, scale)
+                                  for _, loss, lr, scale in buffered])
         events = []
-        for samples, loss, lr, scale in buffered:
+        for (samples, *_), (loss, lr, scale) in zip(buffered, scalars):
             events.extend([
                 ("Train/Samples/train_loss", float(loss), samples),
                 ("Train/Samples/lr", float(lr), samples),
                 ("Train/Samples/loss_scale", float(scale), samples),
             ])
-        if events:
-            self.monitor.write_scalars(events)
+        self.monitor.write_scalars(events)
 
     def set_flops_per_batch(self, flops: float) -> None:
         """Analytic per-batch flops override for the profiler. XLA's

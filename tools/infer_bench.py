@@ -182,7 +182,8 @@ SPEC_CONFIGS = [
 def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
                   prompt_lens=(8, 48), new_tokens=24, num_slots=4,
                   block_size=16, num_blocks=None, prefill_chunk=32,
-                  int8=False, int8_fused=False, seed=0):
+                  int8=False, int8_fused=False, seed=0, decode_impl=None,
+                  emit=True):
     """Continuous-batching serving row: synthetic Poisson arrivals driven
     through ServingEngine.step, wall-clock tokens/s, per-token (TPOT)
     latency percentiles from the scheduler's token timestamps, decode-
@@ -191,6 +192,13 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
     Arrivals are in SCHEDULER-STEP units (deterministic under ``seed``):
     request i is submitted before the first step >= its exponential-gap
     cumsum. ``preset=None`` runs a CPU-smoke-sized model.
+
+    ``decode_impl`` pins the paged attention path ("gather" | "pallas",
+    None = platform default); every row reports which one actually ran
+    plus the analytic cache HBM traffic per decoded token for that path
+    (the gather path moves the whole virtual cache 3x; pallas reads only
+    occupied blocks, once). Returns the row dict so the impl-comparison
+    row can reuse it (``emit=False`` suppresses the JSON line).
     """
     from deepspeed_tpu.models import gpt
     import deepspeed_tpu
@@ -216,7 +224,8 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
         model=(cfg, gpt.init_params(jax.random.PRNGKey(0), cfg)),
         dtype=jnp.int8 if int8 else act_dtype)
     srv = ServingEngine(eng, num_slots=num_slots, block_size=block_size,
-                        num_blocks=num_blocks, prefill_chunk=prefill_chunk)
+                        num_blocks=num_blocks, prefill_chunk=prefill_chunk,
+                        decode_impl=decode_impl)
 
     rng = np.random.default_rng(seed)
     arrive = np.floor(np.cumsum(
@@ -229,7 +238,8 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
 
     # warmup: compile both slot programs before the timed drive
     w = ServingEngine(eng, num_slots=num_slots, block_size=block_size,
-                      num_blocks=num_blocks, prefill_chunk=prefill_chunk)
+                      num_blocks=num_blocks, prefill_chunk=prefill_chunk,
+                      decode_impl=decode_impl)
     w.run([ServeRequest(rid="w", prompt=reqs[0].prompt.copy(),
                         max_new_tokens=2)])
 
@@ -251,10 +261,14 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
     st = srv.stats
     cache = srv.cache
     blk_bytes = gpt.kv_bytes_per_token(cfg, cache.dtype) * block_size
-    print(json.dumps({
+    from deepspeed_tpu.ops.attention.paged import paged_hbm_bytes_per_token
+    mean_len = float(np.mean([len(r.prompt) + len(r.out) / 2
+                              for r in srv.finished])) if srv.finished else 0
+    row = {
         "config": name, "preset": preset or "cpu-smoke",
         "num_requests": num_requests, "new_tokens": new_tokens,
         "num_slots": num_slots, "block_size": block_size,
+        "decode_impl": srv.decode_impl,
         "tokens_per_s": round(gen_tokens / wall_s, 1),
         "tpot_ms_p50": round(float(np.percentile(tpot_ms, 50)), 3),
         "tpot_ms_p99": round(float(np.percentile(tpot_ms, 99)), 3),
@@ -266,7 +280,37 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
         "evictions": st["evictions"],
         "peak_kv_bytes_paged": int(cache.peak_used_blocks * blk_bytes),
         "static_kv_bytes": int(cache.static_equivalent_bytes(num_slots)),
+        "kv_hbm_bytes_per_token": paged_hbm_bytes_per_token(
+            cfg, num_slots, mean_len, cache.tokens_per_slot,
+            dtype=cache.dtype, impl=srv.decode_impl),
         "completed": st["completed"],
+    }
+    if emit:
+        print(json.dumps(row), flush=True)
+    return row
+
+
+def bench_serving_impl_compare(name, **kw):
+    """Same serving drive under both paged-decode attention paths:
+    gather (dense virtual-cache copy per token) vs pallas (flash-decode
+    through the block table). Greedy streams must be identical; the row
+    is the decode-latency and cache-traffic delta the kernel buys."""
+    g = bench_serving(f"{name}[gather]", decode_impl="gather", **kw)
+    p = bench_serving(f"{name}[pallas]", decode_impl="pallas", **kw)
+    print(json.dumps({
+        "config": name, "preset": g["preset"],
+        "decode_impl": "gather-vs-pallas",
+        "tpot_ms_p50_gather": g["tpot_ms_p50"],
+        "tpot_ms_p50_pallas": p["tpot_ms_p50"],
+        "tpot_speedup": round(g["tpot_ms_p50"]
+                              / max(p["tpot_ms_p50"], 1e-9), 2),
+        "tokens_per_s_gather": g["tokens_per_s"],
+        "tokens_per_s_pallas": p["tokens_per_s"],
+        "kv_hbm_bytes_per_token_gather": g["kv_hbm_bytes_per_token"],
+        "kv_hbm_bytes_per_token_pallas": p["kv_hbm_bytes_per_token"],
+        "hbm_traffic_ratio": round(
+            g["kv_hbm_bytes_per_token"]
+            / max(p["kv_hbm_bytes_per_token"], 1), 1),
     }), flush=True)
 
 
@@ -287,6 +331,22 @@ SERVE_CONFIGS = [
         preset="gpt2-medium", num_requests=32, mean_gap_steps=1.5,
         prompt_lens=(64, 384), new_tokens=64, num_slots=8,
         block_size=16, prefill_chunk=128, int8=True, int8_fused=True)),
+]
+
+# gather-vs-pallas comparison drives (one config, both impls): the
+# on-chip row is the kernel's headline; the smoke row runs the pallas
+# kernel in INTERPRET mode on CPU, so its wall-clock is meaningless but
+# the identical-stream and traffic-accounting columns still verify
+SERVE_COMPARE_CONFIGS = [
+    ("serve-impl-smoke", dict(num_requests=6, mean_gap_steps=2.0,
+                              prompt_lens=(8, 24), new_tokens=8,
+                              num_slots=2, block_size=8,
+                              prefill_chunk=16)),
+    ("serve-impl-gpt2-medium", dict(preset="gpt2-medium", num_requests=32,
+                                    mean_gap_steps=1.5,
+                                    prompt_lens=(64, 384), new_tokens=64,
+                                    num_slots=8, block_size=16,
+                                    prefill_chunk=128)),
 ]
 
 
@@ -313,6 +373,15 @@ def main():
     for name, kw in SERVE_CONFIGS:
         try:
             bench_serving(name, **kw)
+        except MemoryGuardError as e:
+            print(json.dumps({"config": name, "skipped": "memory guard",
+                              "why": str(e)[:300]}), flush=True)
+        except Exception as e:
+            print(json.dumps({"config": name, "error": repr(e)[:200]}),
+                  flush=True)
+    for name, kw in SERVE_COMPARE_CONFIGS:
+        try:
+            bench_serving_impl_compare(name, **kw)
         except MemoryGuardError as e:
             print(json.dumps({"config": name, "skipped": "memory guard",
                               "why": str(e)[:300]}), flush=True)
