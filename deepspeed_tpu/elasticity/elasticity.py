@@ -184,9 +184,9 @@ def elasticity_enabled(ds_config: Dict) -> bool:
 def ensure_immutable_elastic_config(runtime_elastic_config_dict: Dict) -> None:
     """Cross-check the scheduler's view (env) against the runtime config
     (ref: elasticity.py:192)."""
-    if DEEPSPEED_ELASTICITY_CONFIG in os.environ:
+    if DEEPSPEED_ELASTICITY_CONFIG in os.environ:  # dslint: disable=DS005 — the scheduler hands its view over via env by contract
         scheduler = ElasticityConfig(
-            json.loads(os.environ[DEEPSPEED_ELASTICITY_CONFIG]))
+            json.loads(os.environ[DEEPSPEED_ELASTICITY_CONFIG]))  # dslint: disable=DS005
         runtime = ElasticityConfig(runtime_elastic_config_dict)
         for field in ("max_acceptable_batch_size", "micro_batches", "version"):
             if getattr(runtime, field) != getattr(scheduler, field):

@@ -55,7 +55,7 @@ def _feature_rows():
     multi = False
     try:
         multi = jax.process_count() > 1
-    except Exception:
+    except Exception:  # dslint: disable=DS006 — best-effort report probe
         pass
     rows.append(("multi-host runtime", multi,
                  f"{jax.process_count() if multi else 1} process(es)"))
@@ -81,7 +81,7 @@ def main():
         stats = jax.local_devices()[0].memory_stats()
         if stats and "bytes_limit" in stats:
             lines.append(f"HBM per device: {stats['bytes_limit'] / 1e9:.1f} GB")
-    except Exception:
+    except Exception:  # dslint: disable=DS006 — best-effort report probe
         pass
     cache = os.environ.get("JAX_COMPILATION_CACHE_DIR")
     if cache:

@@ -692,8 +692,12 @@ class InferenceEngine:
 
         t0 = time.perf_counter()
         token = pick(logits, rng)
+        dev_out = []
         for i in range(max_new_tokens):
-            out.append(np.asarray(token)[:, None])
+            # keep the token on device: a per-step np.asarray would block
+            # the dispatch queue once per token (dslint DS001); the loop
+            # only enqueues work and ONE batched pull lands every token
+            dev_out.append(token)
             if i == max_new_tokens - 1:
                 break
             rng, r = jax.random.split(rng)
@@ -702,6 +706,7 @@ class InferenceEngine:
                 jnp.asarray(S + i, jnp.int32),
                 None if row_len is None else row_len + i)
             token = pick(logits, r)
+        out.extend(t[:, None] for t in jax.device_get(dev_out))
         self.latency_ms["decode_per_token"] = \
             (time.perf_counter() - t0) * 1e3 / max(1, max_new_tokens - 1)
         return np.concatenate(out, axis=1)

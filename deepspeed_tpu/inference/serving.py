@@ -100,6 +100,17 @@ class ServingEngine:
             engine.cfg, num_slots=num_slots, block_size=block_size,
             num_blocks=num_blocks, hbm_budget_bytes=hbm_budget_bytes,
             dtype=engine.dtype, max_seq_len=engine.max_seq_len)
+        mesh = getattr(engine, "mesh", None)
+        if mesh is not None:
+            # place the fresh pools exactly where the jitted programs
+            # will put them (replicated over the engine mesh): a first
+            # prefill call with differently-placed pools keys a second,
+            # single-use executable — one whole wasted XLA compile at
+            # cold start (caught by test_serving_compile_count_contract)
+            from jax.sharding import NamedSharding, PartitionSpec
+            pool_sh = NamedSharding(mesh, PartitionSpec())
+            self.cache.k = jax.device_put(self.cache.k, pool_sh)
+            self.cache.v = jax.device_put(self.cache.v, pool_sh)
         self.num_slots = num_slots
         self.prefill_chunk = int(prefill_chunk)
         self.temperature = temperature

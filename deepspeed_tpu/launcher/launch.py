@@ -94,7 +94,7 @@ def main(args=None):
             logger.info(f"killing subprocess {p.pid}")
             try:
                 p.kill()
-            except Exception:
+            except OSError:
                 pass
         sys.exit(1)
 

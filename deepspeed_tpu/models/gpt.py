@@ -303,7 +303,7 @@ def _int8_fused_enabled() -> bool:
     import os
 
     from deepspeed_tpu.utils import on_tpu
-    return os.environ.get("DS_INT8_FUSED") == "1" and on_tpu()
+    return os.environ.get("DS_INT8_FUSED") == "1" and on_tpu()  # dslint: disable=DS005 — experimental kernel gate, deliberately env-only
 
 
 def _dense(h, p):

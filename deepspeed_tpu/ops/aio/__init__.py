@@ -49,7 +49,7 @@ class AlignedBuffer:
     def __del__(self):  # pragma: no cover - gc timing dependent
         try:
             self.free()
-        except Exception:
+        except Exception:  # dslint: disable=DS006 — __del__ must never raise during teardown
             pass
 
 
@@ -119,5 +119,5 @@ class AsyncIOHandle:
     def __del__(self):  # pragma: no cover
         try:
             self.close()
-        except Exception:
+        except Exception:  # dslint: disable=DS006 — __del__ must never raise during teardown
             pass

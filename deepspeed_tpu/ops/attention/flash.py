@@ -69,7 +69,7 @@ def resolve_window_impl(window, window_impl=None):
     PARITY.md quarantine advice works uniformly."""
     if window is None or isinstance(window, tuple):
         return window
-    impl = window_impl or os.environ.get("DS_FLASH_WINDOW_IMPL", "banded")
+    impl = window_impl or os.environ.get("DS_FLASH_WINDOW_IMPL", "banded")  # dslint: disable=DS005 — documented debug override (PARITY.md quarantine switch)
     if impl not in ("banded", "masked"):
         # ValueError, not assert: this validates user input (env var /
         # config) and must survive python -O

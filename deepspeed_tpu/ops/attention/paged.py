@@ -69,7 +69,7 @@ def resolve_decode_impl(impl: Optional[str] = None) -> str:
     portable fallback). Shared by InferenceEngine and ServingEngine so
     env overrides work uniformly."""
     if impl is None:
-        impl = os.environ.get("DS_PAGED_DECODE_IMPL") or None
+        impl = os.environ.get("DS_PAGED_DECODE_IMPL") or None  # dslint: disable=DS005 — documented impl override shared by both engines
     if impl is None:
         from deepspeed_tpu.utils import on_tpu
         impl = "pallas" if on_tpu() else "gather"

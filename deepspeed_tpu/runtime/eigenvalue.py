@@ -154,7 +154,12 @@ class Eigenvalue:
                 hv = self._extract_block(hvp(params, tangent), i)
                 hv = [jnp.nan_to_num(h.astype(jnp.float32),
                                      posinf=0.0, neginf=0.0) for h in hv]
-                ev_cur = float(self._inner(hv, v))
+                # intentional per-iteration host sync: the Rayleigh
+                # quotient IS the while-loop's convergence predicate, so
+                # the value must land on host before the next iteration
+                # can be scheduled (audited for dslint DS001 — power
+                # iteration is data-dependent, no batched pull possible)
+                ev_cur = float(self._inner(hv, v))  # dslint: disable=DS001
                 v = self._normalize(hv)
                 v = [x / scale for x in v]
                 it += 1

@@ -1325,12 +1325,19 @@ class DeepSpeedEngine:
     def consolidated_16bit_state_dict(self):
         """Gather full (unsharded) compute-dtype params on host
         (ref: engine.py:3060 _zero3_consolidated_16bit_state_dict)."""
-        full = jax.device_get(
-            jax.jit(lambda p: _cast_tree(p, self.compute_dtype),
-                    out_shardings=jax.tree_util.tree_map(
-                        lambda _: NamedSharding(self.mesh, P()),
-                        self.state.params))(self.state.params))
-        return full
+        # the gather-and-cast program is cached on the engine: a fresh
+        # jit(lambda) per call would recompile every checkpoint save
+        # (dslint DS002)
+        fn = getattr(self, "_consolidate_16bit_fn", None)
+        if fn is None:
+            def _gather_cast(p):
+                return _cast_tree(p, self.compute_dtype)
+            fn = jax.jit(_gather_cast,
+                         out_shardings=jax.tree_util.tree_map(
+                             lambda _: NamedSharding(self.mesh, P()),
+                             self.state.params))
+            self._consolidate_16bit_fn = fn
+        return jax.device_get(fn(self.state.params))
 
     def module_state_dict(self):
         """The param pytree (the reference's module.state_dict analog,

@@ -64,7 +64,7 @@ def see_memory_usage(message: str, force: bool = False):
                 used = stats.get("bytes_in_use", 0) / 2**30
                 limit = stats.get("bytes_limit", 0) / 2**30
                 parts.append(f"{d}: {used:.2f}/{limit:.2f} GB")
-    except Exception:
+    except Exception:  # dslint: disable=DS006 — debug-string probe; backends without memory_stats just omit it
         pass
     if PSUTIL:
         vm = psutil.virtual_memory()

@@ -321,7 +321,7 @@ class InfinityParamEngine:
         for l in leaves:
             try:
                 l.copy_to_host_async()
-            except Exception:
+            except Exception:   # dslint: disable=DS006 — best-effort async hint; the sync pull in _pull is the correctness path
                 pass
 
         def _pull():
@@ -369,11 +369,15 @@ class InfinityParamEngine:
         acts.clear()
 
         dother_e = self._j_embed_grad(self.other_dev, batch, dx)
-        # fold head-side + embed-side other-grads on host
-        oleaves = [np.asarray(a, np.float32).ravel() +
-                   np.asarray(b, np.float32).ravel()
-                   for a, b in zip(jax.tree_util.tree_leaves(dother),
-                                   jax.tree_util.tree_leaves(dother_e))]
+        # fold head-side + embed-side other-grads on host: both trees come
+        # down in ONE batched transfer each (a per-leaf np.asarray loop
+        # would block the dispatch queue once per leaf — the
+        # _flush_monitor_buffer bug class, dslint DS001)
+        head_np = jax.device_get(jax.tree_util.tree_leaves(dother))
+        embed_np = jax.device_get(jax.tree_util.tree_leaves(dother_e))
+        oleaves = [a.astype(np.float32).ravel() +
+                   b.astype(np.float32).ravel()
+                   for a, b in zip(head_np, embed_np)]
         if self.other_grad_acc is None:
             self.other_grad_acc = oleaves
         else:
@@ -392,16 +396,21 @@ class InfinityParamEngine:
         # the same host pass that squares for the global norm
         inv = (1.0 / self.gas) / self.cur_scale
 
-        sq = 0.0
+        # squared-norm terms accumulate as 0-d arrays; ONE float() after
+        # the loop converts the lot (a per-leaf float() in the loop is the
+        # dslint DS001 pattern — harmless on these host arrays, poison if
+        # a leaf ever becomes device-resident)
+        sq_terms = []
         for gi in range(self.n_groups):
             for g in self.grad_acc[gi]:
                 if inv != 1.0:
                     g *= inv
-                sq += float(g @ g)
+                sq_terms.append(g @ g)
         for g in self.other_grad_acc:
             if inv != 1.0:
                 g *= inv
-            sq += float(g @ g)
+            sq_terms.append(g @ g)
+        sq = float(np.sum(sq_terms))
         gnorm = math.sqrt(sq) if sq >= 0.0 else float("nan")
         if not math.isfinite(gnorm):
             # overflow: drop the step and back the scale off — the
@@ -514,7 +523,9 @@ class InfinityParamEngine:
             for s in range(gas):
                 mb = jax.tree_util.tree_map(lambda a: a[s], micro)
                 losses.append(self._micro_step(mb))
-            loss = float(np.mean([float(l) for l in losses]))
+            # one batched pull for every micro-step's loss (per-loss
+            # float() would round-trip the host once per micro-step)
+            loss = float(np.mean(jax.device_get(losses)))
         else:
             loss = float(self._micro_step(batch))
         gnorm, lr, overflow = self._apply_update()

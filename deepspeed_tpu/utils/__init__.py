@@ -8,7 +8,7 @@ def honor_platform_request() -> None:
     platform pinned; setting the env var afterwards is silently ignored
     and a dead accelerator tunnel can then hang ``jax.devices()`` forever.
     Call this before first device use (bench.py and the examples do)."""
-    want = os.environ.get("JAX_PLATFORMS", "")
+    want = os.environ.get("JAX_PLATFORMS", "")  # dslint: disable=DS005 — mirrors jax's own env contract
     if want:
         import jax
         jax.config.update("jax_platforms", want)
@@ -26,7 +26,7 @@ def on_tpu() -> bool:
     import os
     import jax
     plats = (getattr(jax.config, "jax_platforms", None)
-             or os.environ.get("JAX_PLATFORMS", ""))
+             or os.environ.get("JAX_PLATFORMS", ""))  # dslint: disable=DS005 — mirrors jax's own env contract
     if plats and plats.split(",")[0].strip() == "cpu":
         return False
     try:

@@ -7,6 +7,9 @@ the JAX runtime across hosts (GCE metadata auto-discovery on Cloud TPU, or
 env/args for manual setups), after which `jax.devices()` spans the pod and
 ONE global mesh replaces all process groups.
 """
+# dslint: disable-file=DS005 — process bootstrap IS the env layer here:
+# rendezvous variables (MPI vars, MASTER_ADDR, DSTPU_*) are set by the
+# launcher/scheduler and are this module's input contract, not config.
 
 import os
 from typing import Optional

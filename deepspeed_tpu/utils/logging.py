@@ -41,7 +41,7 @@ class LoggerFactory:
 
 
 logger = LoggerFactory.create_logger(
-    level=log_levels.get(os.environ.get("DSTPU_LOG_LEVEL", "info"), logging.INFO))
+    level=log_levels.get(os.environ.get("DSTPU_LOG_LEVEL", "info"), logging.INFO))  # dslint: disable=DS005 — log level must exist before config loads
 
 
 def _get_rank() -> int:
@@ -49,7 +49,7 @@ def _get_rank() -> int:
         import jax
         return jax.process_index()
     except Exception:
-        return int(os.environ.get("DSTPU_PROCESS_ID", "0"))
+        return int(os.environ.get("DSTPU_PROCESS_ID", "0"))  # dslint: disable=DS005 — pre-init rank fallback
 
 
 def log_dist(message: str, ranks: Optional[List[int]] = None, level=logging.INFO) -> None:

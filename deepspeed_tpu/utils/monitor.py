@@ -26,7 +26,7 @@ def _try_tensorboard_writer(log_dir: str):
     try:
         from torch.utils.tensorboard import SummaryWriter
         return SummaryWriter(log_dir=log_dir)
-    except Exception:
+    except Exception:  # dslint: disable=DS006 — optional tensorboard backend probe
         pass
     try:
         from tensorboardX import SummaryWriter

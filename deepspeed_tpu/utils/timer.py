@@ -35,7 +35,7 @@ def _device_sync():
         jax.effects_barrier()
         # touch a trivial computation to flush the async dispatch queue
         jax.device_put(0.0).block_until_ready()
-    except Exception:
+    except Exception:  # dslint: disable=DS006 — best-effort queue flush; timers must not crash training
         pass
 
 

@@ -1,0 +1,418 @@
+"""dslint + compile-guard tests (tentpole: tools/dslint +
+deepspeed_tpu/utils/compile_guard.py).
+
+Three layers:
+  1. per-rule fixtures — for every rule DS001–DS008 one true-positive
+     snippet that MUST flag and one clean snippet that MUST NOT (the
+     clean twin pins the rule's precision, not just its recall);
+  2. machinery — inline suppressions, file-level waivers, the baseline
+     multiset roundtrip, CLI exit codes;
+  3. self-scan — the repo's own tree must lint clean (zero
+     non-baselined findings), which is the acceptance bar that keeps
+     the rules honest against real code;
+plus unit tests for CompileWatch, the runtime half of the contract.
+"""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from tools.dslint import (analyze_paths, analyze_source, apply_baseline,
+                          default_rules, load_baseline, rule_catalog,
+                          write_baseline)
+from tools.dslint.core import REPO_ROOT
+
+
+def rules_of(src, path="deepspeed_tpu/runtime/sample.py"):
+    """Rule ids found in ``src`` linted as if it lived at ``path``
+    (the default path is OUTSIDE the DS005-sanctioned env layer)."""
+    return sorted({f.rule for f in analyze_source(src, path=path)})
+
+
+# ---------------------------------------------------------------------------
+# per-rule fixtures: one true positive + one clean twin each
+# ---------------------------------------------------------------------------
+
+def test_ds001_blocking_sync_in_hot_loop():
+    bad = (
+        "import jax\n"
+        "def train_step(batch):\n"
+        "    total = 0.0\n"
+        "    for x in batch:\n"
+        "        total += float(compute(x))\n"
+        "    return total\n")
+    assert "DS001" in rules_of(bad)
+    # the fix the rule asks for: accumulate on device, one batched pull
+    good = (
+        "import jax\n"
+        "def train_step(batch):\n"
+        "    vals = [compute(x) for x in batch]\n"
+        "    return sum(jax.device_get(vals))\n")
+    assert "DS001" not in rules_of(good)
+
+
+def test_ds001_only_fires_in_hot_functions():
+    # same sync pattern, but not a step/decode/generate-family function
+    src = (
+        "def summarize(batch):\n"
+        "    total = 0.0\n"
+        "    for x in batch:\n"
+        "        total += float(compute(x))\n"
+        "    return total\n")
+    assert "DS001" not in rules_of(src)
+
+
+def test_ds001_comprehension_iterable_is_once_not_per_iteration():
+    # jax.device_get as a comprehension's ITERABLE runs once — it is the
+    # recommended batched pull, not a per-iteration sync (the shape of
+    # inference.engine.generate's fixed `out.extend(... device_get ...)`)
+    src = (
+        "import jax\n"
+        "def decode_step(dev_out):\n"
+        "    out = []\n"
+        "    out.extend(t * 2 for t in jax.device_get(dev_out))\n"
+        "    return out\n")
+    assert "DS001" not in rules_of(src)
+    # ...but a sync in the comprehension's ELEMENT is per-iteration work
+    elem = (
+        "import jax\n"
+        "def decode_step(vals):\n"
+        "    return [float(v) for v in vals]\n")
+    assert "DS001" in rules_of(elem)
+
+
+def test_ds002_jit_lambda_and_jit_in_loop():
+    bad = (
+        "import jax\n"
+        "def bench(xs):\n"
+        "    for x in xs:\n"
+        "        f = jax.jit(lambda a: a * 2)\n"
+        "        f(x)\n")
+    found = [f for f in analyze_source(bad, path="m.py") if f.rule == "DS002"]
+    msgs = " ".join(f.message for f in found)
+    assert "inside a loop" in msgs and "lambda" in msgs
+    good = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(a):\n"
+        "    return a * 2\n"
+        "def bench(xs):\n"
+        "    for x in xs:\n"
+        "        f(x)\n")
+    assert "DS002" not in rules_of(good)
+
+
+def test_ds002_nested_jitted_def_vs_cached():
+    bad = (
+        "import jax\n"
+        "def call(self, p):\n"
+        "    @jax.jit\n"
+        "    def inner(q):\n"
+        "        return q + 1\n"
+        "    return inner(p)\n")
+    assert "DS002" in rules_of(bad)
+    # cached on self: the jitted def survives the call — no per-call key
+    good = (
+        "import jax\n"
+        "def call(self, p):\n"
+        "    @jax.jit\n"
+        "    def inner(q):\n"
+        "        return q + 1\n"
+        "    self._fn = inner\n"
+        "    return self._fn(p)\n")
+    assert "DS002" not in rules_of(good)
+
+
+def test_ds002_unhashable_static_default():
+    bad = (
+        "import jax\n"
+        "@jax.jit(static_argnums=(1,))\n"
+        "def f(x, opts=[]):\n"
+        "    return x\n")
+    assert "DS002" in rules_of(bad)
+    good = (
+        "import jax\n"
+        "@jax.jit(static_argnums=(1,))\n"
+        "def f(x, opts=()):\n"
+        "    return x\n")
+    assert "DS002" not in rules_of(good)
+
+
+def test_ds003_read_after_donation():
+    bad = (
+        "import jax\n"
+        "f = jax.jit(g, donate_argnums=(0,))\n"
+        "def run(x):\n"
+        "    y = f(x)\n"
+        "    return x + y\n")
+    assert "DS003" in rules_of(bad)
+    # rebinding through the consuming call is the sanctioned pattern
+    good = (
+        "import jax\n"
+        "f = jax.jit(g, donate_argnums=(0,))\n"
+        "def run(x):\n"
+        "    x = f(x)\n"
+        "    return x\n")
+    assert "DS003" not in rules_of(good)
+
+
+def test_ds004_traced_python_branch():
+    bad = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    if x > 0:\n"
+        "        return x\n"
+        "    return -x\n")
+    assert "DS004" in rules_of(bad)
+    # static args, structure tests, and shape reads stay legal
+    good = (
+        "import jax\n"
+        "@jax.jit(static_argnums=(1,))\n"
+        "def step(x, mode):\n"
+        "    if mode == 'fast':\n"
+        "        return x\n"
+        "    if x is None:\n"
+        "        return x\n"
+        "    if 'mlm' not in x:\n"
+        "        return x\n"
+        "    if x['a'].shape[0] > 1:\n"
+        "        return x\n"
+        "    return -x['a']\n")
+    assert "DS004" not in rules_of(good)
+
+
+def test_ds004_sees_through_jit_of_bound_method():
+    # self._decode = jax.jit(self._decode_fn, static_argnums=(7,)):
+    # call-site positions skip `self`, so arg 7 is the METHOD's 8th
+    # non-self parameter
+    bad = (
+        "import jax\n"
+        "class E:\n"
+        "    def __init__(self):\n"
+        "        self._d = jax.jit(self._d_fn)\n"
+        "    def _d_fn(self, x):\n"
+        "        if x > 0:\n"
+        "            return x\n"
+        "        return -x\n")
+    assert "DS004" in rules_of(bad)
+    good = (
+        "import jax\n"
+        "class E:\n"
+        "    def __init__(self):\n"
+        "        self._d = jax.jit(self._d_fn, static_argnums=(0,))\n"
+        "    def _d_fn(self, impl):\n"
+        "        if impl == 'pallas':\n"
+        "            return 1\n"
+        "        return 0\n")
+    assert "DS004" not in rules_of(good)
+
+
+def test_ds005_env_read_placement():
+    src = (
+        "import os\n"
+        "def pick():\n"
+        "    return os.environ.get('DS_THING', '0')\n")
+    assert "DS005" in rules_of(src, path="deepspeed_tpu/runtime/zero.py")
+    # identical code in the sanctioned config layer is clean
+    assert "DS005" not in rules_of(src, path="deepspeed_tpu/runtime/config.py")
+    # module-scope reads are flagged EVERYWHERE, even in config
+    frozen = "import os\nLEVEL = os.environ.get('DS_LOG', 'info')\n"
+    assert "DS005" in rules_of(frozen, path="deepspeed_tpu/runtime/config.py")
+
+
+def test_ds006_overbroad_except():
+    assert "DS006" in rules_of("try:\n    f()\nexcept Exception:\n    pass\n")
+    assert "DS006" in rules_of("try:\n    f()\nexcept:\n    pass\n")
+    # narrowed type, or a broad catch that at least logs, are clean
+    assert "DS006" not in rules_of(
+        "try:\n    f()\nexcept OSError:\n    pass\n")
+    assert "DS006" not in rules_of(
+        "try:\n    f()\nexcept Exception:\n    log('boom')\n")
+
+
+def test_ds007_mutable_default():
+    findings = analyze_source("def f(x, acc=[], *, m={}):\n    return acc\n",
+                              path="m.py")
+    assert sum(f.rule == "DS007" for f in findings) == 2
+    assert "DS007" not in rules_of("def f(x, acc=None):\n    return acc\n")
+    # DS007 is the designated autofixable rule
+    cat = {r["id"]: r for r in rule_catalog()}
+    assert cat["DS007"]["autofixable"] is True
+
+
+def test_ds008_import_scope_device_work():
+    bad = "import jax.numpy as jnp\nZ = jnp.zeros((4,))\n"
+    assert "DS008" in rules_of(bad)
+    # default-arg expressions evaluate when the top-level def executes
+    bad_default = ("import jax.numpy as jnp\n"
+                   "def f(x=jnp.zeros(3)):\n    return x\n")
+    assert "DS008" in rules_of(bad_default)
+    good = ("import jax.numpy as jnp\n"
+            "def f():\n    return jnp.zeros((4,))\n")
+    assert "DS008" not in rules_of(good)
+
+
+def test_ds000_syntax_error_is_a_finding_not_a_crash():
+    findings = analyze_source("def f(:\n", path="m.py")
+    assert [f.rule for f in findings] == ["DS000"]
+
+
+# ---------------------------------------------------------------------------
+# suppression machinery
+# ---------------------------------------------------------------------------
+
+BAD_LOOP = ("def train_step(batch):\n"
+            "    t = 0.0\n"
+            "    for x in batch:\n"
+            "        t += float(compute(x)){trailer}\n"
+            "    return t\n")
+
+
+def test_inline_suppression_trailing_comment():
+    assert "DS001" in rules_of(BAD_LOOP.format(trailer=""))
+    src = BAD_LOOP.format(
+        trailer="  # dslint: disable=DS001 — convergence predicate")
+    assert "DS001" not in rules_of(src)
+
+
+def test_inline_suppression_comment_line_above():
+    src = ("def train_step(batch):\n"
+           "    t = 0.0\n"
+           "    for x in batch:\n"
+           "        # dslint: disable=DS001\n"
+           "        t += float(compute(x))\n"
+           "    return t\n")
+    assert "DS001" not in rules_of(src)
+
+
+def test_inline_suppression_is_rule_specific():
+    # suppressing a DIFFERENT rule must not hide the finding
+    src = BAD_LOOP.format(trailer="  # dslint: disable=DS006")
+    assert "DS001" in rules_of(src)
+
+
+def test_file_level_suppression():
+    src = ("# dslint: disable-file=DS005\n"
+           "import os\n"
+           "def pick():\n"
+           "    return os.environ.get('DS_THING')\n")
+    assert "DS005" not in rules_of(src, path="deepspeed_tpu/runtime/zero.py")
+
+
+# ---------------------------------------------------------------------------
+# baseline roundtrip + CLI
+# ---------------------------------------------------------------------------
+
+def test_baseline_roundtrip(tmp_path):
+    findings = analyze_source(BAD_LOOP.format(trailer=""), path="m.py")
+    assert findings
+    bl_path = tmp_path / "baseline.json"
+    write_baseline(findings, bl_path)
+    new, old = apply_baseline(
+        analyze_source(BAD_LOOP.format(trailer=""), path="m.py"),
+        load_baseline(bl_path))
+    assert new == [] and len(old) == len(findings)
+    assert all(f.baselined for f in old)
+    # the baseline is a MULTISET: a second identical finding is new debt
+    doubled = findings + findings
+    new2, _ = apply_baseline(doubled, load_baseline(bl_path))
+    assert len(new2) == len(findings)
+
+
+def test_cli_exit_codes_and_json(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(BAD_LOOP.format(trailer=""))
+    empty_bl = tmp_path / "bl.json"
+    empty_bl.write_text('{"version": 1, "entries": []}')
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.dslint", str(bad), "--format", "json",
+         "--baseline", str(empty_bl)],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert r.returncode == 1
+    payload = json.loads(r.stdout)
+    assert payload["counts"]["new"] >= 1
+    assert payload["findings"][0]["rule"] == "DS001"
+    clean = tmp_path / "clean.py"
+    clean.write_text("def f():\n    return 1\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.dslint", str(clean),
+         "--baseline", str(empty_bl)],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert r.returncode == 0
+
+
+# ---------------------------------------------------------------------------
+# self-scan: the tree this repo ships must lint clean
+# ---------------------------------------------------------------------------
+
+def test_self_scan_zero_new_findings():
+    findings = analyze_paths([str(REPO_ROOT / "deepspeed_tpu"),
+                              str(REPO_ROOT / "tools")])
+    new, _ = apply_baseline(findings, load_baseline())
+    assert new == [], "non-baselined dslint findings:\n" + "\n".join(
+        f.format() for f in new)
+
+
+def test_every_rule_has_id_and_rationale():
+    cat = rule_catalog()
+    ids = [r["id"] for r in cat]
+    assert ids == sorted(ids) and len(set(ids)) == len(ids)
+    assert {"DS001", "DS002", "DS003", "DS004",
+            "DS005", "DS006", "DS007", "DS008"} <= set(ids)
+    assert all(r["rationale"] for r in cat)
+    assert len(default_rules()) == len(cat)
+
+
+# ---------------------------------------------------------------------------
+# CompileWatch: the runtime half of the compile contract
+# ---------------------------------------------------------------------------
+
+def test_compile_watch_warm_path_counts_zero(devices):
+    import jax.numpy as jnp
+    import jax
+    from deepspeed_tpu.utils.compile_guard import CompileWatch
+    f = jax.jit(lambda x: x * 2)  # dslint: disable=DS002 — fixture jit
+    f(jnp.ones((4,)))
+    with CompileWatch(max_compiles=0, label="warm") as w:
+        for _ in range(4):
+            f(jnp.ones((4,)))
+    assert w.compiles == 0
+
+
+def test_compile_watch_detects_recompile(devices):
+    import jax.numpy as jnp
+    import jax
+    from deepspeed_tpu.utils.compile_guard import CompileWatch, RecompileError
+    f = jax.jit(lambda x: x + 1)  # dslint: disable=DS002 — fixture jit
+    f(jnp.ones((4,)))
+    with pytest.raises(RecompileError, match="cold"):
+        with CompileWatch(max_compiles=0, label="cold"):
+            f(jnp.ones((8,)))  # new shape -> recompile
+
+
+def test_compile_watch_never_masks_body_exception(devices):
+    import jax.numpy as jnp
+    import jax
+    from deepspeed_tpu.utils.compile_guard import CompileWatch
+    f = jax.jit(lambda x: x + 1)  # dslint: disable=DS002 — fixture jit
+    with pytest.raises(ValueError, match="boom"):
+        with CompileWatch(max_compiles=0):
+            f(jnp.ones((16,)))  # WOULD trip the watch...
+            raise ValueError("boom")  # ...but the body's error wins
+
+
+def test_compile_watch_cache_size_fallback(devices, monkeypatch):
+    import jax.numpy as jnp
+    import jax
+    import deepspeed_tpu.utils.compile_guard as cg
+    monkeypatch.setattr(cg, "_monitoring_api", lambda: None)
+    g = jax.jit(lambda x: x - 1)  # dslint: disable=DS002 — fixture jit
+    w = cg.CompileWatch(max_compiles=0)
+    w.wrap(g)
+    with pytest.raises(cg.RecompileError):
+        with w:
+            g(jnp.ones((3,)))
+    assert not w.monitored and w.compiles >= 1

@@ -250,6 +250,52 @@ def test_serving_int8_compose(devices):
         np.testing.assert_array_equal(out[i], ref)
 
 
+def test_serving_compile_count_contract(devices):
+    """The serving perf contract as an executable assert: steady state
+    is exactly TWO compiled programs (_prefill_slot, _decode_slots) and
+    ZERO recompiles across admission, chunked prefill, eviction and
+    requeue.  The warmup run compiles everything once (including the
+    per-slot eager emit slices — both slots see traffic); the second,
+    identical workload must then compile NOTHING."""
+    from deepspeed_tpu.utils.compile_guard import CompileWatch, cache_size
+    cfg, params = tiny()
+    eng = InferenceEngine(config=cfg, params=params, dtype=jnp.float32)
+    p1, p2 = prompts_of((10, 9), seed=9)
+
+    def run_workload():
+        # tight pool + zero watermark: both requests admit, decode
+        # growth exhausts the free list, the youngest evicts + requeues
+        srv = ServingEngine(eng, num_slots=2, block_size=4, num_blocks=7,
+                            prefill_chunk=8)
+        srv.cache.watermark = 0
+        out = srv.run([ServeRequest(rid="a", prompt=p1, max_new_tokens=12),
+                       ServeRequest(rid="b", prompt=p2, max_new_tokens=10)])
+        return srv, out
+
+    srv, warm_out = run_workload()
+    assert srv.stats["evictions"] >= 1     # the workload really preempts
+    # exactly two compiled serving programs after warmup — one prefill
+    # (chunks are padded to prefill_chunk, so ONE shape) and one decode
+    n_prefill = cache_size(eng._prefill_slot)
+    n_decode = cache_size(eng._decode_slots)
+    if n_prefill is not None:
+        assert (n_prefill, n_decode) == (1, 1), (
+            f"serving steady state fragmented: prefill={n_prefill} "
+            f"decode={n_decode} compiled programs (expected 1+1)")
+
+    watch = CompileWatch(max_compiles=0, label="serving steady state")
+    watch.wrap(eng._prefill_slot)
+    watch.wrap(eng._decode_slots)
+    with watch:                            # raises RecompileError on exit
+        srv2, out = run_workload()         # if anything compiled
+    assert srv2.stats["evictions"] >= 1
+    for rid in ("a", "b"):                 # still the right tokens
+        np.testing.assert_array_equal(out[rid], warm_out[rid])
+    if n_prefill is not None:
+        assert cache_size(eng._prefill_slot) == 1
+        assert cache_size(eng._decode_slots) == 1
+
+
 def test_serving_rejects_oversized_request(devices):
     cfg, params = tiny()
     eng = InferenceEngine(config=cfg, params=params, dtype=jnp.float32)

@@ -1,0 +1,15 @@
+"""dslint — JAX/TPU-aware static analysis for this repo.
+
+CLI: ``python -m tools.dslint deepspeed_tpu tools`` (see __main__.py).
+Library surface (used by tests): analyze_source / analyze_paths,
+load_baseline / apply_baseline / write_baseline, default_rules.
+"""
+
+from tools.dslint.core import (Finding, analyze_paths, analyze_source,
+                               apply_baseline, load_baseline,
+                               write_baseline)
+from tools.dslint.rules import default_rules, rule_catalog
+
+__all__ = ["Finding", "analyze_paths", "analyze_source", "apply_baseline",
+           "load_baseline", "write_baseline", "default_rules",
+           "rule_catalog"]

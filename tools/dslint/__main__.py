@@ -1,0 +1,84 @@
+"""``python -m tools.dslint [paths...]`` — run the JAX-aware lint.
+
+Exit code 0 when every finding is fixed, suppressed inline, or in the
+baseline; 1 otherwise. ``--update-baseline`` rewrites the checked-in
+baseline from the current tree (visible debt, non-blocking).
+"""
+
+import argparse
+import sys
+
+from tools.dslint.core import (DEFAULT_BASELINE, analyze_paths,
+                               apply_baseline, findings_to_json,
+                               load_baseline, write_baseline)
+from tools.dslint.rules import default_rules, rule_catalog
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.dslint",
+        description="JAX/TPU-aware static analysis (rules DS001-DS008; "
+                    "see docs/LINT.md)")
+    ap.add_argument("paths", nargs="*", default=["deepspeed_tpu", "tools"],
+                    help="files or directories (default: deepspeed_tpu "
+                         "tools)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", default=str(DEFAULT_BASELINE),
+                    help="baseline file (default: tools/dslint/"
+                         "baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report baselined findings as failures too")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from the current findings "
+                         "and exit 0")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule IDs to run (default: all)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--show-baselined", action="store_true",
+                    help="also print baselined findings in text mode")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in rule_catalog():
+            fix = " [autofixable]" if r["autofixable"] else ""
+            print(f"{r['id']} {r['name']}{fix}\n    {r['rationale']}")
+        return 0
+
+    rules = default_rules()
+    if args.rules:
+        wanted = {r.strip().upper() for r in args.rules.split(",")}
+        rules = [r for r in rules if r.id in wanted]
+        if not rules:
+            print(f"no such rules: {args.rules}", file=sys.stderr)
+            return 2
+
+    paths = args.paths or ["deepspeed_tpu", "tools"]
+    findings = analyze_paths(paths, rules=rules)
+
+    if args.update_baseline:
+        out = write_baseline(findings, args.baseline)
+        print(f"dslint: baseline written to {out} "
+              f"({len(findings)} entries)")
+        return 0
+
+    baseline = load_baseline(args.baseline) if not args.no_baseline else {}
+    new, baselined = apply_baseline(findings, baseline)
+
+    if args.format == "json":
+        print(findings_to_json(new, baselined))
+    else:
+        for f in new:
+            print(f.format())
+            if f.snippet:
+                print(f"    {f.snippet}")
+        if args.show_baselined:
+            for f in baselined:
+                print(f.format())
+        n_files = len({f.path for f in new})
+        print(f"dslint: {len(new)} finding(s) in {n_files} file(s), "
+              f"{len(baselined)} baselined")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,218 @@
+"""dslint core: findings, suppressions, baseline, and the analysis driver.
+
+The analyzer is pure stdlib ``ast`` — no third-party parser, no imports
+of the code under analysis (modules with heavyweight import side effects
+lint exactly like everything else). Rules live in
+:mod:`tools.dslint.rules`; each has an ID (``DS00x``), an ``autofixable``
+flag, and a one-line rationale surfaced by ``--list-rules``.
+
+Suppression syntax (checked per line)::
+
+    x = float(dev_val)        # dslint: disable=DS001 — reason
+    # dslint: disable=DS004   (comment-only line: covers the NEXT line)
+    # dslint: disable-file=DS005 — whole-file waiver (bootstrap layer)
+
+Baseline: a checked-in JSON multiset of ``(path, rule, stripped source
+line)`` triples. Findings that match a baseline entry are reported as
+*baselined* (visible debt) but do not fail the run, so the lint can land
+strict rules without a big-bang cleanup. ``--update-baseline`` rewrites
+the file from the current tree; entries key on line TEXT, not line
+numbers, so unrelated edits don't invalidate them.
+"""
+
+import ast
+import json
+import os
+from collections import Counter
+from dataclasses import asdict, dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+import re
+
+# repo root = parents of tools/dslint/; used to normalize finding paths so
+# baseline entries are stable regardless of the invocation cwd
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+_SUPPRESS_RE = re.compile(r"#\s*dslint:\s*disable=([A-Z0-9_]+(?:\s*,\s*[A-Z0-9_]+)*)")
+_SUPPRESS_FILE_RE = re.compile(
+    r"#\s*dslint:\s*disable-file=([A-Z0-9_]+(?:\s*,\s*[A-Z0-9_]+)*)")
+
+
+@dataclass
+class Finding:
+    """One rule violation at one source location."""
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    snippet: str = ""
+    baselined: bool = False
+
+    def key(self) -> Tuple[str, str, str]:
+        """Baseline identity: line numbers drift, line text mostly doesn't."""
+        return (self.path, self.rule, self.snippet)
+
+    def format(self) -> str:
+        tag = " [baselined]" if self.baselined else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}{tag} {self.message}"
+
+
+def link_parents(tree: ast.AST) -> ast.AST:
+    """Annotate every node with ``_ds_parent`` so rules can walk upward."""
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._ds_parent = node
+    return tree
+
+
+def parse_suppressions(
+        lines: Sequence[str]) -> Tuple[Set[str], Dict[int, Set[str]]]:
+    """Returns (file-wide suppressed rules, line -> suppressed rules).
+
+    A trailing comment covers its own line and the next (multi-line
+    statements report on their first line); a comment-only line covers
+    the next line.
+    """
+    file_rules: Set[str] = set()
+    by_line: Dict[int, Set[str]] = {}
+    for i, ln in enumerate(lines, 1):
+        m = _SUPPRESS_FILE_RE.search(ln)
+        if m:
+            file_rules |= {r.strip() for r in m.group(1).split(",")}
+        m = _SUPPRESS_RE.search(ln)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        covers = (i + 1,) if ln.strip().startswith("#") else (i, i + 1)
+        for j in covers:
+            by_line.setdefault(j, set()).update(rules)
+    return file_rules, by_line
+
+
+def analyze_source(src: str, path: str = "<memory>",
+                   rules: Optional[Sequence] = None) -> List[Finding]:
+    """Run every rule over one source string. Honors inline suppressions;
+    baseline filtering is the caller's job (see :func:`apply_baseline`)."""
+    if rules is None:
+        from tools.dslint.rules import default_rules
+        rules = default_rules()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding("DS000", path, int(e.lineno or 0), int(e.offset or 0),
+                        f"syntax error: {e.msg}")]
+    link_parents(tree)
+    lines = src.splitlines()
+    findings: List[Finding] = []
+    for rule in rules:
+        findings.extend(rule.check(tree, lines, path))
+    for f in findings:
+        if not f.snippet and 0 < f.line <= len(lines):
+            f.snippet = lines[f.line - 1].strip()
+    file_sup, line_sup = parse_suppressions(lines)
+    findings = [f for f in findings
+                if f.rule not in file_sup
+                and f.rule not in line_sup.get(f.line, ())]
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def _norm_path(p: str) -> str:
+    """Repo-root-relative posix path when possible (baseline stability)."""
+    rp = Path(p).resolve()
+    try:
+        return rp.relative_to(REPO_ROOT).as_posix()
+    except ValueError:
+        return Path(p).as_posix()
+
+
+def iter_py_files(paths: Iterable[str]) -> List[Path]:
+    out: List[Path] = []
+    for p in paths:
+        pp = Path(p)
+        if pp.is_dir():
+            out.extend(sorted(f for f in pp.rglob("*.py")
+                              if not any(part.startswith(".")
+                                         or part in ("__pycache__", "build")
+                                         for part in f.parts)))
+        elif pp.suffix == ".py" and pp.exists():
+            out.append(pp)
+    # dedupe, keep order
+    seen: Set[Path] = set()
+    uniq = []
+    for f in out:
+        r = f.resolve()
+        if r not in seen:
+            seen.add(r)
+            uniq.append(f)
+    return uniq
+
+
+def analyze_paths(paths: Iterable[str],
+                  rules: Optional[Sequence] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for f in iter_py_files(paths):
+        try:
+            src = f.read_text(encoding="utf-8")
+        except (OSError, UnicodeDecodeError) as e:
+            findings.append(Finding("DS000", _norm_path(str(f)), 0, 0,
+                                    f"unreadable: {e}"))
+            continue
+        findings.extend(analyze_source(src, path=_norm_path(str(f)),
+                                       rules=rules))
+    findings.sort(key=lambda x: (x.path, x.line, x.col, x.rule))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# baseline
+# --------------------------------------------------------------------------
+
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+
+def load_baseline(path: Optional[os.PathLike] = None) -> Counter:
+    path = Path(path or DEFAULT_BASELINE)
+    if not path.exists():
+        return Counter()
+    data = json.loads(path.read_text(encoding="utf-8"))
+    return Counter((e["path"], e["rule"], e["snippet"])
+                   for e in data.get("entries", []))
+
+
+def write_baseline(findings: Sequence[Finding],
+                   path: Optional[os.PathLike] = None) -> Path:
+    path = Path(path or DEFAULT_BASELINE)
+    entries = [{"path": f.path, "rule": f.rule, "snippet": f.snippet}
+               for f in sorted(findings, key=lambda f: f.key())]
+    path.write_text(json.dumps({"version": 1, "entries": entries},
+                               indent=1) + "\n", encoding="utf-8")
+    return path
+
+
+def apply_baseline(findings: Sequence[Finding],
+                   baseline: Counter) -> Tuple[List[Finding], List[Finding]]:
+    """Split findings into (new, baselined). Baseline entries are a
+    multiset so N identical lines need N entries."""
+    budget = Counter(baseline)
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:
+        if budget[f.key()] > 0:
+            budget[f.key()] -= 1
+            f.baselined = True
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old
+
+
+def findings_to_json(new: Sequence[Finding],
+                     baselined: Sequence[Finding]) -> str:
+    return json.dumps({
+        "findings": [asdict(f) for f in new],
+        "baselined": [asdict(f) for f in baselined],
+        "counts": {"new": len(new), "baselined": len(baselined)},
+    }, indent=1)

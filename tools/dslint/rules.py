@@ -1,0 +1,758 @@
+"""dslint rules: the JAX/TPU-specific checks (DS001–DS008).
+
+Each rule encodes an invariant the runtime actually depends on (see
+docs/LINT.md for rationale and before/after examples):
+
+DS001  blocking host sync inside a hot loop (float()/bool()/.item()/
+       np.asarray()/jax.device_get() per iteration of a step/decode loop)
+DS002  jit cache fragmentation (jit in a loop, jit(lambda), jitting a
+       fresh nested def per call, unhashable static-arg defaults)
+DS003  donated buffer read after the jitted call that consumed it
+DS004  Python if/while branching on a traced value inside a jitted fn
+DS005  os.environ read outside the config/constants layer or at import
+DS006  bare except / except Exception that silently passes
+DS007  mutable default argument
+DS008  jnp./device work executed at module import scope
+
+All heuristics are deliberately lexical (pure ``ast``): they can't see
+through aliases or cross-module calls, so each rule favors precision on
+the failure modes this repo has actually shipped (PR 2's
+_flush_monitor_buffer host-sync bug, the two-compiled-programs serving
+contract) over recall. Suppress intentional hits inline with a reason.
+"""
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from tools.dslint.core import Finding
+
+# functions whose loops count as hot paths for DS001: the step/decode/
+# update loops where one stray sync serializes the device pipeline
+HOT_NAME = re.compile(r"(^|_)(step|train|decode|generate|update|micro)",
+                      re.IGNORECASE)
+
+LOOP_TYPES = (ast.For, ast.AsyncFor, ast.While,
+              ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+FUNC_TYPES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _parents(node: ast.AST) -> Iterator[ast.AST]:
+    p = getattr(node, "_ds_parent", None)
+    while p is not None:
+        yield p
+        p = getattr(p, "_ds_parent", None)
+
+
+def _enclosing(node: ast.AST, types) -> Optional[ast.AST]:
+    for p in _parents(node):
+        if isinstance(p, types):
+            return p
+    return None
+
+
+def _loop_between(node: ast.AST, fn: ast.AST) -> bool:
+    """True when a loop encloses ``node`` without leaving ``fn``.
+
+    A comprehension's *first* iterable is evaluated exactly once, so a
+    node sitting inside ``generators[0].iter`` is not per-iteration work
+    and the comprehension does not count as its enclosing loop.
+    """
+    for p in _parents(node):
+        if p is fn:
+            return False
+        if isinstance(p, LOOP_TYPES):
+            gens = getattr(p, "generators", None)
+            if gens and _contains(gens[0].iter, node):
+                continue
+            return True
+    return False
+
+
+def _contains(root: ast.AST, node: ast.AST) -> bool:
+    return any(n is node for n in ast.walk(root))
+
+
+def _dotted(func: ast.AST) -> List[str]:
+    """['jax', 'random', 'split'] for jax.random.split; [] if not a
+    plain name/attribute chain."""
+    parts: List[str] = []
+    while isinstance(func, ast.Attribute):
+        parts.append(func.attr)
+        func = func.value
+    if isinstance(func, ast.Name):
+        parts.append(func.id)
+        return list(reversed(parts))
+    return []
+
+
+def _stmt_of(node: ast.AST) -> Optional[ast.stmt]:
+    if isinstance(node, ast.stmt):
+        return node
+    for p in _parents(node):
+        if isinstance(p, ast.stmt):
+            return p
+    return None
+
+
+def _store_names(target: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for n in ast.walk(target):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+            out.add(n.id)
+    return out
+
+
+def _is_mutable_literal(node: ast.AST) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set,
+                         ast.ListComp, ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        chain = _dotted(node.func)
+        return chain[-1:] in (["list"], ["dict"], ["set"], ["bytearray"]) \
+            and len(chain) == 1
+    return False
+
+
+class Rule:
+    id = "DS000"
+    name = "base"
+    autofixable = False
+    rationale = ""
+
+    def check(self, tree: ast.AST, lines: Sequence[str],
+              path: str) -> List[Finding]:
+        raise NotImplementedError
+
+    def _f(self, path: str, node: ast.AST, message: str) -> Finding:
+        return Finding(self.id, path, getattr(node, "lineno", 0),
+                       getattr(node, "col_offset", 0), message)
+
+
+# --------------------------------------------------------------------------
+class BlockingHostSync(Rule):
+    id = "DS001"
+    name = "blocking-host-sync"
+    autofixable = False
+    rationale = ("float()/bool()/.item()/np.asarray()/jax.device_get() per "
+                 "iteration of a step/decode loop blocks on the device and "
+                 "serializes the pipeline; accumulate on device and pull "
+                 "once (batched jax.device_get) after the loop")
+
+    def check(self, tree, lines, path):
+        out = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            what = self._sync_kind(node)
+            if what is None:
+                continue
+            fn = _enclosing(node, FUNC_TYPES)
+            if fn is None or not HOT_NAME.search(fn.name):
+                continue
+            if not _loop_between(node, fn):
+                continue
+            out.append(self._f(
+                path, node,
+                f"blocking host sync `{what}` inside a loop of hot "
+                f"function `{fn.name}` — accumulate on device and do one "
+                f"batched pull (jax.device_get) after the loop"))
+        return out
+
+    @staticmethod
+    def _sync_kind(call: ast.Call) -> Optional[str]:
+        chain = _dotted(call.func)
+        if chain in (["float"], ["bool"]):
+            if not call.args or isinstance(call.args[0], ast.Constant):
+                return None
+            return f"{chain[0]}(...)"
+        if (isinstance(call.func, ast.Attribute) and call.func.attr == "item"
+                and not call.args):
+            return ".item()"
+        if chain[:1] in (["np"], ["numpy"]) and chain[-1:] in (
+                ["asarray"], ["array"]):
+            return f"{'.'.join(chain)}(...)"
+        if chain == ["jax", "device_get"]:
+            return "jax.device_get(...)"
+        return None
+
+
+# --------------------------------------------------------------------------
+class JitCacheFragmentation(Rule):
+    id = "DS002"
+    name = "jit-cache-fragmentation"
+    autofixable = False
+    rationale = ("jax.jit keyed on a fresh callable (loop-local jit, "
+                 "jit(lambda), re-jitted nested def) or an unhashable "
+                 "static default never hits the compile cache — every call "
+                 "recompiles")
+
+    def check(self, tree, lines, path):
+        out = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and self._is_jit(node.func):
+                fn = _enclosing(node, FUNC_TYPES)
+                if fn is not None and _loop_between(node, fn):
+                    out.append(self._f(
+                        path, node,
+                        "jax.jit called inside a loop — every iteration "
+                        "wraps a fresh callable and recompiles; hoist the "
+                        "jit out of the loop"))
+                if any(isinstance(a, ast.Lambda) for a in node.args):
+                    out.append(self._f(
+                        path, node,
+                        "lambda passed to jax.jit — a new lambda object per "
+                        "evaluation defeats the jit cache; use a module-"
+                        "level def"))
+            if isinstance(node, FUNC_TYPES):
+                out.extend(self._check_def(node, path))
+        return out
+
+    @staticmethod
+    def _is_jit(func: ast.AST) -> bool:
+        chain = _dotted(func)
+        return chain == ["jax", "jit"] or chain == ["jit"]
+
+    def _jit_decorator(self, dec: ast.AST) -> Optional[ast.AST]:
+        """The decorator node when it applies jax.jit (plain or via
+        functools.partial), else None."""
+        if self._is_jit(dec):
+            return dec
+        if isinstance(dec, ast.Call):
+            chain = _dotted(dec.func)
+            if chain[-1:] == ["jit"] and chain[:-1] in ([], ["jax"]):
+                return dec
+            if chain[-1:] == ["partial"] and dec.args \
+                    and self._is_jit(dec.args[0]):
+                return dec
+        return None
+
+    def _check_def(self, node, path) -> List[Finding]:
+        out = []
+        jit_dec = None
+        for dec in node.decorator_list:
+            jit_dec = self._jit_decorator(dec)
+            if jit_dec is not None:
+                break
+        if jit_dec is None:
+            return out
+        enclosing_fn = _enclosing(node, FUNC_TYPES)
+        if enclosing_fn is not None and not self._escapes(node.name,
+                                                          enclosing_fn):
+            out.append(self._f(
+                path, node,
+                f"`{node.name}` is re-defined and re-jitted on every call "
+                f"of `{enclosing_fn.name}` — each definition is a new "
+                f"cache key; hoist it or cache the jitted function"))
+        out.extend(self._check_static_defaults(node, jit_dec, path))
+        return out
+
+    @staticmethod
+    def _escapes(name: str, enclosing_fn: ast.AST) -> bool:
+        """A nested jitted def that is cached (stored on self/a dict) or
+        returned survives the enclosing call — not a per-call recompile.
+        Only the function OBJECT escaping counts: ``return inner(x)``
+        calls it and discards it, which is exactly the per-call pattern
+        the rule exists to catch."""
+        def _obj_escapes(value: ast.AST) -> bool:
+            for sub in ast.walk(value):
+                if not (isinstance(sub, ast.Name) and sub.id == name):
+                    continue
+                parent = getattr(sub, "_ds_parent", None)
+                if isinstance(parent, ast.Call) and parent.func is sub:
+                    continue
+                return True
+            return False
+
+        for n in ast.walk(enclosing_fn):
+            if isinstance(n, ast.Return) and n.value is not None \
+                    and _obj_escapes(n.value):
+                return True
+            if isinstance(n, ast.Assign) and any(
+                    isinstance(t, (ast.Attribute, ast.Subscript))
+                    for t in n.targets) and _obj_escapes(n.value):
+                return True
+        return False
+
+    def _check_static_defaults(self, node, jit_dec, path) -> List[Finding]:
+        out = []
+        statics_nums: List[int] = []
+        statics_names: List[str] = []
+        if isinstance(jit_dec, ast.Call):
+            for kw in jit_dec.keywords:
+                val = kw.value
+                items = val.elts if isinstance(
+                    val, (ast.Tuple, ast.List)) else [val]
+                if kw.arg == "static_argnums":
+                    statics_nums = [i.value for i in items
+                                    if isinstance(i, ast.Constant)
+                                    and isinstance(i.value, int)]
+                elif kw.arg == "static_argnames":
+                    statics_names = [i.value for i in items
+                                     if isinstance(i, ast.Constant)
+                                     and isinstance(i.value, str)]
+        args = list(node.args.posonlyargs) + list(node.args.args)
+        defaults = list(node.args.defaults)
+        # defaults align with the TAIL of the positional args
+        offset = len(args) - len(defaults)
+        for i, d in enumerate(defaults):
+            ai = offset + i
+            is_static = ai in statics_nums or args[ai].arg in statics_names
+            if is_static and _is_mutable_literal(d):
+                out.append(self._f(
+                    path, d,
+                    f"static arg `{args[ai].arg}` of jitted `{node.name}` "
+                    f"defaults to an unhashable value — jit's cache lookup "
+                    f"raises (or hashes by identity) on it; use a tuple or "
+                    f"frozen value"))
+        for a, d in zip(node.args.kwonlyargs, node.args.kw_defaults):
+            if d is not None and a.arg in statics_names \
+                    and _is_mutable_literal(d):
+                out.append(self._f(
+                    path, d,
+                    f"static kwarg `{a.arg}` of jitted `{node.name}` "
+                    f"defaults to an unhashable value"))
+        return out
+
+
+# --------------------------------------------------------------------------
+class DonationHazard(Rule):
+    id = "DS003"
+    name = "donated-buffer-reuse"
+    autofixable = False
+    rationale = ("an argument listed in donate_argnums is dead after the "
+                 "jitted call — XLA may have aliased its buffer into the "
+                 "output; reading it is undefined (garbage on TPU, silent "
+                 "correctness bug)")
+
+    def check(self, tree, lines, path):
+        registry = self._collect_donating(tree)
+        if not registry:
+            return []
+        out = []
+        for fn in ast.walk(tree):
+            if not isinstance(fn, FUNC_TYPES):
+                continue
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                key = self._callee_key(call.func)
+                if key is None or key not in registry:
+                    continue
+                for pos in registry[key]:
+                    if pos < len(call.args) and isinstance(
+                            call.args[pos], ast.Name):
+                        out.extend(self._check_use_after(
+                            fn, call, call.args[pos].id, key[1], path))
+        return out
+
+    # -- registry: name/attr -> donated positions -------------------------
+    def _collect_donating(self, tree) -> Dict[Tuple[str, str], List[int]]:
+        reg: Dict[Tuple[str, str], List[int]] = {}
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign) or not isinstance(
+                    node.value, ast.Call):
+                continue
+            call = node.value
+            if _dotted(call.func) not in (["jax", "jit"], ["jit"]):
+                continue
+            donated: List[int] = []
+            for kw in call.keywords:
+                if kw.arg == "donate_argnums":
+                    items = kw.value.elts if isinstance(
+                        kw.value, (ast.Tuple, ast.List)) else [kw.value]
+                    donated = [i.value for i in items
+                               if isinstance(i, ast.Constant)
+                               and isinstance(i.value, int)]
+            if not donated:
+                continue
+            # jitting a bound method (jax.jit(self._fn)) drops `self` from
+            # the arg positions, so recorded positions apply as-is to the
+            # call sites; both Name and self.attr targets are tracked
+            for t in node.targets:
+                key = self._callee_key(t)
+                if key is not None:
+                    reg[key] = donated
+        return reg
+
+    @staticmethod
+    def _callee_key(node: ast.AST) -> Optional[Tuple[str, str]]:
+        if isinstance(node, ast.Name):
+            return ("name", node.id)
+        if isinstance(node, ast.Attribute) and isinstance(
+                node.value, ast.Name) and node.value.id in ("self", "cls"):
+            return ("attr", node.attr)
+        return None
+
+    # -- use-after-donation scan ------------------------------------------
+    def _check_use_after(self, fn, call, name, callee, path) -> List[Finding]:
+        stmt = _stmt_of(call)
+        # the consuming statement's own assignment rebinds the name: safe
+        if isinstance(stmt, ast.Assign) and any(
+                name in _store_names(t) for t in stmt.targets):
+            return []
+        if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)) and \
+                name in _store_names(stmt.target):
+            return []
+        call_pos = (call.end_lineno or call.lineno,
+                    call.end_col_offset or call.col_offset)
+        events = []
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Name) and n.id == name:
+                if any(p is call for p in _parents(n)) or n is call:
+                    continue
+                events.append(((n.lineno, n.col_offset),
+                               isinstance(n.ctx, ast.Store), n))
+        events.sort(key=lambda e: e[0])
+        for pos, is_store, n in events:
+            if pos <= call_pos:
+                continue
+            if is_store:
+                return []        # rebound before any later read
+            return [self._f(
+                path, n,
+                f"`{name}` was donated to `{callee}` (donate_argnums) but "
+                f"is read afterwards — the buffer may have been aliased "
+                f"into the output; rebind or copy before donating")]
+        return []
+
+
+# --------------------------------------------------------------------------
+class TracedPythonBranch(Rule):
+    id = "DS004"
+    name = "traced-python-branch"
+    autofixable = False
+    rationale = ("Python if/while on a traced value inside a jitted "
+                 "function raises TracerBoolConversionError at best and "
+                 "silently bakes one branch into the compiled program at "
+                 "worst; use lax.cond/jnp.where or mark the arg static")
+
+    _OK_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval"}
+    _OK_CALLS = {"len", "isinstance", "hasattr", "getattr", "callable"}
+
+    def check(self, tree, lines, path):
+        jitted = self._jitted_defs(tree)
+        out = []
+        for fn, statics in jitted:
+            params = [a.arg for a in (list(fn.args.posonlyargs)
+                                      + list(fn.args.args)
+                                      + list(fn.args.kwonlyargs))]
+            traced = {p for p in params if p not in statics
+                      and p not in ("self", "cls")}
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                bad = self._traced_name_in_test(node.test, traced)
+                if bad:
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    out.append(self._f(
+                        path, node,
+                        f"Python `{kind}` on traced argument `{bad}` inside "
+                        f"jitted `{fn.name}` — branch with jnp.where/"
+                        f"lax.cond, or make `{bad}` a static_argnum"))
+        return out
+
+    # -- which defs are jitted, and which of their params are static ------
+    def _jitted_defs(self, tree):
+        frag = JitCacheFragmentation()
+        # name -> (static positions, static names, bound-method offset)
+        marked: Dict[str, Tuple[List[int], List[str], int]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and frag._is_jit(node.func) \
+                    and node.args:
+                target = node.args[0]
+                nums, names = self._statics_of(node)
+                if isinstance(target, ast.Name):
+                    marked[target.id] = (nums, names, 0)
+                elif isinstance(target, ast.Attribute) and isinstance(
+                        target.value, ast.Name) and target.value.id == "self":
+                    # bound method: call-site positions skip `self`
+                    marked[target.attr] = (nums, names, 1)
+        out = []
+        for fn in ast.walk(tree):
+            if not isinstance(fn, FUNC_TYPES):
+                continue
+            dec = None
+            for d in fn.decorator_list:
+                dec = frag._jit_decorator(d)
+                if dec is not None:
+                    break
+            if dec is not None:
+                nums, names = (self._statics_of(dec)
+                               if isinstance(dec, ast.Call) else ([], []))
+                out.append((fn, self._static_params(fn, nums, names, 0)))
+            elif fn.name in marked:
+                nums, names, off = marked[fn.name]
+                out.append((fn, self._static_params(fn, nums, names, off)))
+        return out
+
+    @staticmethod
+    def _statics_of(call: ast.Call) -> Tuple[List[int], List[str]]:
+        nums: List[int] = []
+        names: List[str] = []
+        for kw in call.keywords:
+            items = kw.value.elts if isinstance(
+                kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            if kw.arg == "static_argnums":
+                nums = [i.value for i in items
+                        if isinstance(i, ast.Constant)
+                        and isinstance(i.value, int)]
+            elif kw.arg == "static_argnames":
+                names = [i.value for i in items
+                         if isinstance(i, ast.Constant)
+                         and isinstance(i.value, str)]
+        return nums, names
+
+    @staticmethod
+    def _static_params(fn, nums, names, offset) -> Set[str]:
+        args = list(fn.args.posonlyargs) + list(fn.args.args)
+        statics = set(names)
+        for p in nums:
+            idx = p + offset
+            if 0 <= idx < len(args):
+                statics.add(args[idx].arg)
+        return statics
+
+    def _traced_name_in_test(self, test: ast.AST,
+                             traced: Set[str]) -> Optional[str]:
+        for n in ast.walk(test):
+            if not (isinstance(n, ast.Name) and n.id in traced
+                    and isinstance(n.ctx, ast.Load)):
+                continue
+            # climb through subscripts so `x['a'].shape` reads like
+            # `x.shape` — indexing changes the leaf, not the question
+            cur: ast.AST = n
+            parent = getattr(cur, "_ds_parent", None)
+            while isinstance(parent, ast.Subscript) and parent.value is cur:
+                cur = parent
+                parent = getattr(cur, "_ds_parent", None)
+            if isinstance(parent, ast.Attribute) \
+                    and parent.attr in self._OK_ATTRS:
+                continue
+            if isinstance(parent, ast.Call) and \
+                    _dotted(parent.func)[-1:] != [] and \
+                    _dotted(parent.func)[-1] in self._OK_CALLS:
+                continue
+            if isinstance(parent, ast.Compare) and all(
+                    isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                    for op in parent.ops):
+                # `x is None` and `"key" in x` test pytree STRUCTURE,
+                # which is static under trace
+                continue
+            return n.id
+        return None
+
+
+# --------------------------------------------------------------------------
+class EnvReadOutsideConfig(Rule):
+    id = "DS005"
+    name = "env-read-outside-config"
+    autofixable = False
+    rationale = ("os.environ scattered through library code makes behavior "
+                 "depend on ambient state that tests and serving replicas "
+                 "don't pin; route env through the config/constants layer. "
+                 "Module-scope reads additionally freeze the value at "
+                 "import order")
+
+    # the sanctioned env layer: config/constants modules, environment
+    # reporting, process bootstrap (launcher), test harness, entry scripts
+    _ALLOWED = re.compile(
+        r"(config|constants|env_report|conftest)"
+        r"|(^|/)launcher/"
+        r"|(^|/)tools/")
+
+    def check(self, tree, lines, path):
+        allowed_file = bool(self._ALLOWED.search(path.replace("\\", "/")))
+        out = []
+        for node in ast.walk(tree):
+            kind = self._env_read(node)
+            if kind is None:
+                continue
+            fn = _enclosing(node, FUNC_TYPES)
+            if fn is None:
+                out.append(self._f(
+                    path, node,
+                    f"`{kind}` at module import scope freezes the value at "
+                    f"import time — read it inside the function that needs "
+                    f"it (or in the config layer)"))
+            elif not allowed_file:
+                out.append(self._f(
+                    path, node,
+                    f"`{kind}` outside the config/constants layer — thread "
+                    f"the setting through config so replicas and tests can "
+                    f"pin it"))
+        return out
+
+    @staticmethod
+    def _is_environ(node: ast.AST) -> bool:
+        return (isinstance(node, ast.Attribute) and node.attr == "environ"
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "os")
+
+    def _env_read(self, node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Subscript) and self._is_environ(node.value):
+            return "os.environ[...]"
+        if isinstance(node, ast.Call):
+            chain = _dotted(node.func)
+            if chain == ["os", "getenv"]:
+                return "os.getenv(...)"
+            if isinstance(node.func, ast.Attribute) and self._is_environ(
+                    node.func.value):
+                return f"os.environ.{node.func.attr}(...)"
+        if isinstance(node, ast.Compare) and any(
+                isinstance(op, (ast.In, ast.NotIn)) for op in node.ops):
+            if any(self._is_environ(c) for c in node.comparators):
+                return "... in os.environ"
+        return None
+
+
+# --------------------------------------------------------------------------
+class OverbroadExcept(Rule):
+    id = "DS006"
+    name = "overbroad-except"
+    autofixable = False
+    rationale = ("a bare except (or `except Exception: pass`) swallows "
+                 "KeyboardInterrupt/compile errors/real bugs silently — "
+                 "the failure surfaces later as wrong numerics or a hang; "
+                 "catch the specific exception or at least log it")
+
+    _BROAD = {"Exception", "BaseException"}
+
+    def check(self, tree, lines, path):
+        out = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                out.append(self._f(
+                    path, node,
+                    "bare `except:` also catches KeyboardInterrupt/"
+                    "SystemExit — name the exception type"))
+                continue
+            names = self._type_names(node.type)
+            swallows = all(isinstance(s, (ast.Pass, ast.Continue))
+                           for s in node.body)
+            if names & self._BROAD and swallows:
+                out.append(self._f(
+                    path, node,
+                    f"`except {'/'.join(sorted(names & self._BROAD))}` that "
+                    f"silently passes — narrow the type or log the failure"))
+        return out
+
+    @staticmethod
+    def _type_names(t: ast.AST) -> Set[str]:
+        names: Set[str] = set()
+        nodes = t.elts if isinstance(t, ast.Tuple) else [t]
+        for n in nodes:
+            chain = _dotted(n)
+            if chain:
+                names.add(chain[-1])
+        return names
+
+
+# --------------------------------------------------------------------------
+class MutableDefaultArg(Rule):
+    id = "DS007"
+    name = "mutable-default-arg"
+    autofixable = True
+    rationale = ("a mutable default is created once at def time and shared "
+                 "across every call — state leaks between calls; default "
+                 "to None and construct inside")
+
+    def check(self, tree, lines, path):
+        out = []
+        for node in ast.walk(tree):
+            if not isinstance(node, FUNC_TYPES):
+                continue
+            args = list(node.args.posonlyargs) + list(node.args.args)
+            offset = len(args) - len(node.args.defaults)
+            for i, d in enumerate(node.args.defaults):
+                if _is_mutable_literal(d):
+                    out.append(self._f(
+                        path, d,
+                        f"mutable default for `{args[offset + i].arg}` in "
+                        f"`{node.name}` is shared across calls — use None "
+                        f"and construct inside"))
+            for a, d in zip(node.args.kwonlyargs, node.args.kw_defaults):
+                if d is not None and _is_mutable_literal(d):
+                    out.append(self._f(
+                        path, d,
+                        f"mutable default for `{a.arg}` in `{node.name}` "
+                        f"is shared across calls — use None and construct "
+                        f"inside"))
+        return out
+
+
+# --------------------------------------------------------------------------
+class ImportScopeDeviceWork(Rule):
+    id = "DS008"
+    name = "import-scope-device-work"
+    autofixable = False
+    rationale = ("jnp./device calls at module scope run at import: they "
+                 "pick a backend before the app configures one, allocate "
+                 "HBM in every process that merely imports the module, and "
+                 "serialize startup behind compiles")
+
+    # jax.* sub-apis that touch the backend (vs pure transforms like
+    # jax.jit/jax.grad, which only wrap)
+    _JAX_DEVICE = {"random", "numpy", "device_put", "devices",
+                   "local_devices", "device_count", "local_device_count",
+                   "make_array_from_callback",
+                   "make_array_from_single_device_arrays"}
+    _JNP_OK = {"dtype"}          # metadata-only, no backend touch
+
+    def check(self, tree, lines, path):
+        out = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = _enclosing(node, FUNC_TYPES)
+            if fn is not None:
+                # a default-argument expression evaluates when the def
+                # executes — import time for a top-level def; anything
+                # else inside a function runs at call time
+                if not self._in_defaults(node) \
+                        or _enclosing(fn, FUNC_TYPES) is not None:
+                    continue
+            chain = _dotted(node.func)
+            if not chain:
+                continue
+            flagged = None
+            if chain[0] == "jnp" and len(chain) > 1 \
+                    and chain[1] not in self._JNP_OK:
+                flagged = ".".join(chain)
+            elif chain[0] == "jax" and len(chain) > 1 \
+                    and chain[1] in self._JAX_DEVICE:
+                flagged = ".".join(chain)
+            if flagged is None:
+                continue
+            where = ("default argument" if self._in_defaults(node)
+                     else "module import scope")
+            out.append(self._f(
+                path, node,
+                f"`{flagged}(...)` at {where} executes device work at "
+                f"import — move it inside the function (or make it lazy)"))
+        return out
+
+    @staticmethod
+    def _in_defaults(node: ast.AST) -> bool:
+        for p in _parents(node):
+            if isinstance(p, ast.arguments):
+                return True
+            if isinstance(p, (ast.stmt,)):
+                return False
+        return False
+
+
+# --------------------------------------------------------------------------
+
+def default_rules() -> List[Rule]:
+    return [BlockingHostSync(), JitCacheFragmentation(), DonationHazard(),
+            TracedPythonBranch(), EnvReadOutsideConfig(), OverbroadExcept(),
+            MutableDefaultArg(), ImportScopeDeviceWork()]
+
+
+def rule_catalog() -> List[Dict[str, str]]:
+    return [{"id": r.id, "name": r.name,
+             "autofixable": r.autofixable, "rationale": r.rationale}
+            for r in default_rules()]

@@ -42,7 +42,7 @@ def run_case(name, make):
         # bwd tile overrides are a kernel knob only — strip for the ref
         ref_kwargs = {k_: v_ for k_, v_ in kwargs.items()
                       if not k_.startswith("bwd_")}
-        out = jax.jit(lambda q, k, v: F.flash_attention(
+        out = jax.jit(lambda q, k, v: F.flash_attention(  # dslint: disable=DS002 — smoke test compiles per shape on purpose
             q, k, v, causal=True, block_q=256, block_kv=256,
             **kwargs))(q, k, v)
         ref = F.mha_reference(q, k, v, causal=True, **ref_kwargs)
@@ -134,9 +134,9 @@ def ring_block_cases(wanted=()):
         elif wanted and "ring-blocks" not in wanted:
             continue
         try:
-            o, lse = jax.jit(lambda a, b, c: F.flash_block_fwd(
+            o, lse = jax.jit(lambda a, b, c: F.flash_block_fwd(  # dslint: disable=DS002 — benchmark measures per-config compile+run
                 a, b, c, block_q=256, block_kv=256, **kwargs))(q, k, v)
-            dq, dk, dv = jax.jit(lambda a, b, c, do, o, lse:
+            dq, dk, dv = jax.jit(lambda a, b, c, do, o, lse:  # dslint: disable=DS002 — benchmark measures per-config compile+run
                                  F.flash_block_bwd(
                                      a, b, c, do, o, lse, block_q=256,
                                      block_kv=256, **kwargs))(

@@ -20,6 +20,15 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 if [[ "${1:-}" == "quick" ]]; then
+    # lint only the .py files this change touches (full-tree scan is the
+    # full gate's job); baseline + inline suppressions apply as usual
+    lint_changed=$(git diff --name-only --diff-filter=d HEAD -- \
+                   'deepspeed_tpu/*.py' 'deepspeed_tpu/**/*.py' \
+                   'tools/*.py' 'tools/**/*.py' | tr '\n' ' ')
+    if [[ -n "${lint_changed// }" ]]; then
+        echo "gate(quick) dslint: $lint_changed"
+        python -m tools.dslint $lint_changed
+    fi
     # changed TEST files run as-is; changed source files map to test
     # files by name heuristic; plus the always-on smoke set
     # (engine/config/gpt cover the load-bearing core)
@@ -36,6 +45,7 @@ if [[ "${1:-}" == "quick" ]]; then
     echo "gate(quick): $tests"
     python -m pytest $tests -q
 else
+    python -m tools.dslint deepspeed_tpu tools
     python -m pytest tests/ -q
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 fi

@@ -90,7 +90,7 @@ def mesh():
                      ("ulysses", ulysses_attention)):
         zig = name == "ring-zigzag"
         kw = {"layout": "zigzag"} if zig else {}
-        f = jax.jit(lambda a, b, c, fn=fn, kw=kw: fn(
+        f = jax.jit(lambda a, b, c, fn=fn, kw=kw: fn(  # dslint: disable=DS002 — bench re-jits per (impl, seqlen) config on purpose
             a, b, c, mesh=mesh, axis="sequence", causal=True, **kw))
         args = (qz, kz, vz) if zig else (qs, ks, vs)
         out = jax.block_until_ready(f(*args))

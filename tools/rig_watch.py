@@ -27,8 +27,8 @@ PHASE1 = ["flash-smoke", "probe", "trace-1.5b"]
 # cadence is env-overridable so the recovery cycle can be REHEARSED on
 # the CPU backend (tests/test_rig_recovery.py) at second-scale timings —
 # the automation gets a test before its one shot at the real rig
-POLL_S = int(os.environ.get("DS_RIGWATCH_POLL_S", 300))
-CONFIRM_S = int(os.environ.get("DS_RIGWATCH_CONFIRM_S", 45))
+POLL_S = int(os.environ.get("DS_RIGWATCH_POLL_S", 300))  # dslint: disable=DS005 — standalone watchdog, env IS its config
+CONFIRM_S = int(os.environ.get("DS_RIGWATCH_CONFIRM_S", 45))  # dslint: disable=DS005 — standalone watchdog, env IS its config
 
 
 def log(**kw):
