@@ -599,9 +599,14 @@ class InferenceEngine:
                                    (params["block"], k_pool, v_pool))
         return self._logits(params, x), ks, vs
 
-    # public wrappers: host-side numpy in, device pools threaded through
+    # public wrappers: host-side numpy in, device pools threaded through.
+    # The fault-injection sites fire BEFORE any dispatch touches the
+    # donated pools, so a TransientDeviceError here is retryable by the
+    # serving engine against intact buffers (utils/faults).
     def prefill_into_slot(self, k_pool, v_pool, table_row, tokens, start,
                           n_valid):
+        from deepspeed_tpu.utils.faults import maybe_fire
+        maybe_fire("engine.prefill")
         return self._prefill_slot(
             self.params, k_pool, v_pool,
             jnp.asarray(table_row, jnp.int32),
@@ -610,6 +615,8 @@ class InferenceEngine:
 
     def decode_slots(self, k_pool, v_pool, tables, lengths, tokens, active,
                      impl=None):
+        from deepspeed_tpu.utils.faults import maybe_fire
+        maybe_fire("engine.decode")
         return self._decode_slots(
             self.params, k_pool, v_pool,
             jnp.asarray(tables, jnp.int32),

@@ -51,8 +51,13 @@ class PagedKVCache:
                  block_size: int = 16, num_blocks: Optional[int] = None,
                  hbm_budget_bytes: Optional[int] = None,
                  dtype=jnp.bfloat16, max_seq_len: Optional[int] = None,
-                 watermark: Optional[int] = None):
+                 watermark: Optional[int] = None, faults=None):
         self.cfg = cfg
+        # fault-injection hook (utils/faults.FaultInjector): the
+        # ``cache.allocate`` / ``cache.ensure`` sites can fire a
+        # synthetic CacheExhausted so the scheduler's eviction path runs
+        # under test without actually shrinking the pool
+        self.faults = faults
         self.block_size = int(block_size)
         self.num_slots = int(num_slots)
         self.blocks_per_slot, self.tokens_per_slot = gpt_lib.decode_geometry(
@@ -132,6 +137,7 @@ class PagedKVCache:
     def allocate(self, slot: int, n_tokens: int) -> None:
         """Reserve blocks covering ``n_tokens`` for a fresh slot."""
         assert not self.active[slot] and not self._owned[slot], slot
+        self._maybe_inject("cache.allocate", slot)
         need = self.blocks_for(n_tokens)
         if need > self.blocks_per_slot:
             raise ValueError(
@@ -151,6 +157,7 @@ class PagedKVCache:
     def ensure_capacity(self, slot: int, n_tokens: int) -> None:
         """Grow the slot's table until it covers ``n_tokens`` (append)."""
         assert self.active[slot], slot
+        self._maybe_inject("cache.ensure", slot)
         need = self.blocks_for(n_tokens)
         if need > self.blocks_per_slot:
             raise ValueError(
@@ -185,3 +192,12 @@ class PagedKVCache:
 
     def _mark(self):
         self.peak_used_blocks = max(self.peak_used_blocks, self.used_blocks)
+
+    def _maybe_inject(self, site: str, slot: int) -> None:
+        if self.faults is None:
+            return
+        f = self.faults.fire(site)
+        if f is not None and f.kind == "cache_exhausted":
+            raise CacheExhausted(
+                f"injected cache exhaustion at {site} (slot {slot}, "
+                f"{self.free_blocks} blocks actually free)")

@@ -6,13 +6,16 @@ drain. This scheduler implements iteration-level (continuous) batching
 as in Orca (Yu et al., OSDI '22): a fixed set of decode SLOTS, and on
 every iteration
 
-1. **admission** — queued requests claim free slots if the paged cache
+1. **expiry** — requests past their ``deadline`` retire with
+   ``state="timeout"`` (partial tokens kept) instead of squatting a
+   slot or queue position;
+2. **admission** — queued requests claim free slots if the paged cache
    can cover their prompt while keeping the watermark reserve;
-2. **prefill** — newly admitted requests prefill their prompt into
+3. **prefill** — newly admitted requests prefill their prompt into
    their slot in fixed-width CHUNKS (one chunk per iteration per slot),
    so a long prompt never stalls the running decode batch for more than
    one chunk's latency;
-3. **decode** — all decoding slots advance one token through the single
+4. **decode** — all decoding slots advance one token through the single
    compiled ``decode_slots`` program, each at its own position.
 
 On cache exhaustion mid-decode the scheduler EVICTS the most recently
@@ -20,9 +23,37 @@ admitted request instead of OOMing: its blocks return to the pool and
 the request requeues (front of the queue) with prompt+generated as its
 new prompt — recompute-on-resume reproduces the exact pre-eviction
 state, so greedy outputs are untouched (vLLM's recompute preemption).
+``max_evictions`` caps how often one request may be preempted: a
+request at the cap is PINNED (never chosen as a victim again), so an
+eviction storm cannot livelock requeued work — the oldest pinned
+request always runs to completion.
+
+Graceful degradation (the chaos contract, tests/test_chaos.py):
+
+- **bounded queue + load shedding** — with ``max_queue`` set, a submit
+  into a full queue retires the NEWEST request with ``state="shed"``
+  (reject-newest keeps already-accepted work's latency predictable);
+  ``stats["backpressure"]`` exposes queue fullness in [0, 1] for
+  upstream admission control;
+- **retry with backoff** — transient device errors
+  (:class:`~deepspeed_tpu.utils.faults.TransientDeviceError`) around the
+  two slot programs retry up to ``max_retries`` times with exponential
+  backoff and deterministic (seeded) jitter; faults fire BEFORE
+  dispatch, so the donated pools are still valid on every retry;
+- **step watchdog** — with ``step_time_budget_s`` set, ``watchdog_grace``
+  consecutive over-budget decode dispatches raise a structured
+  :class:`DegradedError` carrying every finished result and a snapshot
+  of in-flight work (nothing is thrown away), instead of hanging;
+- **fault injection** — the engine consults the ambient
+  :mod:`deepspeed_tpu.utils.faults` injector (or one passed as
+  ``faults=``) at the ``serving.decode`` / ``serving.prefill`` sites;
+  the paged cache exposes ``cache.allocate`` / ``cache.ensure``.
 
 The steady state is two compiled programs (prefill chunk, slot decode)
-regardless of arrival pattern; all scheduling state is host numpy.
+regardless of arrival pattern; all scheduling state is host numpy. None
+of the robustness paths (deadlines, shedding, backoff, expiry) touch
+device shapes, so the compile-count contract is unchanged — pinned by
+``test_serving_compile_count_contract`` and its chaos twin.
 
 Greedy parity contract (tested): for any arrival pattern, every
 request's output is token-for-token identical to a solo
@@ -39,20 +70,28 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.inference.paged_cache import CacheExhausted, PagedKVCache
+from deepspeed_tpu.utils import faults as faults_lib
+from deepspeed_tpu.utils.faults import TransientDeviceError
 from deepspeed_tpu.utils.logging import logger
+
+TERMINAL_STATES = ("done", "timeout", "shed")
 
 
 @dataclass
 class ServeRequest:
     """One generation request. ``out`` accumulates generated token ids;
     ``token_times`` the scheduler-clock stamp of each emitted token (the
-    bench derives per-token latency percentiles from these)."""
+    bench derives per-token latency percentiles from these).
+    ``deadline`` is an absolute scheduler-clock instant (same clock as
+    ``submit``/``step``'s ``now``): once reached the request retires
+    with ``state="timeout"``, keeping whatever it generated."""
     rid: Any
     prompt: np.ndarray
     max_new_tokens: int = 32
     eos_id: Optional[int] = None
+    deadline: Optional[float] = None
     out: List[int] = field(default_factory=list)
-    state: str = "queued"            # queued | prefill | decode | done
+    state: str = "queued"      # queued | prefill | decode | done | timeout | shed
     token_times: List[float] = field(default_factory=list)
     submitted_at: Optional[float] = None
     first_token_at: Optional[float] = None
@@ -68,6 +107,26 @@ class ServeRequest:
             [self.prompt, np.asarray(self.out, np.int32)])
 
 
+class DegradedError(RuntimeError):
+    """The engine cannot meet its contract (hung step, non-drain) but
+    the work it DID finish is intact: ``results`` maps rid ->
+    prompt+generated for every retired request, ``finished`` holds the
+    request objects, ``pending`` is a host-side snapshot of in-flight
+    work (rid/state/tokens-generated/evictions), ``stats`` the engine
+    counters at raise time. The scheduler state stays consistent — a
+    caller may resubmit ``pending`` work or keep stepping."""
+
+    def __init__(self, message: str, results: Optional[Dict] = None,
+                 finished: Optional[List[ServeRequest]] = None,
+                 pending: Optional[List[Dict]] = None,
+                 stats: Optional[Dict] = None):
+        super().__init__(message)
+        self.results = results or {}
+        self.finished = finished or []
+        self.pending = pending or []
+        self.stats = stats or {}
+
+
 class ServingEngine:
     """Continuous-batching front end for an ``InferenceEngine``.
 
@@ -75,6 +134,21 @@ class ServingEngine:
     watermark); ``num_slots`` bounds the decode batch; ``prefill_chunk``
     bounds how much prompt work one iteration may do (decode latency
     stays O(chunk) under long-prompt arrivals).
+
+    Robustness knobs (all default to the pre-chaos behavior):
+
+    - ``max_queue``: queue bound; a submit beyond it sheds the newcomer
+      (``state="shed"``). None = unbounded.
+    - ``max_evictions``: per-request preemption cap; at the cap a
+      request is pinned against further eviction (storm guard).
+    - ``step_time_budget_s`` / ``watchdog_grace``: decode-dispatch time
+      budget; ``watchdog_grace`` consecutive over-budget steps raise
+      :class:`DegradedError` with partial results. None disables.
+    - ``max_retries`` / ``retry_backoff_s``: transient-device-error
+      retry count and initial backoff (doubled per attempt, plus
+      deterministic jitter from the fault injector's seeded rng).
+    - ``faults``: a :class:`~deepspeed_tpu.utils.faults.FaultInjector`;
+      defaults to the ambient one (env ``DS_FAULTS`` or installed).
     """
 
     def __init__(self, engine, *, num_slots: int = 4, block_size: int = 16,
@@ -82,7 +156,13 @@ class ServingEngine:
                  hbm_budget_bytes: Optional[int] = None,
                  prefill_chunk: int = 64, temperature: float = 0.0,
                  top_k: int = 0, seed: int = 0,
-                 decode_impl: Optional[str] = None):
+                 decode_impl: Optional[str] = None,
+                 max_queue: Optional[int] = None,
+                 max_evictions: int = 8,
+                 step_time_budget_s: Optional[float] = None,
+                 watchdog_grace: int = 2,
+                 max_retries: int = 3, retry_backoff_s: float = 0.02,
+                 faults: Optional[faults_lib.FaultInjector] = None):
         if engine.is_encoder:
             raise ValueError("serving needs a causal decoder engine")
         self.engine = engine
@@ -96,10 +176,12 @@ class ServingEngine:
         else:
             from deepspeed_tpu.ops.attention.paged import resolve_decode_impl
             self.decode_impl = resolve_decode_impl(decode_impl)
+        self.faults = faults if faults is not None else faults_lib.active()
         self.cache = PagedKVCache(
             engine.cfg, num_slots=num_slots, block_size=block_size,
             num_blocks=num_blocks, hbm_budget_bytes=hbm_budget_bytes,
-            dtype=engine.dtype, max_seq_len=engine.max_seq_len)
+            dtype=engine.dtype, max_seq_len=engine.max_seq_len,
+            faults=self.faults)
         mesh = getattr(engine, "mesh", None)
         if mesh is not None:
             # place the fresh pools exactly where the jitted programs
@@ -115,18 +197,33 @@ class ServingEngine:
         self.prefill_chunk = int(prefill_chunk)
         self.temperature = temperature
         self.top_k = top_k
+        self.max_queue = max_queue
+        self.max_evictions = int(max_evictions)
+        self.step_time_budget_s = step_time_budget_s
+        self.watchdog_grace = max(1, int(watchdog_grace))
+        self.max_retries = int(max_retries)
+        self.retry_backoff_s = float(retry_backoff_s)
         self._rng = jax.random.PRNGKey(seed)
         self.queue: deque = deque()
         self.slots: List[Optional[ServeRequest]] = [None] * num_slots
         self.finished: List[ServeRequest] = []
         self._progress = np.zeros((num_slots,), np.int64)  # prefilled toks
         self._admit_counter = 0
+        self._over_budget = 0            # consecutive watchdog strikes
+        self._watchdog_msg: Optional[str] = None
         self.stats = {"steps": 0, "occupancy_sum": 0, "peak_occupancy": 0,
                       "evictions": 0, "admitted": 0, "completed": 0,
-                      "prefill_chunks": 0, "decode_steps": 0}
+                      "prefill_chunks": 0, "decode_steps": 0,
+                      "timeouts": 0, "shed": 0, "retries": 0,
+                      "evict_capped": 0, "watchdog_trips": 0,
+                      "backpressure": 0.0}
 
     # -- API -----------------------------------------------------------
-    def submit(self, req: ServeRequest, now: float = 0.0) -> None:
+    def submit(self, req: ServeRequest, now: float = 0.0) -> bool:
+        """Enqueue ``req``. Returns False when the bounded queue is full
+        and the request was shed instead (``state="shed"``, recorded in
+        ``finished`` so the caller sees exactly one terminal state per
+        request). Malformed requests still raise ValueError."""
         total = len(req.prompt) + req.max_new_tokens
         if total > self.engine.max_seq_len:
             raise ValueError(
@@ -138,45 +235,110 @@ class ServingEngine:
                 f"request {req.rid} needs more blocks than the whole pool")
         req.submitted_at = now
         req._work = np.asarray(req.prompt, np.int32)
+        if self.max_queue is not None and len(self.queue) >= self.max_queue:
+            # reject-newest: accepted work keeps its latency budget; the
+            # newcomer gets an immediate, explicit answer instead of an
+            # unbounded queue wait
+            req.state = "shed"
+            req.finished_at = now
+            self.finished.append(req)
+            self.stats["shed"] += 1
+            self._update_backpressure()
+            logger.warning(f"serving: shed request {req.rid} "
+                           f"(queue full at {self.max_queue})")
+            return False
         self.queue.append(req)
+        self._update_backpressure()
+        return True
 
     @property
     def busy(self) -> bool:
         return bool(self.queue) or any(s is not None for s in self.slots)
 
     def step(self, now: Optional[float] = None) -> int:
-        """One scheduler iteration: admit, prefill chunks, decode.
-        Returns the number of decoding slots this iteration (the
-        occupancy sample)."""
+        """One scheduler iteration: expire, admit, prefill chunks,
+        decode. Returns the number of decoding slots this iteration
+        (the occupancy sample). Raises :class:`DegradedError` when the
+        step watchdog trips (state stays consistent — every token
+        produced so far, including this step's, is recorded)."""
         if now is None:
             now = float(self.stats["steps"])
+        self._expire(now)
         self._admit()
         self._prefill_step(now)
         occ = self._decode_step(now)
         self.stats["steps"] += 1
         self.stats["occupancy_sum"] += occ
         self.stats["peak_occupancy"] = max(self.stats["peak_occupancy"], occ)
+        self._update_backpressure()
+        if self._watchdog_msg is not None:
+            msg, self._watchdog_msg = self._watchdog_msg, None
+            self._over_budget = 0
+            raise self._degraded(msg)
         return occ
 
     def run(self, requests=None, max_steps: int = 1_000_000,
             wall_clock: bool = False) -> Dict[Any, np.ndarray]:
         """Drain: submit ``requests`` (if given) and step until idle.
-        Returns {rid: prompt+generated} like stacked generate() rows."""
-        done: Dict[Any, np.ndarray] = {}
+        Returns {rid: prompt+generated} for every retired request (the
+        terminal state lives on the request object). Submissions are
+        stamped with the SAME clock the step loop uses, so
+        ``submitted_at``-based latency percentiles are meaningful under
+        ``wall_clock=True``. A non-drain raises :class:`DegradedError`
+        with everything finished so far attached instead of discarding
+        it."""
         for r in (requests or []):
-            self.submit(r)
+            self.submit(r, now=time.perf_counter() if wall_clock else 0.0)
         steps = 0
         while self.busy:
             self.step(time.perf_counter() if wall_clock else None)
             steps += 1
             if steps > max_steps:
-                raise RuntimeError(f"serving did not drain in {max_steps} "
-                                   f"steps (queue {len(self.queue)})")
-        for r in self.finished:
-            done[r.rid] = r.tokens
-        return done
+                raise self._degraded(
+                    f"serving did not drain in {max_steps} steps "
+                    f"(queue {len(self.queue)})")
+        return {r.rid: r.tokens for r in self.finished}
+
+    def pending_snapshot(self) -> List[Dict]:
+        """Host-side view of in-flight work (attached to
+        :class:`DegradedError`): one entry per slot/queue request."""
+        snap = []
+        for slot, r in enumerate(self.slots):
+            if r is not None:
+                snap.append({"rid": r.rid, "state": r.state, "slot": slot,
+                             "generated": len(r.out),
+                             "evictions": r.evictions})
+        for pos, r in enumerate(self.queue):
+            snap.append({"rid": r.rid, "state": r.state, "queue_pos": pos,
+                         "generated": len(r.out), "evictions": r.evictions})
+        return snap
 
     # -- phases ----------------------------------------------------------
+    def _expire(self, now: float) -> None:
+        """Retire every request whose deadline has passed — slot holders
+        free their blocks immediately (no zombie slot squatting), queued
+        requests never claim one."""
+        for slot, req in enumerate(self.slots):
+            if req is not None and req.deadline is not None \
+                    and now >= req.deadline:
+                logger.warning(
+                    f"serving: request {req.rid} passed its deadline "
+                    f"({req.deadline}) with {len(req.out)} of "
+                    f"{req.max_new_tokens} tokens; timing out")
+                self._finish(slot, req, now, state="timeout")
+        if not self.queue:
+            return
+        keep = deque()
+        for req in self.queue:
+            if req.deadline is not None and now >= req.deadline:
+                req.state = "timeout"
+                req.finished_at = now
+                self.finished.append(req)
+                self.stats["timeouts"] += 1
+            else:
+                keep.append(req)
+        self.queue = keep
+
     def _admit(self) -> None:
         # FIFO head-of-line: no queue jumping, so a preempted-and-
         # requeued request (appendleft) resumes before newer arrivals
@@ -196,8 +358,13 @@ class ServingEngine:
                       <= self.cache.free_blocks)
             if not ok:
                 break
+            try:
+                self.cache.allocate(slot, len(req._work))
+            except CacheExhausted:
+                # an injected (or racing) exhaustion at admission: the
+                # request stays at the queue head and retries next step
+                break
             self.queue.popleft()
-            self.cache.allocate(slot, len(req._work))
             self.slots[slot] = req
             self._progress[slot] = 0
             req.state = "prefill"
@@ -213,10 +380,10 @@ class ServingEngine:
             n = min(self.prefill_chunk, len(req._work) - done)
             chunk = np.zeros((self.prefill_chunk,), np.int32)
             chunk[:n] = req._work[done:done + n]
-            logits, self.cache.k, self.cache.v = \
-                self.engine.prefill_into_slot(
-                    self.cache.k, self.cache.v, self.cache.tables[slot],
-                    chunk, done, n)
+            logits, self.cache.k, self.cache.v = self._device_call(
+                "serving.prefill", self.engine.prefill_into_slot,
+                self.cache.k, self.cache.v, self.cache.tables[slot],
+                chunk, done, n)
             self.cache.advance(slot, n)
             self._progress[slot] = done + n
             self.stats["prefill_chunks"] += 1
@@ -225,7 +392,7 @@ class ServingEngine:
                 # token (== generate()'s prefill sample; on resume, the
                 # recomputed position is exactly the pre-eviction one)
                 self._emit(slot, req, logits, now)
-                if req.state != "done":
+                if req.state not in TERMINAL_STATES:
                     req.state = "decode"
 
     def _decode_step(self, now: float) -> int:
@@ -253,10 +420,23 @@ class ServingEngine:
                         slot, int(self.cache.lengths[slot]) + 1)
                     break
                 except CacheExhausted:
-                    if not self._evict_one(exclude=slot):
-                        # last resort: preempt this very request
+                    if self._evict_one(exclude=slot):
+                        continue
+                    # nobody else is evictable: preempt this very
+                    # request — unless the storm guard has pinned it,
+                    # in which case truncate rather than livelock
+                    if req.evictions < self.max_evictions:
                         self._preempt(slot)
-                        break
+                    else:
+                        self.stats["evict_capped"] += 1
+                        logger.warning(
+                            f"serving: request {req.rid} is eviction-"
+                            f"pinned ({req.evictions} preemptions) and "
+                            f"the pool cannot grow; finishing with "
+                            f"{len(req.out)} of {req.max_new_tokens} "
+                            f"tokens")
+                        self._finish(slot, req, now)
+                    break
         live = [i for i, r in enumerate(self.slots)
                 if r is not None and r.state == "decode"]
         if not live:
@@ -266,9 +446,28 @@ class ServingEngine:
         for i in live:
             tokens[i] = self.slots[i].out[-1]
             active[i] = True
-        logits, self.cache.k, self.cache.v = self.engine.decode_slots(
+        budget = self.step_time_budget_s
+        t0 = time.perf_counter() if budget is not None else 0.0
+        logits, self.cache.k, self.cache.v = self._device_call(
+            "serving.decode", self.engine.decode_slots,
             self.cache.k, self.cache.v, self.cache.tables,
-            self.cache.lengths, tokens, active, impl=self.decode_impl)
+            self.cache.lengths, tokens, active, self.decode_impl)
+        if budget is not None:
+            elapsed = time.perf_counter() - t0
+            if elapsed > budget:
+                self._over_budget += 1
+                self.stats["watchdog_trips"] += 1
+                if self._over_budget >= self.watchdog_grace:
+                    # this step's tokens are still emitted below: raise
+                    # AFTER bookkeeping (step() rethrows) so nothing is
+                    # lost or double-counted on resume
+                    self._watchdog_msg = (
+                        f"decode step over budget "
+                        f"({elapsed * 1e3:.1f}ms > "
+                        f"{budget * 1e3:.1f}ms) {self._over_budget} "
+                        f"consecutive times — degraded")
+            else:
+                self._over_budget = 0
         self.stats["decode_steps"] += 1
         for i in live:
             self.cache.advance(i, 1)
@@ -276,14 +475,58 @@ class ServingEngine:
         return len(live)
 
     # -- helpers ---------------------------------------------------------
-    def _finish(self, slot: int, req: ServeRequest, now: float) -> None:
+    def _device_call(self, site: str, fn, *args):
+        """Run a slot program with fault injection + transient-error
+        retry. Faults (and any real pre-dispatch failure) fire BEFORE
+        ``fn`` touches the donated pools, so a retry re-dispatches
+        against intact buffers; backoff doubles per attempt with
+        deterministic jitter from the injector's seeded rng."""
+        delay = self.retry_backoff_s
+        attempt = 0
+        while True:
+            try:
+                self.faults.fire(site)
+                return fn(*args)
+            except TransientDeviceError:
+                if attempt >= self.max_retries:
+                    raise
+                attempt += 1
+                self.stats["retries"] += 1
+                pause = min(delay + self.faults.jitter(delay * 0.5), 0.5)
+                logger.warning(
+                    f"serving: transient device error at {site} "
+                    f"(attempt {attempt}/{self.max_retries}); retrying "
+                    f"in {pause * 1e3:.1f}ms")
+                time.sleep(pause)
+                delay *= 2
+
+    def _update_backpressure(self) -> None:
+        if self.max_queue:
+            self.stats["backpressure"] = round(
+                len(self.queue) / self.max_queue, 4)
+        else:
+            self.stats["backpressure"] = 0.0
+
+    def _degraded(self, message: str) -> DegradedError:
+        return DegradedError(
+            message,
+            results={r.rid: r.tokens for r in self.finished},
+            finished=list(self.finished),
+            pending=self.pending_snapshot(),
+            stats=dict(self.stats))
+
+    def _finish(self, slot: int, req: ServeRequest, now: float,
+                state: str = "done") -> None:
         """Retire a request: blocks back to the pool, slot reopened."""
-        req.state = "done"
+        req.state = state
         req.finished_at = now
         self.cache.free(slot)
         self.slots[slot] = None
         self.finished.append(req)
-        self.stats["completed"] += 1
+        if state == "timeout":
+            self.stats["timeouts"] += 1
+        else:
+            self.stats["completed"] += 1
 
     def _emit(self, slot: int, req: ServeRequest, logits, now: float) -> None:
         self._rng, r = jax.random.split(self._rng)
@@ -299,14 +542,23 @@ class ServingEngine:
 
     def _evict_one(self, exclude: int) -> bool:
         """Preempt the most recently admitted live request (LIFO — the
-        oldest work is closest to done) other than ``exclude``."""
+        oldest work is closest to done) other than ``exclude``, skipping
+        requests at the eviction cap: a pinned request cannot be chosen
+        again, so the oldest victim of a storm is guaranteed forward
+        progress."""
         victim = None
+        capped = 0
         for i, r in enumerate(self.slots):
             if i == exclude or r is None:
+                continue
+            if r.evictions >= self.max_evictions:
+                capped += 1
                 continue
             if victim is None or r._admit_seq > self.slots[victim]._admit_seq:
                 victim = i
         if victim is None:
+            if capped:
+                self.stats["evict_capped"] += capped
             return False
         self._preempt(victim)
         return True
@@ -325,4 +577,3 @@ class ServingEngine:
         self.cache.free(slot)
         self.slots[slot] = None
         self.queue.appendleft(req)
-

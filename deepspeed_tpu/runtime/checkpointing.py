@@ -10,35 +10,175 @@ subsumes the reference's "elastic checkpoint" DP-degree resharding
 (stage_1_and_2.py:2002) and the offline zero_to_fp32.py consolidation
 script: ``load_fp32_state_dict_from_zero_checkpoint`` below restores full
 fp32 weights on host from the sharded files.
+
+Crash consistency (docs/ROBUSTNESS.md):
+
+- single-process saves STAGE the whole tag under ``<tag>.building`` and
+  commit it with one directory rename — a crash anywhere before the
+  commit leaves no visible tag, so readers never see a half-written
+  checkpoint; multi-process saves write in place (a cross-process
+  staged rename would need a barrier this layer doesn't own) and rely
+  on the pointer commit below;
+- the ``latest`` pointer is replaced atomically (tmp file + fsync +
+  ``os.replace`` + directory fsync) — the commit point: until it lands,
+  every loader still resolves the previous checkpoint;
+- every tag carries ``ds_manifest.json`` (per-file byte size + crc32);
+  :func:`validate_tag` rejects torn or bit-rotted tags, and
+  :func:`load_checkpoint` walks back from an invalid ``latest`` to the
+  newest valid tag (``strict=True`` raises instead);
+- the ``checkpoint.pre_commit`` / ``checkpoint.commit`` fault-injection
+  sites (utils/faults) simulate a crash just before / just after the
+  tag commit, which is how tests/test_checkpointing.py drives both
+  recovery paths.
 """
 
 import json
 import os
-from typing import Any, Dict, Optional
+import shutil
+import zlib
+from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from deepspeed_tpu.utils.faults import maybe_fire
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 LATEST_FILE = "latest"
 META_FILE = "ds_meta.json"
+MANIFEST_FILE = "ds_manifest.json"
 STATE_DIR = "state"
+_BUILD_SUFFIX = ".building"   # staged (uncommitted) tag dir
+_OLD_SUFFIX = ".old"          # displaced previous tag during overwrite
+
+
+class CheckpointError(RuntimeError):
+    """No loadable checkpoint (missing/corrupt tag with ``strict=True``)."""
 
 
 def _tag_dir(save_dir: str, tag: str) -> str:
-    return os.path.join(os.path.expanduser(save_dir), str(tag))
+    # abspath because orbax/tensorstore refuses relative checkpoint
+    # paths ("Checkpoint path should be absolute") and the error only
+    # surfaces from the async commit thread
+    return os.path.join(_root(save_dir), str(tag))
+
+
+def _root(save_dir: str) -> str:
+    return os.path.abspath(os.path.expanduser(save_dir))
+
+
+def _fsync_dir(path: str) -> None:
+    """Persist a directory entry (the rename itself) to disk."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return   # platform/filesystem without directory open support
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _atomic_write_text(path: str, text: str) -> None:
+    """Pointer-file replacement that is atomic AND durable: readers see
+    either the old or the new content, never a torn write, even across
+    a crash (tmp + fsync + rename + parent fsync)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path) or ".")
+
+
+def _file_crc32(path: str) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    return crc & 0xFFFFFFFF
+
+
+def _write_manifest(tag_path: str, tag: str) -> None:
+    """Record every payload file's size + crc32 so a partial write or
+    bit rot is detectable at load time (validate_tag)."""
+    files: Dict[str, Dict[str, int]] = {}
+    for root, _dirs, names in os.walk(tag_path):
+        for name in names:
+            fp = os.path.join(root, name)
+            rel = os.path.relpath(fp, tag_path)
+            if rel == MANIFEST_FILE:
+                continue
+            files[rel] = {"bytes": os.path.getsize(fp),
+                          "crc32": _file_crc32(fp)}
+    _atomic_write_text(os.path.join(tag_path, MANIFEST_FILE),
+                       json.dumps({"tag": tag, "files": files}, indent=1,
+                                  sort_keys=True))
+
+
+def validate_tag(load_dir: str, tag: str) -> bool:
+    """True when the tag directory exists and every manifest-listed file
+    matches its recorded size and crc32. Pre-manifest (legacy) tags
+    validate on the presence of the state dir."""
+    path = _tag_dir(load_dir, str(tag))
+    if not os.path.isdir(path):
+        return False
+    man = os.path.join(path, MANIFEST_FILE)
+    if not os.path.isfile(man):
+        return os.path.isdir(os.path.join(path, STATE_DIR))
+    try:
+        with open(man) as f:
+            entries = json.load(f)["files"]
+    except (OSError, ValueError, KeyError):
+        return False
+    for rel, info in entries.items():
+        fp = os.path.join(path, rel)
+        if not os.path.isfile(fp):
+            return False
+        if os.path.getsize(fp) != info.get("bytes"):
+            return False
+        if _file_crc32(fp) != info.get("crc32"):
+            return False
+    return True
+
+
+def list_tags(load_dir: str) -> List[str]:
+    """Candidate tag directories under ``load_dir``, newest first
+    (directory mtime). Staged ``.building`` and displaced ``.old`` dirs
+    are never candidates."""
+    root = _root(load_dir)
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for name in os.listdir(root):
+        p = os.path.join(root, name)
+        if not os.path.isdir(p) or name.startswith(".") \
+                or name.endswith(_BUILD_SUFFIX) or name.endswith(_OLD_SUFFIX):
+            continue
+        out.append((os.path.getmtime(p), name))
+    return [name for _mt, name in sorted(out, reverse=True)]
 
 
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                     client_state: Optional[Dict] = None,
                     save_latest: bool = True) -> bool:
-    """Write the engine state (params, optimizer, loss-scale, counters)."""
+    """Write the engine state (params, optimizer, loss-scale, counters).
+
+    Single-process saves are crash-atomic: the tag is staged under
+    ``<tag>.building`` and committed with one rename; ``latest`` is
+    replaced atomically afterwards. A crash at ANY point leaves the
+    previous checkpoint fully loadable."""
     if tag is None:
         tag = f"global_step{engine.global_steps}"
     tag = str(tag)
-    path = _tag_dir(save_dir, tag)
+    save_root = _root(save_dir)
+    final_path = _tag_dir(save_dir, tag)
+    staged = jax.process_count() == 1
+    path = final_path + _BUILD_SUFFIX if staged else final_path
+    if staged and os.path.exists(path):
+        shutil.rmtree(path)   # leftover from a previous crashed save
     os.makedirs(path, exist_ok=True)
 
     state = engine.state
@@ -96,17 +236,40 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         "client_state": client_state or {},
     }
     if jax.process_index() == 0:
-        with open(os.path.join(path, META_FILE), "w") as f:
-            json.dump(meta, f, indent=2, default=str)
-        if save_latest:
-            with open(os.path.join(os.path.expanduser(save_dir), LATEST_FILE), "w") as f:
-                f.write(tag)
-    log_dist(f"saved checkpoint {tag} to {path}", ranks=[0])
+        _atomic_write_text(os.path.join(path, META_FILE),
+                           json.dumps(meta, indent=2, default=str))
+        # manifest LAST: it attests every payload file above (in a
+        # multi-process save it covers the files visible to process 0)
+        _write_manifest(path, tag)
+    # crash here (pre-commit): the staged dir is invisible to loaders
+    maybe_fire("checkpoint.pre_commit")
+    if staged:
+        displaced = None
+        if os.path.exists(final_path):
+            # a dir rename cannot atomically replace a non-empty dst:
+            # displace the old tag aside first (an interrupted save
+            # leaves either old-aside+new or old-in-place — both are
+            # valid states for validate_tag/walk-back)
+            displaced = final_path + _OLD_SUFFIX
+            if os.path.exists(displaced):
+                shutil.rmtree(displaced)
+            os.rename(final_path, displaced)
+        os.rename(path, final_path)
+        _fsync_dir(save_root)
+        if displaced is not None:
+            shutil.rmtree(displaced)
+    # crash here (post-commit): the tag is durable but `latest` still
+    # points at the previous one — exactly the walk-forwardable state
+    # the crash-recovery test pins
+    maybe_fire("checkpoint.commit")
+    if jax.process_index() == 0 and save_latest:
+        _atomic_write_text(os.path.join(save_root, LATEST_FILE), tag)
+    log_dist(f"saved checkpoint {tag} to {final_path}", ranks=[0])
     return True
 
 
 def get_latest_tag(load_dir: str) -> Optional[str]:
-    latest_path = os.path.join(os.path.expanduser(load_dir), LATEST_FILE)
+    latest_path = os.path.join(_root(load_dir), LATEST_FILE)
     if os.path.isfile(latest_path):
         with open(latest_path) as f:
             return f.read().strip()
@@ -114,20 +277,50 @@ def get_latest_tag(load_dir: str) -> Optional[str]:
 
 
 def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
-                    load_optimizer_states: bool = True):
+                    load_optimizer_states: bool = True,
+                    strict: bool = False):
     """Restore engine state; resharding to the current mesh is automatic
-    (elastic checkpoint — any dp/tp degree can load any other's save)."""
+    (elastic checkpoint — any dp/tp degree can load any other's save).
+
+    Corruption handling: every tag is manifest-validated before restore.
+    When the implicit ``latest`` tag is missing or fails validation
+    (torn write, crash mid-save, bit rot), the loader WALKS BACK to the
+    newest valid tag in ``load_dir``. An explicitly requested ``tag``
+    is never silently substituted. ``strict=True`` raises
+    :class:`CheckpointError` instead of warn-and-return-``(None, {})``."""
+    requested = tag
     if tag is None:
         tag = get_latest_tag(load_dir)
         if tag is None:
-            logger.warning(
-                f"Unable to find latest file at {load_dir}/{LATEST_FILE}, "
-                "if trying to load latest checkpoint please pass a valid tag")
+            msg = (f"Unable to find latest file at {load_dir}/{LATEST_FILE},"
+                   " if trying to load latest checkpoint please pass a valid"
+                   " tag")
+            if strict:
+                raise CheckpointError(msg)
+            logger.warning(msg)
             return None, {}
+    if not validate_tag(load_dir, tag):
+        if requested is not None:
+            msg = (f"checkpoint {tag} at {load_dir} is missing or fails "
+                   f"manifest validation")
+            if strict:
+                raise CheckpointError(msg)
+            logger.warning(msg)
+            return None, {}
+        fallback = next((t for t in list_tags(load_dir)
+                         if t != tag and validate_tag(load_dir, t)), None)
+        if fallback is None:
+            msg = (f"latest checkpoint {tag} at {load_dir} is invalid and "
+                   f"no valid tag remains")
+            if strict:
+                raise CheckpointError(msg)
+            logger.warning(msg)
+            return None, {}
+        logger.warning(
+            f"latest checkpoint {tag} at {load_dir} is missing or corrupt; "
+            f"walking back to newest valid tag {fallback}")
+        tag = fallback
     path = _tag_dir(load_dir, tag)
-    if not os.path.isdir(path):
-        logger.warning(f"checkpoint dir {path} does not exist")
-        return None, {}
 
     state = engine.state
     sh = engine._state_shardings

@@ -1311,7 +1311,8 @@ class DeepSpeedEngine:
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_optimizer_states: bool = True,
-                        load_lr_scheduler_states: bool = True):
+                        load_lr_scheduler_states: bool = True,
+                        strict: bool = False):
         # join-and-DISCARD any in-flight DPU update: the worker must not
         # mutate host masters during restore, and its pre-load result
         # must never overwrite the restored weights
@@ -1320,7 +1321,8 @@ class DeepSpeedEngine:
             self._dpu_pending = None
         from deepspeed_tpu.runtime.checkpointing import load_checkpoint
         return load_checkpoint(self, load_dir, tag=tag,
-                               load_optimizer_states=load_optimizer_states)
+                               load_optimizer_states=load_optimizer_states,
+                               strict=strict)
 
     def consolidated_16bit_state_dict(self):
         """Gather full (unsharded) compute-dtype params on host

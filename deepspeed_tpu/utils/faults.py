@@ -1,0 +1,237 @@
+"""Deterministic fault injection — the chaos substrate for the serving
+and checkpoint robustness layers.
+
+Production serving dies in ways unit tests never exercise: a cache-
+exhaustion storm mid-decode, a device that throws once and recovers, a
+decode step that silently takes 100x its budget, a host that crashes
+between writing checkpoint state and updating the ``latest`` pointer.
+This module makes every one of those failure modes a *scheduled,
+reproducible event*: a :class:`FaultInjector` carries an ordered set of
+:class:`Fault` specs, each bound to a named **site** (a point in the
+code that calls :func:`FaultInjector.fire`) and a **visit index** at
+which it triggers. Same spec + same seed → the identical failure
+sequence, so chaos tests assert exact outcomes (token parity, which tag
+``load_checkpoint`` lands on) instead of "it didn't crash".
+
+Sites currently instrumented:
+
+====================== =====================================================
+``serving.decode``     before each batched decode-slots dispatch
+``serving.prefill``    before each prefill-chunk dispatch
+``cache.ensure``       inside ``PagedKVCache.ensure_capacity`` (growth)
+``cache.allocate``     inside ``PagedKVCache.allocate`` (admission)
+``engine.decode``      ``InferenceEngine.decode_slots`` public wrapper
+``checkpoint.pre_commit``  after state write, BEFORE the tag dir commit
+``checkpoint.commit``  after the tag dir commit, BEFORE ``latest`` update
+====================== =====================================================
+
+Fault kinds and what firing does:
+
+- ``device_error`` — raises :class:`TransientDeviceError` (the serving
+  engine retries with exponential backoff + deterministic jitter);
+- ``crash`` — raises :class:`InjectedCrash` (simulated process death:
+  the exception unwinds past the save path exactly where ``kill -9``
+  would cut it);
+- ``slow`` — sleeps ``param`` seconds inside the caller's timed region
+  (drives the step watchdog); a hung step is a ``slow`` fault whose
+  param exceeds the step budget;
+- ``cache_exhausted`` — returned to the site, which raises its own
+  domain exception (:class:`~deepspeed_tpu.inference.paged_cache.
+  CacheExhausted`) so the scheduler's eviction path runs for real.
+
+The ambient injector is either :func:`install`-ed programmatically
+(tests use the :func:`injected` context manager) or parsed once from
+``DS_FAULTS`` / ``DS_FAULT_SEED``::
+
+    DS_FAULTS="serving.decode:device_error@3;checkpoint.commit:crash@0"
+    DS_FAULT_SEED=0
+
+Entry grammar: ``site:kind@step[*count][~param]`` joined by ``;`` —
+fire ``kind`` at ``site`` on visits ``[step, step+count)`` with float
+``param`` (sleep seconds for ``slow``).
+"""
+
+import os
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class FaultError(Exception):
+    """Base class for every injected failure."""
+
+
+class TransientDeviceError(FaultError):
+    """A device dispatch failed in a retryable way (injected analog of a
+    one-off XLA/runtime error; the serving engine's backoff handles it)."""
+
+
+class InjectedCrash(FaultError):
+    """Simulated process death: raised where the process would die, so
+    everything after the site (e.g. the ``latest`` pointer update) never
+    happens — the crash-consistency scenario checkpoint tests drive."""
+
+
+@dataclass(frozen=True)
+class Fault:
+    """One scheduled failure: fire ``kind`` at ``site`` on visit
+    indices ``[step, step + count)``. ``param`` is kind-specific
+    (sleep seconds for ``slow``)."""
+    site: str
+    kind: str
+    step: int = 0
+    count: int = 1
+    param: float = 0.0
+
+    def matches(self, visit: int) -> bool:
+        return self.step <= visit < self.step + self.count
+
+
+KINDS = ("device_error", "crash", "slow", "cache_exhausted")
+
+
+def parse_spec(spec: str) -> List[Fault]:
+    """Parse the ``DS_FAULTS`` grammar (see module docstring)."""
+    faults: List[Fault] = []
+    for entry in spec.replace(",", ";").split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        try:
+            site, rest = entry.split(":", 1)
+            kind, rest = rest.split("@", 1)
+            param = 0.0
+            count = 1
+            if "~" in rest:
+                rest, p = rest.split("~", 1)
+                param = float(p)
+            if "*" in rest:
+                rest, c = rest.split("*", 1)
+                count = int(c)
+            step = int(rest)
+        except ValueError as e:
+            raise ValueError(
+                f"bad fault spec entry {entry!r} (want "
+                f"site:kind@step[*count][~param]): {e}") from e
+        if kind not in KINDS:
+            raise ValueError(f"unknown fault kind {kind!r} in {entry!r} "
+                             f"(known: {', '.join(KINDS)})")
+        faults.append(Fault(site=site.strip(), kind=kind.strip(),
+                            step=step, count=count, param=param))
+    return faults
+
+
+class FaultInjector:
+    """Deterministic, seedable fault scheduler.
+
+    ``visit(site)`` increments the site's visit counter and returns the
+    matching :class:`Fault` (or None); ``fire(site)`` additionally acts
+    on the generic kinds (raise / sleep) and returns domain-specific
+    kinds (``cache_exhausted``) for the site to interpret. ``fired``
+    logs every triggered fault as ``(site, kind, visit)`` so tests can
+    assert the chaos actually happened.
+
+    ``rng`` is a seeded generator shared with the serving engine's
+    retry jitter: one seed pins the whole failure-and-recovery timeline.
+    """
+
+    def __init__(self, faults: Sequence[Fault] = (), seed: int = 0):
+        self.faults: List[Fault] = list(faults)
+        self.seed = int(seed)
+        self.rng = np.random.default_rng(self.seed)
+        self.visits: Dict[str, int] = {}
+        self.fired: List[Tuple[str, str, int]] = []
+
+    @classmethod
+    def from_env(cls, env=None) -> "FaultInjector":
+        # ambient chaos config; tests pin it via install()/injected()
+        env = os.environ if env is None else env  # dslint: disable=DS005 — fault spec is deliberately ambient (chaos knob), parsed once and overridable via install()
+        spec = env.get("DS_FAULTS", "")
+        seed = int(env.get("DS_FAULT_SEED", "0") or "0")
+        return cls(parse_spec(spec), seed=seed)
+
+    # -- scheduling ----------------------------------------------------
+    def visit(self, site: str) -> Optional[Fault]:
+        n = self.visits.get(site, 0)
+        self.visits[site] = n + 1
+        if not self.faults:
+            return None
+        for f in self.faults:
+            if f.site == site and f.matches(n):
+                self.fired.append((site, f.kind, n))
+                return f
+        return None
+
+    def fire(self, site: str) -> Optional[Fault]:
+        """Visit ``site`` and act on the matched fault: raise the
+        generic kinds, sleep for ``slow``, return the rest."""
+        f = self.visit(site)
+        if f is None:
+            return None
+        n = self.visits[site] - 1
+        if f.kind == "device_error":
+            raise TransientDeviceError(
+                f"injected device error at {site} (visit {n})")
+        if f.kind == "crash":
+            raise InjectedCrash(f"injected crash at {site} (visit {n})")
+        if f.kind == "slow":
+            time.sleep(f.param)
+        return f
+
+    def jitter(self, scale: float) -> float:
+        """Deterministic backoff jitter in ``[0, scale)``."""
+        return float(self.rng.uniform(0.0, scale))
+
+    def reset(self) -> None:
+        """Rewind visit counters and the rng — same timeline replays."""
+        self.visits.clear()
+        self.fired.clear()
+        self.rng = np.random.default_rng(self.seed)
+
+
+# -- ambient injector --------------------------------------------------
+_active: Optional[FaultInjector] = None
+
+
+def active() -> FaultInjector:
+    """The ambient injector: installed one, else env-derived (parsed
+    once; an empty ``DS_FAULTS`` yields a no-op injector)."""
+    global _active
+    if _active is None:
+        _active = FaultInjector.from_env()
+    return _active
+
+
+def install(injector: Optional[FaultInjector]) -> Optional[FaultInjector]:
+    """Install ``injector`` as the ambient one (None re-derives from the
+    env on next use). Returns the previous injector for restore."""
+    global _active
+    prev = _active
+    _active = injector
+    return prev
+
+
+def maybe_fire(site: str) -> Optional[Fault]:
+    """Module-level site hook: fire against the ambient injector. The
+    no-fault fast path is one dict get + compare."""
+    return active().fire(site)
+
+
+@contextmanager
+def injected(*faults: Fault, seed: int = 0):
+    """Install a fresh injector for the block (tests)::
+
+        with faults.injected(Fault("serving.decode", "device_error",
+                                   step=3)) as inj:
+            srv.run(reqs)
+        assert inj.fired
+    """
+    inj = FaultInjector(faults, seed=seed)
+    prev = install(inj)
+    try:
+        yield inj
+    finally:
+        install(prev)

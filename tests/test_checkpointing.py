@@ -1,13 +1,21 @@
-"""Checkpoint save/resume tests: loss continuity and elastic reload
-(ref: tests/unit/test_checkpointing.py — save/load across zero stages,
-optimizers, schedulers; loss continuity across resume)."""
+"""Checkpoint save/resume tests: loss continuity, elastic reload, and
+crash consistency (ref: tests/unit/test_checkpointing.py — save/load
+across zero stages, optimizers, schedulers; loss continuity across
+resume). The crash tests drive the ``checkpoint.pre_commit`` /
+``checkpoint.commit`` fault-injection sites: a save killed at either
+point must leave the directory in a state every loader survives."""
+
+import os
 
 import numpy as np
 import pytest
 
 import deepspeed_tpu
 from deepspeed_tpu.runtime.checkpointing import (
-    get_latest_tag, load_fp32_state_dict_from_zero_checkpoint)
+    CheckpointError, get_latest_tag, list_tags,
+    load_fp32_state_dict_from_zero_checkpoint, validate_tag)
+from deepspeed_tpu.utils import faults as faults_lib
+from deepspeed_tpu.utils.faults import Fault, InjectedCrash
 from tests.simple_model import random_batch, simple_model_loss, simple_model_params
 
 HIDDEN = 32
@@ -267,6 +275,91 @@ def test_memory_efficient_bf16_elastic_topology_change(tmp_path, devices):
     # but fsdp=2 vs 8 changes reduction order at bf16 precision — allow
     # bf16-level slack, not drift
     np.testing.assert_allclose(ref, got, rtol=2e-2, atol=2e-2)
+
+
+# ---------------------------------------------------------------------------
+# crash consistency: injected crashes at the commit boundaries
+# ---------------------------------------------------------------------------
+
+def test_crash_between_commit_and_latest_lands_on_previous_tag(
+        tmp_path, devices):
+    """A crash AFTER the tag dir commits but BEFORE ``latest`` updates
+    (the classic torn-pointer window): the new tag is durable on disk,
+    but the pointer still names the previous checkpoint — a plain
+    reload lands there, with the state it had at save time."""
+    engine = _make_engine(dict(BASE))
+    engine.train_batch(random_batch(16, HIDDEN, seed=0))
+    engine.save_checkpoint(str(tmp_path), tag="t1")
+    engine.train_batch(random_batch(16, HIDDEN, seed=1))
+    with faults_lib.injected(Fault("checkpoint.commit", "crash")):
+        with pytest.raises(InjectedCrash):
+            engine.save_checkpoint(str(tmp_path), tag="t2")
+    # t2 is fully committed and valid — only the pointer never moved
+    assert validate_tag(str(tmp_path), "t2")
+    assert get_latest_tag(str(tmp_path)) == "t1"
+    engine2 = _make_engine(dict(BASE), seed=5)
+    path, _ = engine2.load_checkpoint(str(tmp_path))
+    assert path is not None and path.endswith("t1")
+    assert engine2.global_steps == 1
+
+
+def test_crash_pre_commit_leaves_no_visible_tag(tmp_path, devices):
+    """A crash after the state write but BEFORE the tag dir commit: the
+    half-written checkpoint exists only as ``<tag>.building`` — never a
+    loadable tag, never a walk-back candidate — and a retried save
+    succeeds over the leftover."""
+    engine = _make_engine(dict(BASE))
+    engine.train_batch(random_batch(16, HIDDEN, seed=0))
+    engine.save_checkpoint(str(tmp_path), tag="t1")
+    with faults_lib.injected(Fault("checkpoint.pre_commit", "crash")):
+        with pytest.raises(InjectedCrash):
+            engine.save_checkpoint(str(tmp_path), tag="t2")
+    assert not os.path.isdir(tmp_path / "t2")
+    assert os.path.isdir(tmp_path / "t2.building")   # staged leftover
+    assert list_tags(str(tmp_path)) == ["t1"]
+    assert get_latest_tag(str(tmp_path)) == "t1"
+    # the retry cleans the leftover and commits normally
+    engine.save_checkpoint(str(tmp_path), tag="t2")
+    assert get_latest_tag(str(tmp_path)) == "t2"
+    assert validate_tag(str(tmp_path), "t2")
+    assert not os.path.isdir(tmp_path / "t2.building")
+
+
+def test_corrupt_latest_tag_walks_back_to_valid(tmp_path, devices):
+    """Bit rot / torn write in the newest tag: the manifest check
+    rejects it and an implicit (latest) load walks back to the newest
+    valid tag; an EXPLICIT request for the corrupt tag is never
+    silently substituted, and ``strict=True`` raises."""
+    engine = _make_engine(dict(BASE))
+    engine.train_batch(random_batch(16, HIDDEN, seed=0))
+    engine.save_checkpoint(str(tmp_path), tag="good")
+    engine.train_batch(random_batch(16, HIDDEN, seed=1))
+    engine.save_checkpoint(str(tmp_path), tag="bad")
+    assert get_latest_tag(str(tmp_path)) == "bad"
+    # corrupt one manifest-listed payload file in the newest tag
+    with open(tmp_path / "bad" / "ds_meta.json", "a") as f:
+        f.write(" ")
+    assert not validate_tag(str(tmp_path), "bad")
+
+    engine2 = _make_engine(dict(BASE), seed=7)
+    path, _ = engine2.load_checkpoint(str(tmp_path))     # implicit latest
+    assert path is not None and path.endswith("good")
+    assert engine2.global_steps == 1
+    # explicit tag: warn + (None, {}), or CheckpointError under strict
+    engine3 = _make_engine(dict(BASE), seed=9)
+    path, client = engine3.load_checkpoint(str(tmp_path), tag="bad")
+    assert path is None and client == {}
+    with pytest.raises(CheckpointError, match="manifest"):
+        engine3.load_checkpoint(str(tmp_path), tag="bad", strict=True)
+
+
+def test_strict_load_raises_on_empty_dir(tmp_path, devices):
+    engine = _make_engine(dict(BASE))
+    with pytest.raises(CheckpointError, match="latest"):
+        engine.load_checkpoint(str(tmp_path), strict=True)
+    # non-strict keeps the historical warn-and-None contract
+    path, client = engine.load_checkpoint(str(tmp_path))
+    assert path is None and client == {}
 
 
 def test_fp16_scaler_elastic_topology_change(tmp_path, devices):

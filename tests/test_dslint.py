@@ -2,7 +2,7 @@
 deepspeed_tpu/utils/compile_guard.py).
 
 Three layers:
-  1. per-rule fixtures — for every rule DS001–DS008 one true-positive
+  1. per-rule fixtures — for every rule DS001–DS009 one true-positive
      snippet that MUST flag and one clean snippet that MUST NOT (the
      clean twin pins the rule's precision, not just its recall);
   2. machinery — inline suppressions, file-level waivers, the baseline
@@ -255,6 +255,49 @@ def test_ds008_import_scope_device_work():
     assert "DS008" not in rules_of(good)
 
 
+def test_ds009_non_atomic_pointer_write():
+    bad = (
+        "import os\n"
+        "def point_latest(root, tag):\n"
+        "    with open(os.path.join(root, 'latest'), 'w') as f:\n"
+        "        f.write(tag)\n")
+    assert "DS009" in rules_of(
+        bad, path="deepspeed_tpu/runtime/checkpointing.py")
+    # the sanctioned shape: stage to a tmp path, then os.replace commits
+    good = (
+        "import os\n"
+        "def point_latest(root, tag):\n"
+        "    tmp = os.path.join(root, 'latest.tmp')\n"
+        "    with open(tmp, 'w') as f:\n"
+        "        f.write(tag)\n"
+        "    os.replace(tmp, os.path.join(root, 'latest'))\n")
+    assert "DS009" not in rules_of(
+        good, path="deepspeed_tpu/runtime/checkpointing.py")
+
+
+def test_ds009_scoped_to_checkpoint_paths_and_pointer_files():
+    # same in-place write OUTSIDE a checkpoint path: not this rule's beat
+    src = (
+        "def point_latest(root, tag):\n"
+        "    with open(root + '/latest', 'w') as f:\n"
+        "        f.write(tag)\n")
+    assert "DS009" not in rules_of(src, path="deepspeed_tpu/runtime/zero.py")
+    # payload files (non-pointer names) are the manifest's job, not DS009's
+    payload = (
+        "def dump(root, blob):\n"
+        "    with open(root + '/weights.bin', 'wb') as f:\n"
+        "        f.write(blob)\n")
+    assert "DS009" not in rules_of(
+        payload, path="deepspeed_tpu/runtime/checkpointing.py")
+    # read-mode opens of the pointer are fine
+    read = (
+        "def resolve(root):\n"
+        "    with open(root + '/latest') as f:\n"
+        "        return f.read().strip()\n")
+    assert "DS009" not in rules_of(
+        read, path="deepspeed_tpu/runtime/checkpointing.py")
+
+
 def test_ds000_syntax_error_is_a_finding_not_a_crash():
     findings = analyze_source("def f(:\n", path="m.py")
     assert [f.rule for f in findings] == ["DS000"]
@@ -360,8 +403,8 @@ def test_every_rule_has_id_and_rationale():
     cat = rule_catalog()
     ids = [r["id"] for r in cat]
     assert ids == sorted(ids) and len(set(ids)) == len(ids)
-    assert {"DS001", "DS002", "DS003", "DS004",
-            "DS005", "DS006", "DS007", "DS008"} <= set(ids)
+    assert {"DS001", "DS002", "DS003", "DS004", "DS005",
+            "DS006", "DS007", "DS008", "DS009"} <= set(ids)
     assert all(r["rationale"] for r in cat)
     assert len(default_rules()) == len(cat)
 

@@ -303,3 +303,44 @@ def test_serving_rejects_oversized_request(devices):
     with pytest.raises(ValueError, match="max_seq_len"):
         srv.submit(ServeRequest(rid=0, prompt=np.ones(60, np.int32),
                                 max_new_tokens=30))
+
+
+def test_serving_wall_clock_latency_stamps_share_one_clock(devices):
+    """run(wall_clock=True) stamps submission with the SAME clock as
+    token emission — submitted_at <= first_token_at <= finished_at, all
+    positive perf_counter instants, so latency percentiles derived from
+    the stamps are meaningful (the skew bug: submit stamped 0.0 while
+    tokens got perf_counter values, making TTFT equal absolute time)."""
+    cfg, params = tiny()
+    eng = InferenceEngine(config=cfg, params=params, dtype=jnp.float32)
+    srv = ServingEngine(eng, num_slots=2, block_size=4, num_blocks=24)
+    prompts = prompts_of((5, 7), seed=21)
+    srv.run([ServeRequest(rid=i, prompt=p, max_new_tokens=4)
+             for i, p in enumerate(prompts)], wall_clock=True)
+    for r in srv.finished:
+        assert r.submitted_at > 0.0              # not the 0.0 sentinel
+        assert r.submitted_at <= r.first_token_at <= r.finished_at
+        # a sane TTFT: well under a minute, not "seconds since boot"
+        assert r.first_token_at - r.submitted_at < 60.0
+        assert all(t >= r.submitted_at for t in r.token_times)
+
+
+def test_serving_non_drain_raises_degraded_with_partial_results(devices):
+    """run() hitting max_steps attaches everything finished so far plus
+    an in-flight snapshot instead of discarding it."""
+    from deepspeed_tpu.inference.serving import DegradedError
+    cfg, params = tiny()
+    eng = InferenceEngine(config=cfg, params=params, dtype=jnp.float32)
+    p1, p2 = prompts_of((5, 6), seed=17)
+    ref2 = _solo_refs(eng, [p2], 2)[0]
+    srv = ServingEngine(eng, num_slots=2, block_size=4, num_blocks=24)
+    with pytest.raises(DegradedError, match="did not drain") as ei:
+        srv.run([ServeRequest(rid="slowpoke", prompt=p1,
+                              max_new_tokens=30),
+                 ServeRequest(rid="quick", prompt=p2, max_new_tokens=2)],
+                max_steps=5)
+    e = ei.value
+    np.testing.assert_array_equal(e.results["quick"], ref2)
+    assert [p["rid"] for p in e.pending] == ["slowpoke"]
+    assert e.pending[0]["generated"] > 0         # its work is visible
+    assert e.stats["steps"] == 6                 # ran to the cap, then raised

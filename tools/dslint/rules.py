@@ -1,4 +1,4 @@
-"""dslint rules: the JAX/TPU-specific checks (DS001–DS008).
+"""dslint rules: the JAX/TPU-specific checks (DS001–DS009).
 
 Each rule encodes an invariant the runtime actually depends on (see
 docs/LINT.md for rationale and before/after examples):
@@ -13,6 +13,8 @@ DS005  os.environ read outside the config/constants layer or at import
 DS006  bare except / except Exception that silently passes
 DS007  mutable default argument
 DS008  jnp./device work executed at module import scope
+DS009  pointer/marker file in a checkpoint path replaced with a plain
+       in-place write instead of tmp + fsync + os.replace
 
 All heuristics are deliberately lexical (pure ``ast``): they can't see
 through aliases or cross-module calls, so each rule favors precision on
@@ -745,11 +747,92 @@ class ImportScopeDeviceWork(Rule):
 
 
 # --------------------------------------------------------------------------
+class NonAtomicPointerWrite(Rule):
+    id = "DS009"
+    name = "non-atomic-pointer-write"
+    autofixable = False
+    rationale = ("replacing a pointer/marker file (`latest`-style) with a "
+                 "plain open(..., 'w').write is not atomic — a crash "
+                 "mid-write leaves a torn pointer every loader resolves as "
+                 "garbage; write a tmp file, fsync, then os.replace "
+                 "(runtime/checkpointing._atomic_write_text is the clean "
+                 "shape)")
+
+    # pointer-ish identifiers/literals: the files whose torn state takes
+    # the whole checkpoint dir down (vs payload files, which the
+    # manifest validation catches)
+    _POINTER = re.compile(r"latest|pointer|marker", re.IGNORECASE)
+    _TEMP = re.compile(r"te?mp", re.IGNORECASE)
+    # the rule only applies to checkpoint-layer files: that's where a
+    # torn pointer is load-bearing, and where the repo has actually
+    # shipped the bug (pre-robustness save_checkpoint)
+    _PATHS = re.compile(r"checkpoint|ckpt", re.IGNORECASE)
+    _ATOMIC = (["os", "replace"], ["os", "rename"])
+
+    def check(self, tree, lines, path):
+        if not self._PATHS.search(path.replace("\\", "/")):
+            return []
+        out = []
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and _dotted(node.func) == ["open"] and node.args):
+                continue
+            if not self._write_mode(node):
+                continue
+            target = node.args[0]
+            if not self._mentions(target, self._POINTER) \
+                    or self._mentions(target, self._TEMP):
+                continue
+            scope = _enclosing(node, FUNC_TYPES) or tree
+            if self._has_atomic_replace(scope):
+                continue
+            out.append(self._f(
+                path, node,
+                "pointer/marker file written in place — a crash mid-write "
+                "tears it for every future load; write to a tmp path and "
+                "os.replace() into place (+ fsync)"))
+        return out
+
+    @staticmethod
+    def _write_mode(call: ast.Call) -> bool:
+        mode = None
+        if len(call.args) > 1:
+            mode = call.args[1]
+        for kw in call.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+        return (isinstance(mode, ast.Constant)
+                and isinstance(mode.value, str)
+                and ("w" in mode.value or "a" in mode.value))
+
+    @staticmethod
+    def _mentions(target: ast.AST, pat) -> bool:
+        for n in ast.walk(target):
+            if isinstance(n, ast.Constant) and isinstance(n.value, str) \
+                    and pat.search(n.value):
+                return True
+            if isinstance(n, ast.Name) and pat.search(n.id):
+                return True
+            if isinstance(n, ast.Attribute) and pat.search(n.attr):
+                return True
+        return False
+
+    def _has_atomic_replace(self, scope: ast.AST) -> bool:
+        for n in ast.walk(scope):
+            if isinstance(n, FUNC_TYPES) and n is not scope:
+                continue   # walk still descends; acceptable over-approx
+            if isinstance(n, ast.Call) and _dotted(n.func) in self._ATOMIC:
+                return True
+        return False
+
+
+# --------------------------------------------------------------------------
 
 def default_rules() -> List[Rule]:
     return [BlockingHostSync(), JitCacheFragmentation(), DonationHazard(),
             TracedPythonBranch(), EnvReadOutsideConfig(), OverbroadExcept(),
-            MutableDefaultArg(), ImportScopeDeviceWork()]
+            MutableDefaultArg(), ImportScopeDeviceWork(),
+            NonAtomicPointerWrite()]
 
 
 def rule_catalog() -> List[Dict[str, str]]:

@@ -12,6 +12,9 @@
 #   tools/gate.sh quick      # changed-path heuristic: changed test files
 #                            # + test files matching changed modules +
 #                            # the always-on smoke set (~minutes)
+#   tools/gate.sh chaos      # fault-injection smoke: the chaos suite +
+#                            # checkpoint crash recovery under a FIXED
+#                            # seed (docs/ROBUSTNESS.md)
 #
 # NOTE: the gate tests the WORKING TREE. The pre-commit hook refuses
 # partially-staged commits on gate-relevant paths (a green working tree
@@ -19,7 +22,14 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-if [[ "${1:-}" == "quick" ]]; then
+if [[ "${1:-}" == "chaos" ]]; then
+    # deterministic chaos smoke: every injected failure path (transient
+    # device errors, cache exhaustion, slow steps, crash-mid-checkpoint)
+    # under a pinned seed, so a red run is reproducible bit-for-bit
+    echo "gate(chaos): fault-injection smoke (DS_FAULT_SEED=0)"
+    DS_FAULT_SEED=0 python -m pytest tests/test_chaos.py \
+        tests/test_checkpointing.py -q
+elif [[ "${1:-}" == "quick" ]]; then
     # lint only the .py files this change touches (full-tree scan is the
     # full gate's job); baseline + inline suppressions apply as usual
     lint_changed=$(git diff --name-only --diff-filter=d HEAD -- \

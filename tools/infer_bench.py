@@ -284,6 +284,12 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
             cfg, num_slots, mean_len, cache.tokens_per_slot,
             dtype=cache.dtype, impl=srv.decode_impl),
         "completed": st["completed"],
+        # robustness counters: zero in a clean run, nonzero under
+        # deadlines/bounded queues/chaos (DS_FAULTS) — a bench row that
+        # silently dropped work would otherwise report inflated tokens/s
+        "timeouts": st["timeouts"],
+        "shed": st["shed"],
+        "evict_capped": st["evict_capped"],
     }
     if emit:
         print(json.dumps(row), flush=True)
