@@ -449,6 +449,13 @@ class InferenceEngine:
             self._decode_slots = jax.jit(self._decode_slots_fn,
                                          donate_argnums=(1, 2),
                                          static_argnums=(7,))
+            # prefix-cache copy-on-write block copy: src/dst are traced
+            # scalars, so every divergence reuses ONE compiled program
+            # (warmed at ServingEngine construction — the steady-state
+            # compile contract stays at zero recompiles with the prefix
+            # cache on)
+            self._cow_blocks = jax.jit(self._cow_blocks_fn,
+                                       donate_argnums=(0, 1))
         log_dist(f"inference engine: {config.n_layers}L/{config.d_model}d "
                  f"mp={mp_size} dtype={jnp.dtype(dtype).name} "
                  f"{'encoder' if self.is_encoder else 'decoder'}",
@@ -548,7 +555,9 @@ class InferenceEngine:
         tokens: [C] fixed-width chunk (padded; n_valid real tokens);
         start: scalar — tokens already cached for this slot (0 for the
         first chunk, the resume point for later chunks / requeued
-        requests); table_row: [NB] the slot's block table. Returns the
+        requests, the MATCHED BOUNDARY for a prefix-cache hit whose
+        shared blocks are already resident); table_row: [NB] the slot's
+        block table. Returns the
         logits of the LAST VALID position (meaningful once the final
         chunk lands) and the updated (donated) pools."""
         cfg = self.cfg
@@ -598,6 +607,18 @@ class InferenceEngine:
         x, (ks, vs) = jax.lax.scan(body, x,
                                    (params["block"], k_pool, v_pool))
         return self._logits(params, x), ks, vs
+
+    def _cow_blocks_fn(self, k_pool, v_pool, src, dst):
+        """Copy pool block ``src`` -> ``dst`` across every layer — the
+        device half of prefix-cache copy-on-write (paged_cache._cow).
+        Pools are donated, so the copy is in-place in HBM."""
+        return (k_pool.at[:, dst].set(k_pool[:, src]),
+                v_pool.at[:, dst].set(v_pool[:, src]))
+
+    def cow_blocks(self, k_pool, v_pool, src, dst):
+        return self._cow_blocks(k_pool, v_pool,
+                                jnp.asarray(src, jnp.int32),
+                                jnp.asarray(dst, jnp.int32))
 
     # public wrappers: host-side numpy in, device pools threaded through.
     # The fault-injection sites fire BEFORE any dispatch touches the

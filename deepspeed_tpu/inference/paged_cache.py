@@ -13,21 +13,41 @@ scales with tokens in flight, fragmentation is bounded by one partial
 block per request, and a finished request's blocks return to the pool
 immediately.
 
-Host-side bookkeeping (tables, lengths, the free list) is plain numpy —
-it changes every scheduler iteration and must never trigger a recompile;
-the device arrays (``k``/``v`` pools) thread functionally through the
-engine's donated ``prefill_into_slot`` / ``decode_slots`` programs.
+**Shared-prefix caching** (``prefix_cache=True`` / ``DS_PREFIX_CACHE=on``,
+vLLM automatic prefix caching + SGLang RadixAttention): blocks carry
+REFCOUNTS, and a host-side radix index (:mod:`.prefix_index`) maps full
+block-sized token chunks to the pool blocks already holding their K/V.
+Admission matches a new prompt's longest cached prefix and maps those
+blocks into the slot's table read-only (refcount++), charging the free
+list only for the uncached suffix; a divergence *inside* a block is
+handled by copy-on-write (device-copy the partially-matching block into
+a fresh one, overwrite from the divergence point). A finished request's
+indexed blocks stay resident at refcount 0 — evictable — and block
+reclaim becomes LRU over those instead of whole-request preemption.
+``prefix_cache=False`` (the default) is bit-identical to the pre-prefix
+allocator and stays the behavioral reference.
+
+Host-side bookkeeping (tables, lengths, refcounts, the free list, the
+radix index) is plain numpy — it changes every scheduler iteration and
+must never trigger a recompile; the device arrays (``k``/``v`` pools)
+thread functionally through the engine's donated ``prefill_into_slot``
+/ ``decode_slots`` programs, and the only device work this module ever
+issues is the one COW block copy (a single compiled program, warmed at
+serving startup).
 
 Block id 0 is RESERVED as the trash block: the slot programs route
 writes for masked-out lanes (chunk padding, inactive slots) there, so
 the compiled scatter needs no branch.
 """
 
-from typing import List, Optional
+import os
+from typing import Callable, Dict, List, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepspeed_tpu.inference.prefix_index import PrefixIndex, PrefixMatch
 from deepspeed_tpu.models import gpt as gpt_lib
 from deepspeed_tpu.models.gpt import GPTConfig
 
@@ -37,26 +57,68 @@ class CacheExhausted(Exception):
     evict-and-requeue instead of OOMing the device."""
 
 
+def resolve_prefix_cache(flag: Optional[bool] = None) -> bool:
+    """Resolve the shared-prefix cache switch.
+
+    Explicit argument wins, else the ``DS_PREFIX_CACHE`` env var
+    (``on``/``off``, also ``1``/``0``/``true``/``false``), else OFF —
+    the refcount-free allocator is the behavioral bit-reference."""
+    if flag is not None:
+        return bool(flag)
+    v = os.environ.get("DS_PREFIX_CACHE", "")  # dslint: disable=DS005 — documented serving knob, resolved once at engine construction and overridable per ServingEngine
+    v = v.strip().lower()
+    if v in ("", "off", "0", "false", "no"):
+        return False
+    if v in ("on", "1", "true", "yes"):
+        return True
+    # ValueError, not assert: validates user env input, survives python -O
+    raise ValueError(f"DS_PREFIX_CACHE={v!r}: expected 'on' or 'off'")
+
+
+def _cow_copy_fn(k_pool, v_pool, src, dst):
+    """Copy ONE pool block (every layer) ``src`` -> ``dst``: the device
+    half of copy-on-write. Pools are donated so the copy is in-place in
+    HBM; ``src``/``dst`` are traced scalars, so every (src, dst) pair
+    reuses one compiled program."""
+    return (k_pool.at[:, dst].set(k_pool[:, src]),
+            v_pool.at[:, dst].set(v_pool[:, src]))
+
+
+_default_cow = jax.jit(_cow_copy_fn, donate_argnums=(0, 1))
+
+
 class PagedKVCache:
-    """Pool + allocator + per-slot block tables.
+    """Pool + allocator + per-slot block tables (+ optional prefix index).
 
     num_blocks is the HBM-budget watermark made concrete: either passed
     directly or derived from ``hbm_budget_bytes`` via the per-token cache
     cost (models.gpt.kv_bytes_per_token). ``watermark`` free blocks are
     held back at admission time so every active slot can always grow into
     its next decode block without immediate eviction.
+
+    With ``prefix_cache=True`` every mapped block carries a refcount
+    (shared prefix blocks count once per slot mapping them); a block is
+    in exactly ONE of three states: on the free list, held (refcount >
+    0), or cached (indexed, refcount 0, reclaimable in LRU order).
+    ``copy_fn(k, v, src, dst) -> (k, v)`` performs the COW block copy —
+    the serving engine wires the engine's donated program in; standalone
+    caches fall back to a module-level jitted copy.
     """
 
     def __init__(self, cfg: GPTConfig, *, num_slots: int,
                  block_size: int = 16, num_blocks: Optional[int] = None,
                  hbm_budget_bytes: Optional[int] = None,
                  dtype=jnp.bfloat16, max_seq_len: Optional[int] = None,
-                 watermark: Optional[int] = None, faults=None):
+                 watermark: Optional[int] = None, faults=None,
+                 prefix_cache: bool = False,
+                 copy_fn: Optional[Callable] = None):
         self.cfg = cfg
         # fault-injection hook (utils/faults.FaultInjector): the
         # ``cache.allocate`` / ``cache.ensure`` sites can fire a
         # synthetic CacheExhausted so the scheduler's eviction path runs
-        # under test without actually shrinking the pool
+        # under test without actually shrinking the pool;
+        # ``cache.match`` degrades a prefix lookup to a miss and
+        # ``cache.cow`` fails the copy-on-write before any bookkeeping
         self.faults = faults
         self.block_size = int(block_size)
         self.num_slots = int(num_slots)
@@ -85,12 +147,23 @@ class PagedKVCache:
         self.v = jnp.zeros_like(self.k)
         self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
         self._owned: List[List[int]] = [[] for _ in range(num_slots)]
+        self._refcount = np.zeros((self.num_blocks,), np.int32)
         self.tables = np.zeros((num_slots, self.blocks_per_slot), np.int32)
         self.lengths = np.zeros((num_slots,), np.int32)
         self.active = np.zeros((num_slots,), bool)
         self.watermark = num_slots if watermark is None else int(watermark)
+        self.prefix_cache = bool(prefix_cache)
+        self.index: Optional[PrefixIndex] = \
+            PrefixIndex(self.block_size) if self.prefix_cache else None
+        self.copy_fn = copy_fn
         self.peak_used_blocks = 0
         self.peak_tokens_in_flight = 0
+        # prefix-cache counters (mirrored into serving stats / bench rows)
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_tokens_saved = 0
+        self.cow_copies = 0
+        self.cache_block_evictions = 0
 
     # -- accounting ----------------------------------------------------
     @property
@@ -99,11 +172,56 @@ class PagedKVCache:
 
     @property
     def used_blocks(self) -> int:
+        """Blocks not on the free list — held by slots OR resident in
+        the prefix cache (both occupy HBM)."""
         return (self.num_blocks - 1) - len(self._free)
+
+    @property
+    def held_blocks(self) -> int:
+        """Blocks mapped into at least one slot table (refcount > 0)."""
+        return int((self._refcount > 0).sum())
+
+    @property
+    def shared_blocks(self) -> int:
+        """Blocks mapped by MORE than one slot — the sharing win."""
+        return int((self._refcount > 1).sum())
+
+    @property
+    def cached_blocks(self) -> int:
+        """Indexed blocks no slot holds: resident, reclaimable (LRU)."""
+        if self.index is None:
+            return 0
+        return self.index.evictable_count(
+            lambda b: self._refcount[b] == 0)
 
     @property
     def tokens_in_flight(self) -> int:
         return int(self.lengths.sum())
+
+    def stats(self) -> Dict[str, float]:
+        """Allocator state for bench rows and operators: block counts by
+        state, internal fragmentation of slot tables (tail-block waste:
+        allocated-but-unwritten token positions over allocated capacity),
+        and the prefix-cache counters."""
+        cap_tokens = sum(len(o) for o in self._owned) * self.block_size
+        frag = (1.0 - self.tokens_in_flight / cap_tokens) if cap_tokens \
+            else 0.0
+        return {
+            "num_blocks": self.num_blocks - 1,
+            "free_blocks": self.free_blocks,
+            "used_blocks": self.used_blocks,
+            "held_blocks": self.held_blocks,
+            "shared_blocks": self.shared_blocks,
+            "cached_blocks": self.cached_blocks,
+            "fragmentation": round(float(frag), 4),
+            "tokens_in_flight": self.tokens_in_flight,
+            "peak_used_blocks": self.peak_used_blocks,
+            "prefix_hits": self.prefix_hits,
+            "prefix_misses": self.prefix_misses,
+            "prefix_tokens_saved": self.prefix_tokens_saved,
+            "cow_copies": self.cow_copies,
+            "cache_block_evictions": self.cache_block_evictions,
+        }
 
     def used_block_bytes(self) -> int:
         """Bytes actually held by allocated blocks — what the bench's
@@ -128,35 +246,138 @@ class PagedKVCache:
         scheduler must finish the request before the kernel runs."""
         return int(self.lengths[slot]) >= self.tokens_per_slot
 
-    def can_admit(self, n_tokens: int) -> bool:
-        """Admission-control check: prompt blocks available AND the
-        watermark reserve stays intact so live slots can keep growing."""
-        return self.free_blocks >= self.blocks_for(n_tokens) + self.watermark
+    # -- admission control ---------------------------------------------
+    def _peek_match(self, tokens) -> PrefixMatch:
+        """LRU-neutral prefix lookup (admission precheck)."""
+        if self.index is None or tokens is None or len(tokens) < 2:
+            return PrefixMatch()
+        return self.index.match(tokens, max_tokens=len(tokens) - 1,
+                                touch=False)
+
+    def blocks_needed(self, n_tokens: int, tokens=None) -> int:
+        """Fresh blocks an allocation would draw from the pool after
+        prefix sharing (a COW divergence still needs its fresh copy)."""
+        return self.blocks_for(n_tokens) - \
+            len(self._peek_match(tokens).block_ids)
+
+    def available_blocks(self, tokens=None) -> int:
+        """Free blocks plus LRU-reclaimable cached blocks, EXCLUDING any
+        block a match on ``tokens`` would map (a chain block at refcount
+        0 cannot both be shared into the slot and reclaimed for it)."""
+        n = len(self._free)
+        if self.index is not None:
+            m = self._peek_match(tokens)
+            pinned = set(m.block_ids)
+            if m.cow_src is not None:
+                pinned.add(m.cow_src)
+            n += self.index.evictable_count(
+                lambda b: self._refcount[b] == 0 and b not in pinned)
+        return n
+
+    def can_admit(self, n_tokens: int, tokens=None,
+                  watermark: Optional[int] = None) -> bool:
+        """Admission-control check: fresh blocks for the (uncached part
+        of the) prompt available AND the watermark reserve stays intact
+        so live slots can keep growing. Shared prefix blocks are free —
+        admission charges only the uncached suffix."""
+        wm = self.watermark if watermark is None else int(watermark)
+        return self.available_blocks(tokens) >= \
+            self.blocks_needed(n_tokens, tokens) + wm
 
     # -- allocator -----------------------------------------------------
-    def allocate(self, slot: int, n_tokens: int) -> None:
-        """Reserve blocks covering ``n_tokens`` for a fresh slot."""
-        assert not self.active[slot] and not self._owned[slot], slot
+    def allocate(self, slot: int, n_tokens: int, tokens=None) -> int:
+        """Reserve blocks covering ``n_tokens`` for a fresh slot.
+
+        With the prefix cache on and the prompt's ``tokens`` given, the
+        longest cached prefix is mapped in read-only (shared blocks,
+        refcount++) and only the uncached suffix draws fresh blocks; a
+        mid-block divergence copy-on-writes the partially-matching block.
+        Returns the number of prefix tokens already resident — the
+        slot's ``lengths`` starts there and prefill begins at that
+        offset (0 on a miss / with the cache off)."""
+        if not 0 <= slot < self.num_slots:
+            raise ValueError(f"slot {slot} out of range "
+                             f"[0, {self.num_slots})")
+        if self.active[slot] or self._owned[slot]:
+            raise ValueError(f"slot {slot} is already allocated; free() "
+                             f"it before re-allocating")
         self._maybe_inject("cache.allocate", slot)
-        need = self.blocks_for(n_tokens)
-        if need > self.blocks_per_slot:
+        need_total = self.blocks_for(n_tokens)
+        if need_total > self.blocks_per_slot:
             raise ValueError(
-                f"{n_tokens} tokens need {need} blocks > per-slot table "
-                f"width {self.blocks_per_slot}")
-        if need > self.free_blocks:
+                f"{n_tokens} tokens need {need_total} blocks > per-slot "
+                f"table width {self.blocks_per_slot}")
+        m = self._match_for_allocate(tokens)
+        # every fault site above fired and every validation ran; from
+        # here the bookkeeping must be atomic (claim -> check -> commit,
+        # with rollback on the one remaining failure: pool shortage)
+        pinned = list(m.block_ids)
+        if m.cow_src is not None:
+            pinned.append(m.cow_src)
+        for bid in pinned:
+            self._refcount[bid] += 1      # claim: un-reclaimable below
+        fresh_need = need_total - len(m.block_ids)
+        avail = len(self._free)
+        if self.index is not None:
+            avail += self.index.evictable_count(
+                lambda b: self._refcount[b] == 0)
+        if fresh_need > avail:
+            for bid in pinned:
+                self._refcount[bid] -= 1  # rollback the claim
             raise CacheExhausted(
-                f"need {need} blocks, {self.free_blocks} free")
-        ids = [self._free.pop() for _ in range(need)]
-        self._owned[slot] = ids
+                f"need {fresh_need} fresh blocks "
+                f"({need_total} total, {len(m.block_ids)} shared), "
+                f"{avail} available")
+        ids = [self._pop_free() for _ in range(fresh_need)]
+        if m.cow_src is not None:
+            # the divergent/partial block: device-copy into the first
+            # fresh block (table position len(chain)); the suffix
+            # prefill overwrites it from the divergence point on
+            self._cow(m.cow_src, ids[0])
+            self._refcount[m.cow_src] -= 1   # pin released post-copy
+        for bid in ids:
+            self._refcount[bid] = 1
+        all_ids = m.block_ids + ids
+        self._owned[slot] = list(all_ids)
         self.tables[slot, :] = 0
-        self.tables[slot, :need] = ids
-        self.lengths[slot] = 0
+        self.tables[slot, :len(all_ids)] = all_ids
+        self.lengths[slot] = m.matched
         self.active[slot] = True
+        if self.index is not None and tokens is not None:
+            if m.matched > 0:
+                self.prefix_hits += 1
+                self.prefix_tokens_saved += m.matched
+            else:
+                self.prefix_misses += 1
         self._mark()
+        return m.matched
+
+    def _match_for_allocate(self, tokens) -> PrefixMatch:
+        """The real (LRU-touching) prefix match, with its fault sites:
+        ``cache.match`` degrades the lookup to a miss, ``cache.cow``
+        fails the copy-on-write — both BEFORE any bookkeeping mutates,
+        so an injected failure leaves the allocator untouched."""
+        if self.index is None or tokens is None or len(tokens) < 2:
+            return PrefixMatch()
+        f = self._fire("cache.match")
+        if f is not None and f.kind == "cache_exhausted":
+            return PrefixMatch()          # degraded: serve as a cold miss
+        m = self.index.match(tokens, max_tokens=len(tokens) - 1)
+        if m.cow_src is not None:
+            f = self._fire("cache.cow")
+            if f is not None and f.kind == "cache_exhausted":
+                raise CacheExhausted(
+                    "injected copy-on-write failure at cache.cow "
+                    f"({self.free_blocks} blocks actually free)")
+        return m
 
     def ensure_capacity(self, slot: int, n_tokens: int) -> None:
-        """Grow the slot's table until it covers ``n_tokens`` (append)."""
-        assert self.active[slot], slot
+        """Grow the slot's table until it covers ``n_tokens`` (append).
+        When the free list is dry, reclaim least-recently-used cached
+        blocks (refcount 0) from the prefix index first — request
+        preemption is the scheduler's LAST resort, not the first."""
+        if not self.active[slot]:
+            raise ValueError(f"slot {slot} is not active")
         self._maybe_inject("cache.ensure", slot)
         need = self.blocks_for(n_tokens)
         if need > self.blocks_per_slot:
@@ -164,11 +385,8 @@ class PagedKVCache:
                 f"{n_tokens} tokens exceed the per-slot capacity "
                 f"{self.tokens_per_slot}")
         while len(self._owned[slot]) < need:
-            if not self._free:
-                raise CacheExhausted(
-                    f"slot {slot} needs a block for token "
-                    f"{n_tokens}; free list empty")
-            bid = self._free.pop()
+            bid = self._pop_free()
+            self._refcount[bid] = 1
             self.tables[slot, len(self._owned[slot])] = bid
             self._owned[slot].append(bid)
         self._mark()
@@ -183,20 +401,96 @@ class PagedKVCache:
                                          self.tokens_in_flight)
 
     def free(self, slot: int) -> None:
-        """Return every block the slot owns to the free list."""
-        self._free.extend(reversed(self._owned[slot]))
+        """Release the slot's references. Idempotent: freeing an already-
+        free slot is a no-op (retry/requeue paths may race a finish).
+        A block whose refcount drops to 0 returns to the free list —
+        unless the prefix index holds it, in which case it stays
+        resident as reclaimable cache."""
+        if not self.active[slot] and not self._owned[slot]:
+            self.tables[slot, :] = 0
+            self.lengths[slot] = 0
+            return
+        for bid in reversed(self._owned[slot]):
+            self._release(bid)
         self._owned[slot] = []
         self.tables[slot, :] = 0
         self.lengths[slot] = 0
         self.active[slot] = False
 
+    def register_prefix(self, slot: int, tokens) -> int:
+        """Publish the slot's FULL prompt blocks into the prefix index
+        (called once the prompt is completely prefilled, so every full
+        block's K/V is final — full blocks are never written again).
+        Chunks already cached keep their existing block; the slot's
+        duplicate stays private. Returns newly registered blocks."""
+        if self.index is None or tokens is None:
+            return 0
+        if not self.active[slot]:
+            raise ValueError(f"slot {slot} is not active")
+        n_full = min(len(tokens) // self.block_size,
+                     len(self._owned[slot]))
+        if int(self.lengths[slot]) < n_full * self.block_size:
+            raise ValueError(
+                f"slot {slot} holds {int(self.lengths[slot])} tokens; "
+                f"cannot register {n_full} full blocks before they are "
+                f"written")
+        return self.index.insert(np.asarray(tokens, np.int32),
+                                 self._owned[slot][:n_full])
+
+    def warm_cow(self) -> None:
+        """Compile the COW copy program up front (trash-block self-copy)
+        so the first real divergence — possibly inside a CompileWatch-
+        guarded steady state — hits a warm cache."""
+        if self.prefix_cache:
+            fn = self.copy_fn if self.copy_fn is not None else _default_cow
+            self.k, self.v = fn(self.k, self.v, np.int32(0), np.int32(0))
+
+    # -- internals -----------------------------------------------------
+    def _cow(self, src: int, dst: int) -> None:
+        fn = self.copy_fn if self.copy_fn is not None else _default_cow
+        self.k, self.v = fn(self.k, self.v, np.int32(src), np.int32(dst))
+        self.cow_copies += 1
+
+    def _pop_free(self) -> int:
+        """Next usable block: the free list, else the LRU refcount-zero
+        cached block (unregistered from the index). Raises
+        :class:`CacheExhausted` when neither can supply one."""
+        if self._free:
+            return self._free.pop()
+        if self.index is not None:
+            bid = self.index.pop_evictable(
+                lambda b: self._refcount[b] == 0)
+            if bid is not None:
+                self.cache_block_evictions += 1
+                return bid
+        raise CacheExhausted("free list empty and no reclaimable "
+                             "cached blocks")
+
+    def _release(self, bid: int) -> None:
+        """Drop one reference with hardening: a foreign or already-free
+        block id is a bookkeeping bug and raises instead of silently
+        corrupting the pool (load-bearing once blocks are shared)."""
+        if not 0 < bid < self.num_blocks:
+            raise ValueError(f"foreign block id {bid} (pool has blocks "
+                             f"1..{self.num_blocks - 1}; 0 is the trash "
+                             f"block)")
+        if self._refcount[bid] <= 0:
+            raise ValueError(f"double free of block {bid}")
+        self._refcount[bid] -= 1
+        if self._refcount[bid] == 0 and not (
+                self.index is not None and bid in self.index):
+            self._free.append(bid)
+
     def _mark(self):
         self.peak_used_blocks = max(self.peak_used_blocks, self.used_blocks)
 
-    def _maybe_inject(self, site: str, slot: int) -> None:
+    def _fire(self, site: str):
         if self.faults is None:
-            return
-        f = self.faults.fire(site)
+            return None
+        return self.faults.fire(site)
+
+    def _maybe_inject(self, site: str, slot: int) -> None:
+        f = self._fire(site)
         if f is not None and f.kind == "cache_exhausted":
             raise CacheExhausted(
                 f"injected cache exhaustion at {site} (slot {slot}, "

@@ -1,0 +1,207 @@
+"""Host-side radix index over token-id block chunks — the shared-prefix
+lookup structure behind the paged KV-cache's automatic prefix caching.
+
+A fleet serving millions of users sends the same system prompt with
+every request; without sharing, each request re-prefills it and holds a
+private copy of its K/V in HBM. This index is the cross-request memory
+(vLLM automatic prefix caching, Kwon et al. SOSP '23; SGLang
+RadixAttention): a radix tree whose edges are FULL block-sized token
+chunks and whose nodes each name one pool block holding that chunk's
+K/V. Because the transformer is causal, a block's K/V depend only on
+the tokens at and before it — two requests that agree on their first
+``k * block_size`` tokens can read the very same ``k`` pool blocks.
+
+The index is pure host-side bookkeeping (dicts over numpy token
+chunks): it never appears in a device program, so lookups, inserts and
+evictions happen every scheduler iteration without any recompile — the
+paged-serving two-program contract is untouched.
+
+Division of labor with :class:`~deepspeed_tpu.inference.paged_cache.
+PagedKVCache`: the index maps token prefixes to block ids and keeps LRU
+order; the CACHE owns refcounts and decides reclaim eligibility
+(``refcount == 0``), passing that predicate into
+:meth:`PrefixIndex.pop_evictable`. Only LEAF nodes are evictable — an
+interior block can never be reclaimed before its descendants, so a
+cached chain never dangles (and since every mapped chain claims all its
+ancestors, an interior node's refcount is always >= any descendant's).
+
+Matching returns the longest cached chain of full blocks plus, when the
+query diverges (or simply ends) inside the NEXT block, a copy-on-write
+candidate: the child block sharing the longest leading run of tokens.
+The caller copies that block into a fresh one and overwrites from the
+divergence point — mid-block reuse without ever mutating shared state.
+"""
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+
+def _chunk_key(tokens: np.ndarray) -> bytes:
+    return np.ascontiguousarray(tokens, dtype=np.int32).tobytes()
+
+
+class _Node:
+    """One cached block: the full token chunk it holds, the pool block
+    id, and radix-tree links. ``last_used`` is the index's logical tick
+    (monotonic), not wall time — LRU must be deterministic for tests."""
+
+    __slots__ = ("chunk", "block", "parent", "children", "last_used")
+
+    def __init__(self, chunk: np.ndarray, block: int,
+                 parent: Optional["_Node"]):
+        self.chunk = chunk
+        self.block = block
+        self.parent = parent
+        self.children: Dict[bytes, "_Node"] = {}
+        self.last_used = 0
+
+
+@dataclass
+class PrefixMatch:
+    """Result of :meth:`PrefixIndex.match`: ``block_ids`` is the chain
+    of fully-shared blocks (map read-only), ``cow_src``/``cow_tokens``
+    the optional partially-matching block to copy-on-write (reuse its
+    first ``cow_tokens`` positions). ``matched`` counts total reusable
+    tokens: ``len(block_ids) * block_size + cow_tokens``."""
+    block_ids: List[int] = field(default_factory=list)
+    matched: int = 0
+    cow_src: Optional[int] = None
+    cow_tokens: int = 0
+
+
+class PrefixIndex:
+    """Radix tree of full-block token chunks -> pool block ids."""
+
+    def __init__(self, block_size: int):
+        self.block_size = int(block_size)
+        self._root = _Node(np.zeros((0,), np.int32), -1, None)
+        self._by_block: Dict[int, _Node] = {}
+        self._tick = 0
+
+    def __len__(self) -> int:
+        return len(self._by_block)
+
+    def __contains__(self, block_id: int) -> bool:
+        return int(block_id) in self._by_block
+
+    def _touch(self, node: _Node) -> None:
+        self._tick += 1
+        node.last_used = self._tick
+
+    # -- lookup --------------------------------------------------------
+    def match(self, tokens: np.ndarray, max_tokens: int,
+              touch: bool = True) -> PrefixMatch:
+        """Longest cached prefix of ``tokens``, capped at ``max_tokens``
+        (the caller caps at ``len(tokens) - 1`` so at least one token is
+        always left to prefill — the final chunk's logits emit the first
+        generated token). ``touch=False`` peeks without disturbing LRU
+        order (admission-control precheck)."""
+        tokens = np.asarray(tokens, np.int32)
+        bs = self.block_size
+        max_tokens = min(int(max_tokens), len(tokens))
+        node = self._root
+        m = PrefixMatch()
+        while m.matched + bs <= max_tokens:
+            child = node.children.get(
+                _chunk_key(tokens[m.matched:m.matched + bs]))
+            if child is None:
+                break
+            node = child
+            m.block_ids.append(child.block)
+            m.matched += bs
+            if touch:
+                self._touch(child)
+        # divergent / final partial block: the child sharing the longest
+        # leading token run is the copy-on-write candidate
+        rem = tokens[m.matched:max_tokens]
+        if len(rem) > 0:
+            best, best_j = None, 0
+            for child in node.children.values():
+                j = _common_prefix_len(child.chunk, rem)
+                if j > best_j:
+                    best, best_j = child, j
+            if best is not None:
+                m.cow_src = best.block
+                m.cow_tokens = best_j
+                m.matched += best_j
+                if touch:
+                    self._touch(best)
+        return m
+
+    # -- registration --------------------------------------------------
+    def insert(self, tokens: np.ndarray, block_ids: List[int]) -> int:
+        """Register a chain: chunk ``i`` of ``tokens`` lives in
+        ``block_ids[i]``. Chunks already cached keep their EXISTING
+        block (the caller's duplicate stays private and is freed with
+        its slot); new chunks extend the tree. Returns how many blocks
+        were newly registered."""
+        tokens = np.asarray(tokens, np.int32)
+        bs = self.block_size
+        n_full = min(len(tokens) // bs, len(block_ids))
+        node = self._root
+        added = 0
+        for i in range(n_full):
+            chunk = tokens[i * bs:(i + 1) * bs]
+            key = _chunk_key(chunk)
+            child = node.children.get(key)
+            if child is None:
+                bid = int(block_ids[i])
+                if bid in self._by_block:
+                    # one physical block holds one chunk; a block cannot
+                    # be registered under two chains
+                    raise ValueError(
+                        f"block {bid} is already registered in the index")
+                child = _Node(chunk.copy(), bid, node)
+                node.children[key] = child
+                self._by_block[bid] = child
+                added += 1
+            self._touch(child)
+            node = child
+        return added
+
+    # -- eviction ------------------------------------------------------
+    def evictable_count(self, can_evict: Callable[[int], bool]) -> int:
+        """How many cached blocks could be reclaimed right now — every
+        indexed block the predicate clears, since leaf-first pops expose
+        interior nodes as they go (refcount(parent) >= refcount(child),
+        so a clearable interior implies clearable descendants)."""
+        return sum(1 for bid in self._by_block if can_evict(bid))
+
+    def pop_evictable(self, can_evict: Callable[[int], bool]
+                      ) -> Optional[int]:
+        """Remove and return the least-recently-used LEAF block passing
+        ``can_evict`` (the cache's ``refcount == 0`` test), or None.
+        Evicting a leaf may expose its parent as the next candidate."""
+        victim = None
+        for node in self._by_block.values():
+            if node.children or not can_evict(node.block):
+                continue
+            if victim is None or node.last_used < victim.last_used:
+                victim = node
+        if victim is None:
+            return None
+        self._remove(victim)
+        return victim.block
+
+    def remove_block(self, block_id: int) -> bool:
+        """Unregister ``block_id`` if it is a leaf; False otherwise."""
+        node = self._by_block.get(int(block_id))
+        if node is None or node.children:
+            return False
+        self._remove(node)
+        return True
+
+    def _remove(self, node: _Node) -> None:
+        assert not node.children, "evicting an interior node"
+        del self._by_block[node.block]
+        node.parent.children.pop(_chunk_key(node.chunk), None)
+
+
+def _common_prefix_len(a: np.ndarray, b: np.ndarray) -> int:
+    n = min(len(a), len(b))
+    if n == 0:
+        return 0
+    neq = np.nonzero(a[:n] != b[:n])[0]
+    return int(neq[0]) if len(neq) else n

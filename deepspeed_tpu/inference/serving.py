@@ -49,11 +49,29 @@ Graceful degradation (the chaos contract, tests/test_chaos.py):
   ``faults=``) at the ``serving.decode`` / ``serving.prefill`` sites;
   the paged cache exposes ``cache.allocate`` / ``cache.ensure``.
 
+Shared-prefix caching (``prefix_cache=True`` / ``DS_PREFIX_CACHE=on``,
+docs/PREFIX_CACHE.md): admission asks the cache to match the request's
+longest cached prefix — shared blocks map into the slot read-only and
+PREFILL STARTS AT THE MATCHED BOUNDARY (``_progress`` begins at the
+matched token count, so a fully-cached system prompt costs zero prefill
+chunks beyond its uncached tail). When the prompt finishes prefilling,
+its full blocks are published to the index for the next request.
+``stats["prefix_hits"]`` / ``stats["prefix_tokens_saved"]`` count the
+win; ``_finish``/``_preempt`` release REFERENCES, not blocks — a block
+another slot still maps, or one the index keeps as reusable cache,
+stays resident. Warm-vs-cold token parity is exact: the prefill program
+is chunk-boundary invariant (fixed-width chunks, gather over the full
+table, causal mask), so starting at a nonzero offset over shared blocks
+reproduces the cold logits bit-for-bit (tests/test_prefix_cache.py).
+
 The steady state is two compiled programs (prefill chunk, slot decode)
 regardless of arrival pattern; all scheduling state is host numpy. None
 of the robustness paths (deadlines, shedding, backoff, expiry) touch
 device shapes, so the compile-count contract is unchanged — pinned by
-``test_serving_compile_count_contract`` and its chaos twin.
+``test_serving_compile_count_contract`` and its chaos twin. The prefix
+cache adds ONE more program (the copy-on-write block copy), compiled
+eagerly at construction via ``cache.warm_cow()`` so steady state stays
+recompile-free with the cache on.
 
 Greedy parity contract (tested): for any arrival pattern, every
 request's output is token-for-token identical to a solo
@@ -69,7 +87,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deepspeed_tpu.inference.paged_cache import CacheExhausted, PagedKVCache
+from deepspeed_tpu.inference.paged_cache import (CacheExhausted,
+                                                 PagedKVCache,
+                                                 resolve_prefix_cache)
 from deepspeed_tpu.utils import faults as faults_lib
 from deepspeed_tpu.utils.faults import TransientDeviceError
 from deepspeed_tpu.utils.logging import logger
@@ -149,6 +169,10 @@ class ServingEngine:
       deterministic jitter from the fault injector's seeded rng).
     - ``faults``: a :class:`~deepspeed_tpu.utils.faults.FaultInjector`;
       defaults to the ambient one (env ``DS_FAULTS`` or installed).
+    - ``prefix_cache``: shared-prefix KV reuse across requests
+      (refcounted block sharing + radix index + copy-on-write). None
+      defers to ``DS_PREFIX_CACHE`` (default off — the private-blocks
+      allocator stays the bit-reference).
     """
 
     def __init__(self, engine, *, num_slots: int = 4, block_size: int = 16,
@@ -157,6 +181,7 @@ class ServingEngine:
                  prefill_chunk: int = 64, temperature: float = 0.0,
                  top_k: int = 0, seed: int = 0,
                  decode_impl: Optional[str] = None,
+                 prefix_cache: Optional[bool] = None,
                  max_queue: Optional[int] = None,
                  max_evictions: int = 8,
                  step_time_budget_s: Optional[float] = None,
@@ -177,11 +202,13 @@ class ServingEngine:
             from deepspeed_tpu.ops.attention.paged import resolve_decode_impl
             self.decode_impl = resolve_decode_impl(decode_impl)
         self.faults = faults if faults is not None else faults_lib.active()
+        self.prefix_cache = resolve_prefix_cache(prefix_cache)
         self.cache = PagedKVCache(
             engine.cfg, num_slots=num_slots, block_size=block_size,
             num_blocks=num_blocks, hbm_budget_bytes=hbm_budget_bytes,
             dtype=engine.dtype, max_seq_len=engine.max_seq_len,
-            faults=self.faults)
+            faults=self.faults, prefix_cache=self.prefix_cache,
+            copy_fn=getattr(engine, "cow_blocks", None))
         mesh = getattr(engine, "mesh", None)
         if mesh is not None:
             # place the fresh pools exactly where the jitted programs
@@ -193,6 +220,11 @@ class ServingEngine:
             pool_sh = NamedSharding(mesh, PartitionSpec())
             self.cache.k = jax.device_put(self.cache.k, pool_sh)
             self.cache.v = jax.device_put(self.cache.v, pool_sh)
+        # compile the COW copy program now (after pool placement, so the
+        # warmed executable matches steady-state shardings): the first
+        # mid-block divergence must not add a compile inside the
+        # CompileWatch-pinned steady state
+        self.cache.warm_cow()
         self.num_slots = num_slots
         self.prefill_chunk = int(prefill_chunk)
         self.temperature = temperature
@@ -216,7 +248,8 @@ class ServingEngine:
                       "prefill_chunks": 0, "decode_steps": 0,
                       "timeouts": 0, "shed": 0, "retries": 0,
                       "evict_capped": 0, "watchdog_trips": 0,
-                      "backpressure": 0.0}
+                      "backpressure": 0.0,
+                      "prefix_hits": 0, "prefix_tokens_saved": 0}
 
     # -- API -----------------------------------------------------------
     def submit(self, req: ServeRequest, now: float = 0.0) -> bool:
@@ -349,24 +382,30 @@ class ServingEngine:
                 break
             req = self.queue[0]
             occupied = any(s is not None for s in self.slots)
-            if occupied:
-                ok = self.cache.can_admit(len(req._work))
-            else:
-                # idle engine: skip the watermark so a lone request that
-                # fits the pool always makes progress (no livelock)
-                ok = (self.cache.blocks_for(len(req._work))
-                      <= self.cache.free_blocks)
+            # idle engine: skip the watermark so a lone request that
+            # fits the pool always makes progress (no livelock); the
+            # admission charge covers only the uncached suffix when the
+            # prefix cache can share blocks
+            ok = self.cache.can_admit(len(req._work), tokens=req._work,
+                                      watermark=None if occupied else 0)
             if not ok:
                 break
             try:
-                self.cache.allocate(slot, len(req._work))
+                matched = self.cache.allocate(slot, len(req._work),
+                                              tokens=req._work)
             except CacheExhausted:
                 # an injected (or racing) exhaustion at admission: the
                 # request stays at the queue head and retries next step
                 break
             self.queue.popleft()
             self.slots[slot] = req
-            self._progress[slot] = 0
+            # prefill resumes at the matched boundary — the shared
+            # blocks' K/V is already resident, so those tokens are
+            # never recomputed
+            self._progress[slot] = matched
+            if matched > 0:
+                self.stats["prefix_hits"] += 1
+                self.stats["prefix_tokens_saved"] += matched
             req.state = "prefill"
             req._admit_seq = self._admit_counter
             self._admit_counter += 1
@@ -388,6 +427,10 @@ class ServingEngine:
             self._progress[slot] = done + n
             self.stats["prefill_chunks"] += 1
             if self._progress[slot] == len(req._work):
+                # prompt fully resident: publish its full blocks to the
+                # prefix index (before _emit, which may free the slot)
+                # so the NEXT request sharing this prefix skips them
+                self.cache.register_prefix(slot, req._work)
                 # final chunk: its last-position logits yield the next
                 # token (== generate()'s prefill sample; on resume, the
                 # recomputed position is exactly the pre-eviction one)

@@ -20,6 +20,12 @@ Sites currently instrumented:
 ``serving.prefill``    before each prefill-chunk dispatch
 ``cache.ensure``       inside ``PagedKVCache.ensure_capacity`` (growth)
 ``cache.allocate``     inside ``PagedKVCache.allocate`` (admission)
+``cache.match``        before the prefix-index lookup in ``allocate``;
+                       ``cache_exhausted`` degrades the request to a
+                       cold miss (served correctly, no sharing)
+``cache.cow``          before the copy-on-write block copy (and before
+                       ANY bookkeeping mutates); ``cache_exhausted``
+                       raises CacheExhausted — the admission retries
 ``engine.decode``      ``InferenceEngine.decode_slots`` public wrapper
 ``checkpoint.pre_commit``  after state write, BEFORE the tag dir commit
 ``checkpoint.commit``  after the tag dir commit, BEFORE ``latest`` update

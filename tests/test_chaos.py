@@ -335,3 +335,36 @@ def test_chaos_compile_count_contract(eng):
     if n_before[0] is not None:
         assert (cache_size(eng._prefill_slot),
                 cache_size(eng._decode_slots)) == n_before
+
+
+def test_chaos_prefix_cache_sites_parity(eng):
+    """The prefix-cache fault sites under one seeded injector: a
+    ``cache.match`` exhaustion degrades an admission to a cold miss, a
+    ``cache.cow`` exhaustion aborts a copy-on-write admission BEFORE
+    any bookkeeping (the request retries and succeeds), and a transient
+    device error rides along — parity and exactly-once still hold with
+    the prefix cache on."""
+    base = np.arange(1, 31, dtype=np.int32)          # 30 tokens, bs=8
+    div = base.copy()
+    div[21] = 99                                     # diverges mid-block
+    refs = _solo_refs(eng, [base, base, div], 6)
+    chaos = [Fault("cache.match", "cache_exhausted", step=1),
+             Fault("cache.cow", "cache_exhausted", step=0),
+             Fault("serving.decode", "device_error", step=2)]
+    with faults_lib.injected(*chaos, seed=0) as inj:
+        srv = ServingEngine(eng, num_slots=1, block_size=8, num_blocks=24,
+                            prefill_chunk=16, prefix_cache=True,
+                            max_retries=3, retry_backoff_s=0.001)
+        out = srv.run([ServeRequest(rid=i, prompt=p, max_new_tokens=6)
+                       for i, p in enumerate((base, base, div))])
+    fired_sites = {s for s, _k, _v in inj.fired}
+    assert {"cache.match", "cache.cow", "serving.decode"} <= fired_sites
+    # request 0 cold; request 1's lookup was degraded to a miss (visit
+    # 1); request 2's first COW attempt failed and the retry landed
+    assert srv.stats["prefix_hits"] == 1
+    assert srv.cache.cow_copies == 1
+    for i, ref in enumerate(refs):
+        np.testing.assert_array_equal(out[i], ref)
+    assert all(r.state == "done" for r in srv.finished)
+    assert srv.cache.held_blocks == 0
+    assert (srv.cache._refcount == 0).all()          # no leaked claims

@@ -71,6 +71,34 @@ def test_paged_allocator_exhaustion_and_watermark(devices):
     assert c.can_admit(8) and not c.can_admit(12)
 
 
+def test_paged_allocator_hardening_and_stats(devices):
+    """Hardened bookkeeping in the DEFAULT (prefix-off) mode: free() is
+    idempotent, double-free/foreign block ids raise instead of silently
+    corrupting the pool, re-allocating an occupied slot raises, and
+    stats() reports block states + fragmentation for bench rows."""
+    cfg, _ = tiny()
+    c = PagedKVCache(cfg, num_slots=2, block_size=4, num_blocks=6)
+    c.allocate(0, 5)                     # 2 blocks, 5 tokens pending
+    with pytest.raises(ValueError, match="already allocated"):
+        c.allocate(0, 4)
+    c.advance(0, 5)
+    s = c.stats()
+    assert s["used_blocks"] == 2 and s["free_blocks"] == 4
+    assert s["held_blocks"] == 2
+    assert s["shared_blocks"] == 0 and s["cached_blocks"] == 0
+    assert s["fragmentation"] == round(1 - 5 / 8, 4)  # 5 of 8 written
+    bid = c._owned[0][0]
+    c.free(0)
+    c.free(0)                            # idempotent: freeing twice is ok
+    assert c.free_blocks == 6 and c.stats()["fragmentation"] == 0.0
+    with pytest.raises(ValueError, match="double free"):
+        c._release(bid)
+    with pytest.raises(ValueError, match="foreign block"):
+        c._release(0)                    # the reserved trash block
+    with pytest.raises(ValueError, match="out of range"):
+        c.allocate(5, 4)
+
+
 def test_paged_cache_hbm_budget_watermark(devices):
     """num_blocks derives from an HBM budget via the per-token cache
     cost, and the usage accounting scales with tokens in flight."""

@@ -57,6 +57,15 @@ elif [[ "${1:-}" == "quick" ]]; then
 else
     python -m tools.dslint deepspeed_tpu tools
     python -m pytest tests/ -q
+    # shared-prefix cache knob smoke: the serving path must be green with
+    # the prefix cache forced ON and forced OFF. The suite default leaves
+    # DS_PREFIX_CACHE unset (= off), so without this loop the on-path only
+    # gets coverage from tests that opt in explicitly (docs/PREFIX_CACHE.md)
+    for pc in on off; do
+        echo "gate: serving smoke (DS_PREFIX_CACHE=$pc)"
+        DS_PREFIX_CACHE=$pc python -m pytest tests/test_serving.py \
+            tests/test_prefix_cache.py -q
+    done
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 fi
 echo "gate: green"

@@ -183,7 +183,7 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
                   prompt_lens=(8, 48), new_tokens=24, num_slots=4,
                   block_size=16, num_blocks=None, prefill_chunk=32,
                   int8=False, int8_fused=False, seed=0, decode_impl=None,
-                  emit=True):
+                  prefix_cache=None, shared_prefix_len=0, emit=True):
     """Continuous-batching serving row: synthetic Poisson arrivals driven
     through ServingEngine.step, wall-clock tokens/s, per-token (TPOT)
     latency percentiles from the scheduler's token timestamps, decode-
@@ -199,6 +199,12 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
     (the gather path moves the whole virtual cache 3x; pallas reads only
     occupied blocks, once). Returns the row dict so the impl-comparison
     row can reuse it (``emit=False`` suppresses the JSON line).
+
+    ``shared_prefix_len`` > 0 prepends a fixed system prompt to every
+    request (the shared-prefix workload); ``prefix_cache`` pins the
+    shared-prefix KV cache on/off (None = ``DS_PREFIX_CACHE``). Rows
+    report ``prefix_hit_rate``/``prefix_tokens_saved``/``prefill_chunks``
+    so the on/off comparison shows the prefill work the cache removes.
     """
     from deepspeed_tpu.models import gpt
     import deepspeed_tpu
@@ -206,7 +212,7 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
 
     on_tpu = "tpu" in (jax.devices()[0].platform +
                        jax.devices()[0].device_kind).lower()
-    max_seq = prompt_lens[1] + new_tokens + 8
+    max_seq = prompt_lens[1] + shared_prefix_len + new_tokens + 8
     if preset:
         cfg = gpt.preset(preset, max_seq_len=max_seq, dtype=jnp.bfloat16,
                          use_flash_attention=on_tpu)
@@ -225,21 +231,31 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
         dtype=jnp.int8 if int8 else act_dtype)
     srv = ServingEngine(eng, num_slots=num_slots, block_size=block_size,
                         num_blocks=num_blocks, prefill_chunk=prefill_chunk,
-                        decode_impl=decode_impl)
+                        decode_impl=decode_impl, prefix_cache=prefix_cache)
 
     rng = np.random.default_rng(seed)
     arrive = np.floor(np.cumsum(
         rng.exponential(mean_gap_steps, num_requests))).astype(int)
-    reqs = [ServeRequest(
-        rid=i,
-        prompt=rng.integers(0, cfg.vocab_size,
-                            rng.integers(*prompt_lens)).astype(np.int32),
-        max_new_tokens=new_tokens) for i in range(num_requests)]
+    # the shared-prefix workload: every request opens with the SAME
+    # system prompt (deterministic, independent of the tail rng stream)
+    sys_prompt = (1 + np.arange(shared_prefix_len)
+                  % (cfg.vocab_size - 1)).astype(np.int32) \
+        if shared_prefix_len else None
+
+    def mk_prompt():
+        tail = rng.integers(0, cfg.vocab_size,
+                            rng.integers(*prompt_lens)).astype(np.int32)
+        return tail if sys_prompt is None \
+            else np.concatenate([sys_prompt, tail])
+
+    reqs = [ServeRequest(rid=i, prompt=mk_prompt(),
+                         max_new_tokens=new_tokens)
+            for i in range(num_requests)]
 
     # warmup: compile both slot programs before the timed drive
     w = ServingEngine(eng, num_slots=num_slots, block_size=block_size,
                       num_blocks=num_blocks, prefill_chunk=prefill_chunk,
-                      decode_impl=decode_impl)
+                      decode_impl=decode_impl, prefix_cache=prefix_cache)
     w.run([ServeRequest(rid="w", prompt=reqs[0].prompt.copy(),
                         max_new_tokens=2)])
 
@@ -290,9 +306,20 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
         "timeouts": st["timeouts"],
         "shed": st["shed"],
         "evict_capped": st["evict_capped"],
+        # shared-prefix KV cache columns: hit rate over admissions,
+        # prompt tokens whose prefill was skipped, and total prefill
+        # chunks (the on/off delta is the work the cache removed)
+        "prefix_cache": bool(srv.prefix_cache),
+        "prefix_hit_rate": round(
+            st["prefix_hits"] / max(st["admitted"], 1), 3),
+        "prefix_tokens_saved": st["prefix_tokens_saved"],
+        "prefill_chunks": st["prefill_chunks"],
+        "cache_stats": cache.stats(),
     }
     if emit:
         print(json.dumps(row), flush=True)
+    # greedy streams for comparison rows (post-emit: never serialized)
+    row["_results"] = {r.rid: r.tokens.tolist() for r in srv.finished}
     return row
 
 
@@ -317,6 +344,32 @@ def bench_serving_impl_compare(name, **kw):
         "hbm_traffic_ratio": round(
             g["kv_hbm_bytes_per_token"]
             / max(p["kv_hbm_bytes_per_token"], 1), 1),
+    }), flush=True)
+
+
+def bench_serving_prefix_compare(name, shared_prefix_len=64, **kw):
+    """Same shared-system-prompt drive with the prefix cache OFF then
+    ON: greedy streams must be identical (the cache changes work done,
+    never tokens produced); the row is the prefill work and KV-sharing
+    delta the cache buys."""
+    off = bench_serving(f"{name}[off]", prefix_cache=False,
+                        shared_prefix_len=shared_prefix_len, **kw)
+    on = bench_serving(f"{name}[on]", prefix_cache=True,
+                       shared_prefix_len=shared_prefix_len, **kw)
+    print(json.dumps({
+        "config": name, "preset": off["preset"],
+        "prefix_cache": "off-vs-on",
+        "shared_prefix_len": shared_prefix_len,
+        "output_identical": off["_results"] == on["_results"],
+        "prefix_hit_rate": on["prefix_hit_rate"],
+        "prefix_tokens_saved": on["prefix_tokens_saved"],
+        "prefill_chunks_off": off["prefill_chunks"],
+        "prefill_chunks_on": on["prefill_chunks"],
+        "prefill_chunks_saved": off["prefill_chunks"]
+        - on["prefill_chunks"],
+        "tokens_per_s_off": off["tokens_per_s"],
+        "tokens_per_s_on": on["tokens_per_s"],
+        "cow_copies": on["cache_stats"]["cow_copies"],
     }), flush=True)
 
 
@@ -353,6 +406,19 @@ SERVE_COMPARE_CONFIGS = [
                                     prompt_lens=(64, 384), new_tokens=64,
                                     num_slots=8, block_size=16,
                                     prefill_chunk=128)),
+    # shared-system-prompt workload, DS_PREFIX_CACHE on vs off: every
+    # request opens with the same shared_prefix_len tokens, so the warm
+    # path must report prefix_hit_rate > 0 and fewer prefill chunks
+    # while streams stay identical
+    ("serve-prefix-smoke", dict(mode="prefix", num_requests=8,
+                                mean_gap_steps=2.0, prompt_lens=(4, 12),
+                                new_tokens=8, num_slots=2, block_size=8,
+                                prefill_chunk=16, shared_prefix_len=24)),
+    ("serve-prefix-gpt2-medium", dict(
+        mode="prefix", preset="gpt2-medium", num_requests=32,
+        mean_gap_steps=1.5, prompt_lens=(16, 128), new_tokens=64,
+        num_slots=8, block_size=16, prefill_chunk=128,
+        shared_prefix_len=256)),
 ]
 
 
@@ -386,8 +452,12 @@ def main():
             print(json.dumps({"config": name, "error": repr(e)[:200]}),
                   flush=True)
     for name, kw in SERVE_COMPARE_CONFIGS:
+        kw = dict(kw)
+        mode = kw.pop("mode", "impl")
+        compare = (bench_serving_prefix_compare if mode == "prefix"
+                   else bench_serving_impl_compare)
         try:
-            bench_serving_impl_compare(name, **kw)
+            compare(name, **kw)
         except MemoryGuardError as e:
             print(json.dumps({"config": name, "skipped": "memory guard",
                               "why": str(e)[:300]}), flush=True)
