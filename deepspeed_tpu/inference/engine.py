@@ -620,6 +620,13 @@ class InferenceEngine:
                                 jnp.asarray(src, jnp.int32),
                                 jnp.asarray(dst, jnp.int32))
 
+    def sync(self, *values) -> None:
+        """Barrier on device values (pools, logits): the telemetry
+        step-time breakdown's sampled sync point — same discipline as
+        utils/timer's ``_device_sync``, but scoped to the values the
+        serving step actually produced so it keys no new programs."""
+        jax.block_until_ready(values)
+
     # public wrappers: host-side numpy in, device pools threaded through.
     # The fault-injection sites fire BEFORE any dispatch touches the
     # donated pools, so a TransientDeviceError here is retryable by the

@@ -111,8 +111,13 @@ class PagedKVCache:
                  dtype=jnp.bfloat16, max_seq_len: Optional[int] = None,
                  watermark: Optional[int] = None, faults=None,
                  prefix_cache: bool = False,
-                 copy_fn: Optional[Callable] = None):
+                 copy_fn: Optional[Callable] = None,
+                 tracer=None):
         self.cfg = cfg
+        # telemetry hook (telemetry/tracer.RequestTracer): COW copies
+        # and index-block reclaims land in the serving timeline; None
+        # (standalone caches, telemetry off) records nothing
+        self.tracer = tracer
         # fault-injection hook (utils/faults.FaultInjector): the
         # ``cache.allocate`` / ``cache.ensure`` sites can fire a
         # synthetic CacheExhausted so the scheduler's eviction path runs
@@ -450,6 +455,8 @@ class PagedKVCache:
         fn = self.copy_fn if self.copy_fn is not None else _default_cow
         self.k, self.v = fn(self.k, self.v, np.int32(src), np.int32(dst))
         self.cow_copies += 1
+        if self.tracer is not None:
+            self.tracer.event("cow", src=src, dst=dst)
 
     def _pop_free(self) -> int:
         """Next usable block: the free list, else the LRU refcount-zero
@@ -462,6 +469,8 @@ class PagedKVCache:
                 lambda b: self._refcount[b] == 0)
             if bid is not None:
                 self.cache_block_evictions += 1
+                if self.tracer is not None:
+                    self.tracer.event("cache_evict_block", block=bid)
                 return bid
         raise CacheExhausted("free list empty and no reclaimable "
                              "cached blocks")

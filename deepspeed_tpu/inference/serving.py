@@ -73,6 +73,18 @@ cache adds ONE more program (the copy-on-write block copy), compiled
 eagerly at construction via ``cache.warm_cow()`` so steady state stays
 recompile-free with the cache on.
 
+Telemetry (``telemetry=True`` / ``DS_TELEMETRY=on``,
+docs/OBSERVABILITY.md): every lifecycle transition (enqueue, admit with
+prefix-hit tags, prefill chunks, evict/requeue, finish/timeout/shed),
+injected faults and a sampled per-phase step-time breakdown stream into
+a :class:`~deepspeed_tpu.telemetry.Telemetry` bundle — ring-buffered
+host-side records plus a metrics registry with Prometheus and
+Chrome-trace/Perfetto exporters. ``stats`` is now a READ-ONLY mapping
+view over registry counters (same keys, same values as the old dict);
+the scheduler deadline clock is a private field, so mutating a metric
+can never move a deadline. Default off: the off path swaps in no-op
+twins and is token-bit-identical to on (tests/test_telemetry.py).
+
 Greedy parity contract (tested): for any arrival pattern, every
 request's output is token-for-token identical to a solo
 ``InferenceEngine.generate`` run of its prompt.
@@ -80,6 +92,7 @@ request's output is token-for-token identical to a solo
 
 import time
 from collections import deque
+from collections.abc import Mapping
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -90,11 +103,57 @@ import numpy as np
 from deepspeed_tpu.inference.paged_cache import (CacheExhausted,
                                                  PagedKVCache,
                                                  resolve_prefix_cache)
+from deepspeed_tpu.telemetry import (NOOP, MetricsRegistry, NoopTelemetry,
+                                     Telemetry, resolve_telemetry)
 from deepspeed_tpu.utils import faults as faults_lib
 from deepspeed_tpu.utils.faults import TransientDeviceError
 from deepspeed_tpu.utils.logging import logger
 
 TERMINAL_STATES = ("done", "timeout", "shed")
+
+# the stats contract: same keys (and order) as the pre-telemetry dict,
+# now backed by registry metrics ("c" counter / "g" gauge) and exposed
+# through the read-only _StatsView
+_STAT_FIELDS = (
+    ("steps", "c", "scheduler iterations"),
+    ("occupancy_sum", "c", "sum of per-step decode occupancy"),
+    ("peak_occupancy", "g", "max decode occupancy seen"),
+    ("evictions", "c", "preemptions (recompute-on-resume requeues)"),
+    ("admitted", "c", "requests admitted to a slot"),
+    ("completed", "c", "requests finished with state=done"),
+    ("prefill_chunks", "c", "prefill chunk dispatches"),
+    ("decode_steps", "c", "batched decode dispatches"),
+    ("timeouts", "c", "requests retired at their deadline"),
+    ("shed", "c", "requests rejected by the bounded queue"),
+    ("retries", "c", "transient-device-error retries"),
+    ("evict_capped", "c", "evictions refused by the storm guard"),
+    ("watchdog_trips", "c", "over-budget decode dispatches"),
+    ("backpressure", "g", "queue fullness in [0, 1]"),
+    ("prefix_hits", "c", "admissions that matched a cached prefix"),
+    ("prefix_tokens_saved", "c", "prompt tokens served from shared blocks"),
+)
+
+
+class _StatsView(Mapping):
+    """Read-only mapping over the registry-backed serving counters:
+    the old ``stats`` dict's keys and values, minus mutability — writes
+    go through the registry (``srv.metrics``), never through the view,
+    so external code cannot skew the scheduler's bookkeeping."""
+
+    def __init__(self, metrics: Dict[str, Any]):
+        self._metrics = metrics
+
+    def __getitem__(self, key):
+        return self._metrics[key].value
+
+    def __iter__(self):
+        return iter(self._metrics)
+
+    def __len__(self):
+        return len(self._metrics)
+
+    def __repr__(self):
+        return repr(dict(self))
 
 
 @dataclass
@@ -173,6 +232,11 @@ class ServingEngine:
       (refcounted block sharing + radix index + copy-on-write). None
       defers to ``DS_PREFIX_CACHE`` (default off — the private-blocks
       allocator stays the bit-reference).
+    - ``telemetry``: lifecycle tracing + metrics registry + step-time
+      breakdown (docs/OBSERVABILITY.md). True/False forces it, a
+      :class:`~deepspeed_tpu.telemetry.Telemetry` instance is used
+      as-is (share one across engines to aggregate), None defers to
+      ``DS_TELEMETRY`` (default off — no-op plane, zero overhead).
     """
 
     def __init__(self, engine, *, num_slots: int = 4, block_size: int = 16,
@@ -187,10 +251,17 @@ class ServingEngine:
                  step_time_budget_s: Optional[float] = None,
                  watchdog_grace: int = 2,
                  max_retries: int = 3, retry_backoff_s: float = 0.02,
-                 faults: Optional[faults_lib.FaultInjector] = None):
+                 faults: Optional[faults_lib.FaultInjector] = None,
+                 telemetry=None):
         if engine.is_encoder:
             raise ValueError("serving needs a causal decoder engine")
         self.engine = engine
+        if isinstance(telemetry, (Telemetry, NoopTelemetry)):
+            self.telemetry = telemetry
+        elif resolve_telemetry(telemetry):
+            self.telemetry = Telemetry()
+        else:
+            self.telemetry = NOOP
         # decode attention path ("pallas" flash-decode through the block
         # table | "gather" dense reference); defaults to the engine's
         # resolved choice so env/platform selection applies uniformly.
@@ -208,7 +279,9 @@ class ServingEngine:
             num_blocks=num_blocks, hbm_budget_bytes=hbm_budget_bytes,
             dtype=engine.dtype, max_seq_len=engine.max_seq_len,
             faults=self.faults, prefix_cache=self.prefix_cache,
-            copy_fn=getattr(engine, "cow_blocks", None))
+            copy_fn=getattr(engine, "cow_blocks", None),
+            tracer=self.telemetry.tracer
+            if self.telemetry.enabled else None)
         mesh = getattr(engine, "mesh", None)
         if mesh is not None:
             # place the fresh pools exactly where the jitted programs
@@ -243,13 +316,59 @@ class ServingEngine:
         self._admit_counter = 0
         self._over_budget = 0            # consecutive watchdog strikes
         self._watchdog_msg: Optional[str] = None
-        self.stats = {"steps": 0, "occupancy_sum": 0, "peak_occupancy": 0,
-                      "evictions": 0, "admitted": 0, "completed": 0,
-                      "prefill_chunks": 0, "decode_steps": 0,
-                      "timeouts": 0, "shed": 0, "retries": 0,
-                      "evict_capped": 0, "watchdog_trips": 0,
-                      "backpressure": 0.0,
-                      "prefix_hits": 0, "prefix_tokens_saved": 0}
+        # the deadline clock is its OWN monotone counter (one tick per
+        # step): stats["steps"] used to double as it, which let a stats
+        # mutation skew every relative deadline — now stats are a
+        # read-only view and the clock is private
+        self._step_clock = 0
+        # stats route through a metrics registry (the telemetry one
+        # when enabled, else a private one — the counters must stay
+        # live either way since they ARE the public stats contract)
+        self.metrics = (self.telemetry.registry if self.telemetry.enabled
+                        else MetricsRegistry())
+        self._stat = {}
+        for key, kind, help_ in _STAT_FIELDS:
+            make = (self.metrics.counter if kind == "c"
+                    else self.metrics.gauge)
+            self._stat[key] = make(f"serving_{key}", help_)
+        self.stats = _StatsView(self._stat)
+        if self.telemetry.enabled:
+            reg = self.metrics
+            self._h_ttft = reg.histogram(
+                "serving_ttft", "time to first token (scheduler clock "
+                "units: seconds under wall_clock, steps otherwise)")
+            self._h_tpot = reg.histogram(
+                "serving_tpot",
+                "per-output-token latency (scheduler clock units)")
+            self._h_qwait = reg.histogram(
+                "serving_queue_wait",
+                "enqueue-to-admit wait (scheduler clock units)")
+            self._h_occ = reg.histogram(
+                "serving_batch_occupancy", "decoding slots per step",
+                buckets=tuple(float(i) for i in range(num_slots + 1)))
+            self._g_held = reg.gauge(
+                "serving_hbm_blocks_held", "pool blocks with refcount > 0")
+            self._g_cached = reg.gauge(
+                "serving_hbm_blocks_cached",
+                "refcount-0 blocks kept by the prefix index")
+            self._g_free = reg.gauge(
+                "serving_hbm_blocks_free", "free-list blocks")
+            self._g_hit_rate = reg.gauge(
+                "serving_prefix_hit_rate", "prefix hits / admissions")
+
+            def _on_fault(site: str, kind: str, visit: int) -> None:
+                # injected faults land in the SAME timeline as the
+                # request lifecycle, stamped with the scheduler step at
+                # fire time — a chaos run replays as one trace
+                self.telemetry.tracer.event(
+                    "fault", step=self._step_clock,
+                    site=site, kind=kind, visit=visit)
+
+            self._fault_listener = _on_fault
+            self.faults.add_listener(self._fault_listener)
+        else:
+            self._h_ttft = self._h_tpot = self._h_qwait = self._h_occ = None
+            self._fault_listener = None
 
     # -- API -----------------------------------------------------------
     def submit(self, req: ServeRequest, now: float = 0.0) -> bool:
@@ -268,6 +387,9 @@ class ServingEngine:
                 f"request {req.rid} needs more blocks than the whole pool")
         req.submitted_at = now
         req._work = np.asarray(req.prompt, np.int32)
+        self.telemetry.tracer.event("enqueue", rid=req.rid,
+                                    step=self._step_clock,
+                                    queue_len=len(self.queue))
         if self.max_queue is not None and len(self.queue) >= self.max_queue:
             # reject-newest: accepted work keeps its latency budget; the
             # newcomer gets an immediate, explicit answer instead of an
@@ -275,7 +397,10 @@ class ServingEngine:
             req.state = "shed"
             req.finished_at = now
             self.finished.append(req)
-            self.stats["shed"] += 1
+            self._stat["shed"].inc()
+            self.telemetry.tracer.event("finish", rid=req.rid,
+                                        step=self._step_clock,
+                                        state="shed", generated=0)
             self._update_backpressure()
             logger.warning(f"serving: shed request {req.rid} "
                            f"(queue full at {self.max_queue})")
@@ -295,18 +420,32 @@ class ServingEngine:
         step watchdog trips (state stays consistent — every token
         produced so far, including this step's, is recorded)."""
         if now is None:
-            now = float(self.stats["steps"])
+            now = float(self._step_clock)
+        bd = self.telemetry.breakdown
+        sampled = bd.begin(self._step_clock, sync=self._sync_devices)
         self._expire(now)
-        self._admit()
+        self._admit(now)
+        bd.lap("admission")
         self._prefill_step(now)
+        bd.lap("prefill")
         occ = self._decode_step(now)
-        self.stats["steps"] += 1
-        self.stats["occupancy_sum"] += occ
-        self.stats["peak_occupancy"] = max(self.stats["peak_occupancy"], occ)
+        bd.lap("decode")
+        self._step_clock += 1
+        self._stat["steps"].inc()
+        self._stat["occupancy_sum"].inc(occ)
+        peak = self._stat["peak_occupancy"]
+        peak.set(max(peak.value, occ))
         self._update_backpressure()
+        if self._h_occ is not None:
+            self._h_occ.observe(occ)
+            if sampled:
+                self._sample_gauges()
+        bd.finish(occupancy=occ)
         if self._watchdog_msg is not None:
             msg, self._watchdog_msg = self._watchdog_msg, None
             self._over_budget = 0
+            self.telemetry.tracer.event("degraded", step=self._step_clock,
+                                        message=msg)
             raise self._degraded(msg)
         return occ
 
@@ -367,12 +506,15 @@ class ServingEngine:
                 req.state = "timeout"
                 req.finished_at = now
                 self.finished.append(req)
-                self.stats["timeouts"] += 1
+                self._stat["timeouts"].inc()
+                self.telemetry.tracer.event(
+                    "finish", rid=req.rid, step=self._step_clock,
+                    state="timeout", generated=len(req.out))
             else:
                 keep.append(req)
         self.queue = keep
 
-    def _admit(self) -> None:
+    def _admit(self, now: float = 0.0) -> None:
         # FIFO head-of-line: no queue jumping, so a preempted-and-
         # requeued request (appendleft) resumes before newer arrivals
         while self.queue:
@@ -404,12 +546,17 @@ class ServingEngine:
             # never recomputed
             self._progress[slot] = matched
             if matched > 0:
-                self.stats["prefix_hits"] += 1
-                self.stats["prefix_tokens_saved"] += matched
+                self._stat["prefix_hits"].inc()
+                self._stat["prefix_tokens_saved"].inc(matched)
             req.state = "prefill"
             req._admit_seq = self._admit_counter
             self._admit_counter += 1
-            self.stats["admitted"] += 1
+            self._stat["admitted"].inc()
+            if self._h_qwait is not None and req.submitted_at is not None:
+                self._h_qwait.observe(max(0.0, now - req.submitted_at))
+            self.telemetry.tracer.event(
+                "admit", rid=req.rid, step=self._step_clock, slot=slot,
+                matched=int(matched), evictions=req.evictions)
 
     def _prefill_step(self, now: float) -> None:
         for slot, req in enumerate(self.slots):
@@ -425,12 +572,18 @@ class ServingEngine:
                 chunk, done, n)
             self.cache.advance(slot, n)
             self._progress[slot] = done + n
-            self.stats["prefill_chunks"] += 1
+            self._stat["prefill_chunks"].inc()
+            self.telemetry.tracer.event(
+                "prefill_chunk", rid=req.rid, step=self._step_clock,
+                slot=slot, start=done, n=n)
             if self._progress[slot] == len(req._work):
                 # prompt fully resident: publish its full blocks to the
                 # prefix index (before _emit, which may free the slot)
                 # so the NEXT request sharing this prefix skips them
                 self.cache.register_prefix(slot, req._work)
+                self.telemetry.tracer.event(
+                    "prefill_done", rid=req.rid, step=self._step_clock,
+                    slot=slot)
                 # final chunk: its last-position logits yield the next
                 # token (== generate()'s prefill sample; on resume, the
                 # recomputed position is exactly the pre-eviction one)
@@ -471,7 +624,7 @@ class ServingEngine:
                     if req.evictions < self.max_evictions:
                         self._preempt(slot)
                     else:
-                        self.stats["evict_capped"] += 1
+                        self._stat["evict_capped"].inc()
                         logger.warning(
                             f"serving: request {req.rid} is eviction-"
                             f"pinned ({req.evictions} preemptions) and "
@@ -499,7 +652,11 @@ class ServingEngine:
             elapsed = time.perf_counter() - t0
             if elapsed > budget:
                 self._over_budget += 1
-                self.stats["watchdog_trips"] += 1
+                self._stat["watchdog_trips"].inc()
+                self.telemetry.tracer.event(
+                    "watchdog", step=self._step_clock,
+                    elapsed_s=round(elapsed, 6),
+                    strikes=self._over_budget)
                 if self._over_budget >= self.watchdog_grace:
                     # this step's tokens are still emitted below: raise
                     # AFTER bookkeeping (step() rethrows) so nothing is
@@ -511,7 +668,7 @@ class ServingEngine:
                         f"consecutive times — degraded")
             else:
                 self._over_budget = 0
-        self.stats["decode_steps"] += 1
+        self._stat["decode_steps"].inc()
         for i in live:
             self.cache.advance(i, 1)
             self._emit(i, self.slots[i], logits[i:i + 1], now)
@@ -534,7 +691,7 @@ class ServingEngine:
                 if attempt >= self.max_retries:
                     raise
                 attempt += 1
-                self.stats["retries"] += 1
+                self._stat["retries"].inc()
                 pause = min(delay + self.faults.jitter(delay * 0.5), 0.5)
                 logger.warning(
                     f"serving: transient device error at {site} "
@@ -545,10 +702,30 @@ class ServingEngine:
 
     def _update_backpressure(self) -> None:
         if self.max_queue:
-            self.stats["backpressure"] = round(
-                len(self.queue) / self.max_queue, 4)
+            self._stat["backpressure"].set(round(
+                len(self.queue) / self.max_queue, 4))
         else:
-            self.stats["backpressure"] = 0.0
+            self._stat["backpressure"].set(0.0)
+
+    def _sync_devices(self) -> None:
+        """Sampled-step barrier (utils/timer device-sync discipline):
+        drain pending pool work so a breakdown lap bills device time to
+        the phase that dispatched it. Only the breakdown calls this,
+        and only on sampled steps — the unsampled hot path stays
+        sync-free (dslint DS001)."""
+        jax.block_until_ready((self.cache.k, self.cache.v))
+
+    def _sample_gauges(self) -> None:
+        """Sampled-step gauge refresh: HBM block states + prefix hit
+        rate. Host numpy reductions — cheap, but they ride the
+        breakdown's sampling cadence, not every step."""
+        self._g_held.set(int(self.cache.held_blocks))
+        self._g_cached.set(int(self.cache.cached_blocks))
+        self._g_free.set(int(self.cache.free_blocks))
+        admitted = self._stat["admitted"].value
+        self._g_hit_rate.set(
+            round(self._stat["prefix_hits"].value / admitted, 4)
+            if admitted else 0.0)
 
     def _degraded(self, message: str) -> DegradedError:
         return DegradedError(
@@ -567,18 +744,28 @@ class ServingEngine:
         self.slots[slot] = None
         self.finished.append(req)
         if state == "timeout":
-            self.stats["timeouts"] += 1
+            self._stat["timeouts"].inc()
         else:
-            self.stats["completed"] += 1
+            self._stat["completed"].inc()
+        self.telemetry.tracer.event(
+            "finish", rid=req.rid, step=self._step_clock, slot=slot,
+            state=state, generated=len(req.out))
 
     def _emit(self, slot: int, req: ServeRequest, logits, now: float) -> None:
         self._rng, r = jax.random.split(self._rng)
         tok = int(np.asarray(self.engine._sample(
             logits, r, self.temperature, self.top_k))[0])
+        prev = req.token_times[-1] if req.token_times else None
         req.out.append(tok)
         req.token_times.append(now)
         if req.first_token_at is None:
             req.first_token_at = now
+            if self._h_ttft is not None and req.submitted_at is not None:
+                self._h_ttft.observe(max(0.0, now - req.submitted_at))
+            self.telemetry.tracer.event(
+                "first_token", rid=req.rid, step=self._step_clock, slot=slot)
+        elif self._h_tpot is not None and prev is not None:
+            self._h_tpot.observe(max(0.0, now - prev))
         if (len(req.out) >= req.max_new_tokens
                 or (req.eos_id is not None and tok == req.eos_id)):
             self._finish(slot, req, now)
@@ -601,7 +788,7 @@ class ServingEngine:
                 victim = i
         if victim is None:
             if capped:
-                self.stats["evict_capped"] += capped
+                self._stat["evict_capped"].inc(capped)
             return False
         self._preempt(victim)
         return True
@@ -616,7 +803,10 @@ class ServingEngine:
         req._work = req.tokens
         req.state = "queued"
         req.evictions += 1
-        self.stats["evictions"] += 1
+        self._stat["evictions"].inc()
+        self.telemetry.tracer.event(
+            "evict", rid=req.rid, step=self._step_clock, slot=slot,
+            generated=len(req.out))
         self.cache.free(slot)
         self.slots[slot] = None
         self.queue.appendleft(req)

@@ -1,0 +1,106 @@
+"""Serving telemetry — request-lifecycle tracing, a metrics registry
+with Prometheus/Perfetto exporters, and a sampled step-time breakdown.
+
+The reproduction's analog of the reference's engine-owned monitoring
+(deepspeed/monitor/* + the flops profiler), at serving granularity: an
+iteration-level scheduler is exactly the system where aggregate
+counters hide what matters (per-request queue wait, TTFT, TPOT,
+eviction/COW/retry timelines), so this package gives the
+:class:`~deepspeed_tpu.inference.serving.ServingEngine` a first-class
+observability plane — see docs/OBSERVABILITY.md for the metric catalog,
+trace schema and overhead notes.
+
+Three pieces, one facade:
+
+- :class:`~deepspeed_tpu.telemetry.metrics.MetricsRegistry` — counters,
+  gauges, fixed-bucket histograms; exports Prometheus text exposition
+  and Monitor-compatible scalar tuples;
+- :class:`~deepspeed_tpu.telemetry.tracer.RequestTracer` — ring-
+  buffered host-side lifecycle events; exports Chrome-trace/Perfetto
+  JSON (``tools/trace_analyze.py serve <file>`` reads it);
+- :class:`~deepspeed_tpu.telemetry.breakdown.StepBreakdown` — sampled
+  per-phase step timing under the ``utils/timer.py`` device-sync
+  discipline.
+
+Enablement mirrors the prefix-cache knob: explicit ``telemetry=`` on
+``ServingEngine`` wins, else ``DS_TELEMETRY=on|off`` (default OFF — the
+off path swaps in constant-time no-op twins, so the hot loop pays one
+attribute access per call site and the compile/parity contracts are
+byte-identical either way).
+"""
+
+import os
+import time
+from typing import Optional
+
+from deepspeed_tpu.telemetry.breakdown import (NoopBreakdown, PHASES,
+                                               StepBreakdown)
+from deepspeed_tpu.telemetry.metrics import (Counter, DEFAULT_BUCKETS,
+                                             Gauge, Histogram,
+                                             MetricsRegistry)
+from deepspeed_tpu.telemetry.tracer import NoopTracer, RequestTracer
+
+__all__ = ["Telemetry", "NoopTelemetry", "NOOP", "resolve_telemetry",
+           "MetricsRegistry", "Counter", "Gauge", "Histogram",
+           "RequestTracer", "NoopTracer", "StepBreakdown",
+           "NoopBreakdown", "PHASES", "DEFAULT_BUCKETS"]
+
+
+def resolve_telemetry(flag: Optional[bool] = None) -> bool:
+    """Explicit flag wins; else the ``DS_TELEMETRY`` env knob; default
+    off (the no-op plane is the bit-reference)."""
+    if flag is not None:
+        return bool(flag)
+    v = os.environ.get("DS_TELEMETRY", "")  # dslint: disable=DS005 — documented serving knob, resolved once at engine construction and overridable per ServingEngine
+    return v.strip().lower() in ("1", "on", "true", "yes")
+
+
+class Telemetry:
+    """Live bundle: one registry + one tracer + one breakdown, shared
+    by everything a single :class:`ServingEngine` emits. Pass an
+    instance to several engines to aggregate, or one per engine to
+    keep timelines separate."""
+
+    enabled = True
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 trace_capacity: int = 65536, sample_every: int = 16,
+                 clock=time.perf_counter):
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.tracer = RequestTracer(capacity=trace_capacity, clock=clock)
+        self.breakdown = StepBreakdown(self.registry, self.tracer,
+                                       sample_every=sample_every)
+
+    # convenience exporters -------------------------------------------
+    def to_prometheus(self) -> str:
+        return self.registry.to_prometheus()
+
+    def export_trace(self, path: str) -> str:
+        return self.tracer.export(path)
+
+    def to_scalars(self, step: int):
+        return self.registry.to_scalars(step)
+
+
+class NoopTelemetry:
+    """Off-mode bundle: no registry (the engine keeps a private one for
+    the stats view), no recording, no sampling."""
+
+    enabled = False
+    registry = None
+
+    def __init__(self):
+        self.tracer = NoopTracer()
+        self.breakdown = NoopBreakdown()
+
+    def to_prometheus(self) -> str:
+        return ""
+
+    def export_trace(self, path: str) -> str:
+        return self.tracer.export(path)
+
+    def to_scalars(self, step: int):
+        return []
+
+
+NOOP = NoopTelemetry()

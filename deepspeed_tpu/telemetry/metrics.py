@@ -1,0 +1,210 @@
+"""Metrics registry — counters, gauges, fixed-bucket histograms.
+
+Serving-side analog of the reference's engine-owned monitor
+(deepspeed/monitor/*): one registry instance owns every metric the
+scheduler emits, and two exporters turn it into the formats the rest of
+the stack consumes — Prometheus text exposition (``to_prometheus``) for
+scrape endpoints, and ``to_scalars`` tuples for
+:class:`deepspeed_tpu.utils.monitor.Monitor` so training and serving
+share one scalar sink.
+
+Design constraints (docs/OBSERVABILITY.md):
+
+- **host-side only** — observing a value is a dict lookup plus an int
+  add; nothing here touches jax, so the registry can sit inside the
+  scheduler hot loop without violating the dslint DS001 contract;
+- **fixed buckets** — histograms bucket at observe time into
+  preallocated cumulative-friendly counts (no per-observation
+  allocation, no unbounded reservoir), and percentiles are estimated by
+  linear interpolation inside the owning bucket — the classic
+  Prometheus ``histogram_quantile`` math, reproduced host-side so
+  ``infer_bench`` rows do not need a scrape cycle;
+- **unit-agnostic** — serving clocks are caller-supplied (step index in
+  tests, ``perf_counter`` seconds in the bench), so the default bucket
+  ladder spans both regimes log-spaced.
+"""
+
+from bisect import bisect_left
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# log-ish ladder covering sub-millisecond wall clocks AND integer step
+# clocks: 1-2.5-5 decades from 100us to 250 units
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 1e-2, 2.5e-2, 5e-2,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0)
+
+
+def _fmt(v) -> str:
+    """Prometheus sample formatting: integral values render without the
+    trailing ``.0`` so counter lines stay the conventional ``name 42``."""
+    f = float(v)
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+class Counter:
+    """Monotonic counter. ``value`` stays an int while fed ints (the
+    serving stats view compares against ints in tests)."""
+    __slots__ = ("name", "help", "value")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self.value = 0
+
+    def inc(self, n=1) -> None:
+        self.value += n
+
+
+class Gauge:
+    """Last-write-wins instantaneous value."""
+    __slots__ = ("name", "help", "value")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self.value = 0.0
+
+    def set(self, v) -> None:
+        self.value = v
+
+
+class Histogram:
+    """Fixed-bucket histogram with Prometheus ``le`` (inclusive upper
+    bound) semantics; the last bucket is the implicit ``+Inf`` overflow.
+    ``percentile`` linearly interpolates inside the owning bucket and
+    clamps the overflow bucket to the largest observed value, so an
+    estimate never exceeds reality."""
+    __slots__ = ("name", "help", "uppers", "counts", "sum", "count",
+                 "_vmax")
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: Optional[Sequence[float]] = None):
+        self.name = name
+        self.help = help
+        ups = tuple(sorted(float(b) for b in
+                           (DEFAULT_BUCKETS if buckets is None else buckets)))
+        if not ups:
+            raise ValueError(f"histogram {name}: needs >= 1 finite bucket")
+        self.uppers = ups
+        self.counts = [0] * (len(ups) + 1)   # [+ overflow]
+        self.sum = 0.0
+        self.count = 0
+        self._vmax = 0.0
+
+    def observe(self, v) -> None:
+        v = float(v)
+        self.counts[bisect_left(self.uppers, v)] += 1
+        self.sum += v
+        self.count += 1
+        if v > self._vmax:
+            self._vmax = v
+
+    def percentile(self, q: float) -> float:
+        """Estimate the q-th percentile (q in [0, 100]) from the bucket
+        counts — same interpolation as PromQL histogram_quantile."""
+        if self.count == 0:
+            return 0.0
+        target = (q / 100.0) * self.count
+        cum = 0
+        lo = 0.0
+        for i, ub in enumerate(self.uppers):
+            c = self.counts[i]
+            if c and cum + c >= target:
+                frac = min(max((target - cum) / c, 0.0), 1.0)
+                return min(lo + (ub - lo) * frac, self._vmax)
+            cum += c
+            lo = ub
+        return self._vmax      # lives in the overflow bucket
+
+    def summary(self) -> Dict[str, float]:
+        """p50/p95/p99 digest — the shape Monitor.write_scalars expands
+        into ``tag/p50`` style sub-scalars."""
+        return {"p50": self.percentile(50), "p95": self.percentile(95),
+                "p99": self.percentile(99),
+                "mean": self.sum / self.count if self.count else 0.0,
+                "count": float(self.count)}
+
+
+class MetricsRegistry:
+    """Name -> metric map with get-or-create accessors (re-requesting a
+    name returns the same instance, so serving phases and exporters
+    never race on registration order)."""
+
+    def __init__(self):
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        c = self._counters.get(name)
+        if c is None:
+            c = self._counters[name] = Counter(name, help)
+        return c
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        g = self._gauges.get(name)
+        if g is None:
+            g = self._gauges[name] = Gauge(name, help)
+        return g
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+        h = self._histograms.get(name)
+        if h is None:
+            h = self._histograms[name] = Histogram(name, help, buckets)
+        return h
+
+    def names(self) -> List[str]:
+        return (list(self._counters) + list(self._gauges)
+                + list(self._histograms))
+
+    # -- exporters -----------------------------------------------------
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition (version 0.0.4): HELP/TYPE headers
+        per family, cumulative ``_bucket{le=...}`` series + ``_sum`` /
+        ``_count`` for histograms."""
+        out: List[str] = []
+        for c in self._counters.values():
+            if c.help:
+                out.append(f"# HELP {c.name} {c.help}")
+            out.append(f"# TYPE {c.name} counter")
+            out.append(f"{c.name} {_fmt(c.value)}")
+        for g in self._gauges.values():
+            if g.help:
+                out.append(f"# HELP {g.name} {g.help}")
+            out.append(f"# TYPE {g.name} gauge")
+            out.append(f"{g.name} {_fmt(g.value)}")
+        for h in self._histograms.values():
+            if h.help:
+                out.append(f"# HELP {h.name} {h.help}")
+            out.append(f"# TYPE {h.name} histogram")
+            cum = 0
+            for i, ub in enumerate(h.uppers):
+                cum += h.counts[i]
+                out.append(f'{h.name}_bucket{{le="{_fmt(ub)}"}} {cum}')
+            out.append(f'{h.name}_bucket{{le="+Inf"}} {h.count}')
+            out.append(f"{h.name}_sum {_fmt(h.sum)}")
+            out.append(f"{h.name}_count {h.count}")
+        return "\n".join(out) + "\n"
+
+    def snapshot(self) -> Dict[str, Dict]:
+        """Plain-data dump (bench rows, DegradedError attachments)."""
+        return {
+            "counters": {n: c.value for n, c in self._counters.items()},
+            "gauges": {n: g.value for n, g in self._gauges.items()},
+            "histograms": {n: h.summary()
+                           for n, h in self._histograms.items()},
+        }
+
+    def to_scalars(self, step: int) -> List[Tuple[str, object, int]]:
+        """``(tag, value, step)`` tuples for Monitor.write_scalars —
+        histogram entries carry their summary dict, which the monitor
+        expands into ``tag/p50`` etc."""
+        out: List[Tuple[str, object, int]] = []
+        for n, c in self._counters.items():
+            out.append((n, c.value, step))
+        for n, g in self._gauges.items():
+            out.append((n, g.value, step))
+        for n, h in self._histograms.items():
+            out.append((n, h.summary(), step))
+        return out

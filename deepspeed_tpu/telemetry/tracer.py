@@ -1,0 +1,249 @@
+"""Request-lifecycle tracer — a host-side ring buffer of scheduler
+events, exportable as a Chrome-trace / Perfetto JSON timeline.
+
+Every request transition the scheduler makes lands here as one record:
+``enqueue`` → ``admit`` (tagged with the prefix match and any COW) →
+``prefill_chunk``* → ``prefill_done`` → ``first_token`` → ``evict`` /
+re-``admit`` → ``finish`` (state done/timeout/shed), plus scheduler-
+lane records (``step_phase`` breakdowns, ``watchdog``, ``degraded``)
+and ``fault`` records streamed in from
+:class:`deepspeed_tpu.utils.faults.FaultInjector` listeners — so a
+seeded chaos run replays as a single ordered timeline
+(docs/OBSERVABILITY.md has the schema, docs/ROBUSTNESS.md the chaos
+cross-reference).
+
+The buffer is a preallocated ring of fixed capacity: recording is one
+tuple build + indexed store (no growth, no I/O, no device work), old
+records are overwritten once the ring wraps (``dropped`` counts them),
+and nothing is serialized until :meth:`export` — so the tracer can sit
+inside the scheduler hot loop without breaking the DS001 sync-free
+contract or the zero-recompile CompileWatch pin.
+
+Export builds per-request lifecycle SPANS from the point records: a
+``queued`` span per enqueue→admit interval, ``prefill`` per
+admit→prefill_done, ``decode`` per prefill_done→(finish|evict); an
+evicted request simply opens a new queued span, so a preempted
+lifecycle shows up as repeated queued/prefill/decode triples on one
+timeline row. Faults and scheduler phases ride along as instant/slice
+events on the scheduler row (tid 0).
+"""
+
+import json
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+# record layout: (ts, etype, rid, step, slot, data-dict-or-None)
+_TS, _ETYPE, _RID, _STEP, _SLOT, _DATA = range(6)
+
+# lifecycle phases, in the order a healthy request traverses them
+SPAN_QUEUED = "queued"
+SPAN_PREFILL = "prefill"
+SPAN_DECODE = "decode"
+
+
+class RequestTracer:
+    """Ring-buffered event recorder. ``event()`` is the only hot-path
+    entry point; everything else is export-time."""
+
+    enabled = True
+
+    def __init__(self, capacity: int = 65536,
+                 clock: Callable[[], float] = time.perf_counter):
+        self.capacity = int(capacity)
+        if self.capacity <= 0:
+            raise ValueError("tracer capacity must be positive")
+        self._clock = clock
+        self._buf: List[Optional[tuple]] = [None] * self.capacity
+        self._n = 0          # total records ever written
+
+    # -- recording (hot path) ------------------------------------------
+    def event(self, etype: str, rid: Any = None, step: int = -1,
+              slot: int = -1, **data) -> None:
+        self._buf[self._n % self.capacity] = (
+            self._clock(), etype, rid, step, slot, data or None)
+        self._n += 1
+
+    # -- inspection ----------------------------------------------------
+    @property
+    def dropped(self) -> int:
+        return max(0, self._n - self.capacity)
+
+    def records(self) -> List[tuple]:
+        """Surviving records, oldest first."""
+        if self._n <= self.capacity:
+            return [r for r in self._buf[:self._n]]
+        head = self._n % self.capacity
+        return self._buf[head:] + self._buf[:head]
+
+    def events_of(self, rid: Any) -> List[tuple]:
+        return [r for r in self.records() if r[_RID] == rid]
+
+    def reset(self) -> None:
+        self._buf = [None] * self.capacity
+        self._n = 0
+
+    # -- export --------------------------------------------------------
+    def to_chrome_trace(self) -> Dict:
+        """Chrome-trace/Perfetto JSON object. pid 1 is the serving
+        process; tid 0 the scheduler lane (step phases, faults,
+        watchdog); tids 1.. one lane per request in first-seen order.
+        Request lifecycles become ``ph: "X"`` complete events; faults
+        and terminal states become ``ph: "i"`` instants; sampled step
+        occupancy becomes a ``ph: "C"`` counter track."""
+        recs = self.records()
+        events: List[Dict] = [
+            {"ph": "M", "pid": 1, "name": "process_name",
+             "args": {"name": "deepspeed_tpu.serving"}},
+            {"ph": "M", "pid": 1, "tid": 0, "name": "thread_name",
+             "args": {"name": "scheduler"}},
+        ]
+        if not recs:
+            return {"traceEvents": events, "displayTimeUnit": "ms",
+                    "dropped_events": 0}
+        t0 = recs[0][_TS]
+
+        def us(ts: float) -> float:
+            return round((ts - t0) * 1e6, 3)
+
+        tids: Dict[Any, int] = {}
+
+        def tid_of(rid: Any) -> int:
+            t = tids.get(rid)
+            if t is None:
+                t = tids[rid] = len(tids) + 1
+                events.append({"ph": "M", "pid": 1, "tid": t,
+                               "name": "thread_name",
+                               "args": {"name": f"req {rid}"}})
+            return t
+
+        # open[rid] = (span_name, start_ts, start_args)
+        open_span: Dict[Any, tuple] = {}
+
+        def close(rid: Any, ts: float, extra: Optional[Dict] = None) -> None:
+            sp = open_span.pop(rid, None)
+            if sp is None:
+                return
+            name, start, args = sp
+            a = {"rid": str(rid)}
+            a.update(args or {})
+            a.update(extra or {})
+            events.append({"ph": "X", "pid": 1, "tid": tid_of(rid),
+                           "cat": "request", "name": name,
+                           "ts": us(start), "dur": us(ts) - us(start),
+                           "args": a})
+
+        for ts, etype, rid, step, slot, data in recs:
+            data = data or {}
+            if etype == "enqueue":
+                close(rid, ts)           # defensive: rid reuse
+                open_span[rid] = (SPAN_QUEUED, ts, {})
+            elif etype == "admit":
+                close(rid, ts)
+                open_span[rid] = (SPAN_PREFILL, ts, {
+                    "slot": slot,
+                    "prefix_hit": bool(data.get("matched", 0)),
+                    "matched_tokens": data.get("matched", 0)})
+            elif etype == "prefill_done":
+                close(rid, ts)
+                open_span[rid] = (SPAN_DECODE, ts, {"slot": slot})
+            elif etype == "evict":
+                close(rid, ts, {"evicted": True})
+                open_span[rid] = (SPAN_QUEUED, ts, {"requeued": True})
+                events.append({"ph": "i", "pid": 1, "tid": tid_of(rid),
+                               "cat": "request", "name": "evict",
+                               "ts": us(ts), "s": "t",
+                               "args": {"rid": str(rid), "slot": slot,
+                                        "step": step}})
+            elif etype == "finish":
+                state = data.get("state", "done")
+                # a request shed/timed out straight from the queue (or a
+                # prefill-final-chunk finish) closes whatever span is open
+                if rid not in open_span:
+                    open_span[rid] = (SPAN_QUEUED, ts, {})
+                close(rid, ts, {"state": state})
+                events.append({"ph": "i", "pid": 1, "tid": tid_of(rid),
+                               "cat": "request", "name": f"finish:{state}",
+                               "ts": us(ts), "s": "t",
+                               "args": {"rid": str(rid), "step": step,
+                                        "generated":
+                                            data.get("generated", 0)}})
+            elif etype == "step_phase":
+                # consecutive slices on the scheduler lane, one per phase
+                start = ts - data.get("total_s", 0.0)
+                for ph in ("admission", "prefill", "decode", "bookkeeping"):
+                    d = data.get(f"{ph}_s")
+                    if d is None:
+                        continue
+                    events.append({"ph": "X", "pid": 1, "tid": 0,
+                                   "cat": "step", "name": ph,
+                                   "ts": us(start), "dur": round(d * 1e6, 3),
+                                   "args": {"step": step}})
+                    start += d
+                if "occupancy" in data:
+                    events.append({"ph": "C", "pid": 1, "name": "occupancy",
+                                   "ts": us(ts),
+                                   "args": {"slots": data["occupancy"]}})
+            elif etype == "fault":
+                events.append({"ph": "i", "pid": 1, "tid": 0,
+                               "cat": "fault",
+                               "name": f"fault:{data.get('site')}:"
+                                       f"{data.get('kind')}",
+                               "ts": us(ts), "s": "g",
+                               "args": {"site": data.get("site"),
+                                        "kind": data.get("kind"),
+                                        "visit": data.get("visit"),
+                                        "step": step}})
+            else:
+                # first_token, prefill_chunk, cow, cache_evict_block,
+                # watchdog, degraded, ... — instant on the owning lane
+                tid = tid_of(rid) if rid is not None else 0
+                a = {"step": step}
+                if rid is not None:
+                    a["rid"] = str(rid)
+                a.update(data)
+                events.append({"ph": "i", "pid": 1, "tid": tid,
+                               "cat": "scheduler", "name": etype,
+                               "ts": us(ts), "s": "t", "args": a})
+        # whatever is still open at export time renders as in-flight
+        last = recs[-1][_TS]
+        for rid in list(open_span):
+            close(rid, last, {"in_flight": True})
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "dropped_events": self.dropped}
+
+    def export(self, path: str) -> str:
+        """Write the Chrome-trace JSON to ``path`` (load it in Perfetto
+        / chrome://tracing, or ``tools/trace_analyze.py serve <path>``)."""
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+        return path
+
+
+class NoopTracer:
+    """DS_TELEMETRY=off twin: every entry point is a constant-time
+    no-op, so the scheduler's call sites need no branching."""
+
+    enabled = False
+    capacity = 0
+    dropped = 0
+
+    def event(self, etype, rid=None, step=-1, slot=-1, **data) -> None:
+        pass
+
+    def events_of(self, rid) -> List[tuple]:
+        return []
+
+    def records(self) -> List[tuple]:
+        return []
+
+    def reset(self) -> None:
+        pass
+
+    def to_chrome_trace(self) -> Dict:
+        return {"traceEvents": [], "displayTimeUnit": "ms",
+                "dropped_events": 0}
+
+    def export(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+        return path

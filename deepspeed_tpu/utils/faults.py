@@ -150,6 +150,19 @@ class FaultInjector:
         self.rng = np.random.default_rng(self.seed)
         self.visits: Dict[str, int] = {}
         self.fired: List[Tuple[str, str, int]] = []
+        # observers notified on every fired fault (before the kind
+        # acts, so a raise still reaches them): telemetry tracers tag
+        # chaos events into the request-lifecycle timeline here
+        self._listeners: List = []
+
+    def add_listener(self, cb) -> None:
+        """Register ``cb(site, kind, visit)``, called on every fired
+        fault (including ones that then raise)."""
+        self._listeners.append(cb)
+
+    def remove_listener(self, cb) -> None:
+        if cb in self._listeners:
+            self._listeners.remove(cb)
 
     @classmethod
     def from_env(cls, env=None) -> "FaultInjector":
@@ -168,6 +181,8 @@ class FaultInjector:
         for f in self.faults:
             if f.site == site and f.matches(n):
                 self.fired.append((site, f.kind, n))
+                for cb in self._listeners:
+                    cb(site, f.kind, n)
                 return f
         return None
 

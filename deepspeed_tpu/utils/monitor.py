@@ -17,6 +17,7 @@ import atexit
 import csv
 import json
 import os
+from collections.abc import Mapping as MappingABC
 from typing import Dict, List, Optional, Tuple
 
 from deepspeed_tpu.utils.logging import logger
@@ -31,7 +32,7 @@ def _try_tensorboard_writer(log_dir: str):
     try:
         from tensorboardX import SummaryWriter
         return SummaryWriter(log_dir=log_dir)
-    except Exception:
+    except Exception:  # dslint: disable=DS006 — optional tensorboard backend probe (tensorboardX fallback)
         return None
 
 
@@ -80,7 +81,15 @@ class Monitor:
 
     def write_scalars(self,
                       scalars: List[Tuple[str, float, int]]) -> None:
+        """``(tag, value, step)`` tuples. A value may also be a
+        histogram summary mapping (p50/p95/p99/... as emitted by
+        ``telemetry.MetricsRegistry.to_scalars``): it expands into
+        ``tag/p50`` style sub-scalars, so serving latency digests and
+        training losses share this one sink."""
         if not self.enabled or not scalars:
+            return
+        scalars = self._expand_summaries(scalars)
+        if not scalars:
             return
         if self._tb is not None:
             for tag, value, step in scalars:
@@ -90,6 +99,17 @@ class Monitor:
                 f.write(json.dumps({"tag": tag, "value": float(value),
                                     "step": int(step)}) + "\n")
         self._write_csv_row(scalars)
+
+    @staticmethod
+    def _expand_summaries(scalars) -> List[Tuple[str, float, int]]:
+        flat: List[Tuple[str, float, int]] = []
+        for tag, value, step in scalars:
+            if isinstance(value, MappingABC):
+                flat.extend((f"{tag}/{k}", float(v), step)
+                            for k, v in value.items())
+            else:
+                flat.append((tag, float(value), step))
+        return flat
 
     def _write_csv_row(self, scalars) -> None:
         tags = [t for t, _, _ in scalars]

@@ -25,6 +25,13 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # tier-1 CI deselects with `-m 'not slow'`; the gate's full mode
+    # runs everything
+    config.addinivalue_line(
+        "markers", "slow: heavy end-to-end test, excluded from tier-1")
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()

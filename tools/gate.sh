@@ -41,8 +41,9 @@ elif [[ "${1:-}" == "quick" ]]; then
     fi
     # changed TEST files run as-is; changed source files map to test
     # files by name heuristic; plus the always-on smoke set
-    # (engine/config/gpt cover the load-bearing core)
-    tests="tests/test_engine.py tests/test_config.py tests/test_gpt.py"
+    # (engine/config/gpt cover the load-bearing core; telemetry guards
+    # the serving observability plane and its no-op contract)
+    tests="tests/test_engine.py tests/test_config.py tests/test_gpt.py tests/test_telemetry.py"
     tests="$tests $(git diff --name-only --diff-filter=d HEAD -- 'tests/test_*.py' | tr '\n' ' ')"
     changed=$(git diff --name-only --diff-filter=d HEAD -- 'deepspeed_tpu/**.py' \
               | xargs -rn1 basename | sed 's/\.py$//')
@@ -66,6 +67,14 @@ else
         DS_PREFIX_CACHE=$pc python -m pytest tests/test_serving.py \
             tests/test_prefix_cache.py -q
     done
+    # telemetry knob smoke: the suite default leaves DS_TELEMETRY unset
+    # (= off, the bit-reference no-op plane), so run the serving suites
+    # once with tracing/metrics/breakdown forced ON — greedy parity and
+    # the zero-recompile contract must hold either way
+    # (docs/OBSERVABILITY.md)
+    echo "gate: serving smoke (DS_TELEMETRY=on)"
+    DS_TELEMETRY=on python -m pytest tests/test_serving.py \
+        tests/test_telemetry.py tests/test_chaos.py -q
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 fi
 echo "gate: green"

@@ -185,9 +185,9 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
                   int8=False, int8_fused=False, seed=0, decode_impl=None,
                   prefix_cache=None, shared_prefix_len=0, emit=True):
     """Continuous-batching serving row: synthetic Poisson arrivals driven
-    through ServingEngine.step, wall-clock tokens/s, per-token (TPOT)
-    latency percentiles from the scheduler's token timestamps, decode-
-    slot utilization, and the paged-vs-static KV HBM accounting.
+    through ServingEngine.step, wall-clock tokens/s, TTFT/TPOT latency
+    percentiles from the telemetry registry's histograms, decode-slot
+    utilization, and the paged-vs-static KV HBM accounting.
 
     Arrivals are in SCHEDULER-STEP units (deterministic under ``seed``):
     request i is submitted before the first step >= its exponential-gap
@@ -209,6 +209,7 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
     from deepspeed_tpu.models import gpt
     import deepspeed_tpu
     from deepspeed_tpu.inference.serving import ServeRequest, ServingEngine
+    from deepspeed_tpu.telemetry import Telemetry
 
     on_tpu = "tpu" in (jax.devices()[0].platform +
                        jax.devices()[0].device_kind).lower()
@@ -229,9 +230,13 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
     eng = deepspeed_tpu.init_inference(
         model=(cfg, gpt.init_params(jax.random.PRNGKey(0), cfg)),
         dtype=jnp.int8 if int8 else act_dtype)
+    # telemetry on for the timed drive: the latency columns come from
+    # the registry's TTFT/TPOT histograms (scheduler clock = perf_counter
+    # seconds here), not from ad-hoc timestamp lists
     srv = ServingEngine(eng, num_slots=num_slots, block_size=block_size,
                         num_blocks=num_blocks, prefill_chunk=prefill_chunk,
-                        decode_impl=decode_impl, prefix_cache=prefix_cache)
+                        decode_impl=decode_impl, prefix_cache=prefix_cache,
+                        telemetry=Telemetry())
 
     rng = np.random.default_rng(seed)
     arrive = np.floor(np.cumsum(
@@ -270,9 +275,8 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
         step += 1
     wall_s = time.perf_counter() - t0
 
-    tpot_ms = np.concatenate(
-        [np.diff(r.token_times) for r in srv.finished
-         if len(r.token_times) > 1]) * 1e3
+    ttft_h = srv.metrics.histogram("serving_ttft")
+    tpot_h = srv.metrics.histogram("serving_tpot")
     gen_tokens = sum(len(r.out) for r in srv.finished)
     st = srv.stats
     cache = srv.cache
@@ -286,8 +290,11 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
         "num_slots": num_slots, "block_size": block_size,
         "decode_impl": srv.decode_impl,
         "tokens_per_s": round(gen_tokens / wall_s, 1),
-        "tpot_ms_p50": round(float(np.percentile(tpot_ms, 50)), 3),
-        "tpot_ms_p99": round(float(np.percentile(tpot_ms, 99)), 3),
+        "tpot_ms_p50": round(tpot_h.percentile(50) * 1e3, 3),
+        "tpot_ms_p99": round(tpot_h.percentile(99) * 1e3, 3),
+        "ttft_p50_ms": round(ttft_h.percentile(50) * 1e3, 3),
+        "ttft_p99_ms": round(ttft_h.percentile(99) * 1e3, 3),
+        "tpot_p50_ms": round(tpot_h.percentile(50) * 1e3, 3),
         "mean_occupancy": round(st["occupancy_sum"]
                                 / max(st["decode_steps"], 1), 2),
         "peak_occupancy": st["peak_occupancy"],

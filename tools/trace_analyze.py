@@ -12,6 +12,10 @@ Usage:
         then analyzes it.
   python tools/trace_analyze.py read /tmp/dstrace
       — re-analyze an existing capture.
+  python tools/trace_analyze.py serve /tmp/serve_trace.json
+      — analyze a serving-telemetry Perfetto export
+        (deepspeed_tpu/telemetry, docs/OBSERVABILITY.md): per-request
+        lifecycle spans, step-phase breakdown, injected-fault timeline.
 """
 
 import collections
@@ -91,6 +95,69 @@ def analyze(log_dir: str, top: int = 25):
         print(f"{us/1e3:10.2f} ms  {100*us/max(total,1e-9):5.1f}%  {name[:110]}")
 
 
+def _load_trace(path: str) -> dict:
+    if path.endswith(".gz"):
+        with gzip.open(path, "rt") as f:
+            return json.load(f)
+    with open(path) as f:
+        return json.load(f)
+
+
+def analyze_serving_trace(path: str, quiet: bool = False) -> dict:
+    """Summarize a serving-telemetry Chrome-trace export
+    (``RequestTracer.to_chrome_trace``): per-request lifecycle span
+    sequences (queued/prefill/decode, terminal state), scheduler
+    step-phase totals, and the injected-fault timeline. Returns the
+    summary dict (tests assert on it); prints it unless ``quiet``."""
+    trace = _load_trace(path)
+    events = trace.get("traceEvents", [])
+    requests, phase_us, faults = {}, collections.Counter(), []
+    for e in events:
+        ph, cat = e.get("ph"), e.get("cat")
+        if ph == "X" and cat == "request":
+            rid = e.get("args", {}).get("rid")
+            requests.setdefault(rid, {"spans": [], "state": None,
+                                      "span_us": 0.0})
+            requests[rid]["spans"].append((e["ts"], e["name"]))
+            requests[rid]["span_us"] += e.get("dur", 0.0)
+            state = e.get("args", {}).get("state")
+            if state:
+                requests[rid]["state"] = state
+        elif ph == "X" and cat == "step":
+            phase_us[e["name"]] += e.get("dur", 0.0)
+        elif ph == "i" and cat == "fault":
+            faults.append(dict(e.get("args", {}), ts=e.get("ts")))
+    for r in requests.values():
+        r["spans"] = [name for _, name in sorted(r["spans"],
+                                                 key=lambda s: s[0])]
+    summary = {
+        "n_events": len(events),
+        "dropped_events": trace.get("dropped_events", 0),
+        "requests": requests,
+        "phase_us": {k: round(v, 1) for k, v in phase_us.items()},
+        "faults": faults,
+    }
+    if not quiet:
+        print(json.dumps({"trace": path, "n_events": len(events),
+                          "requests": len(requests),
+                          "faults": len(faults)}))
+        print("\n-- step phases (sampled) --")
+        total = sum(phase_us.values())
+        for name, us in phase_us.most_common():
+            print(f"{us/1e3:10.2f} ms  {100*us/max(total,1e-9):5.1f}%  {name}")
+        print("\n-- requests --")
+        for rid, r in requests.items():
+            print(f"  {rid}: {' > '.join(r['spans'])}"
+                  f"  [{r['state'] or 'in flight'}]"
+                  f"  {r['span_us']/1e3:.2f} ms")
+        if faults:
+            print("\n-- injected faults --")
+            for f in faults:
+                print(f"  step {f.get('step')}: {f.get('site')}"
+                      f":{f.get('kind')} (visit {f.get('visit')})")
+    return summary
+
+
 def run():
     import jax
     import numpy as np
@@ -132,5 +199,7 @@ def run():
 if __name__ == "__main__":
     if sys.argv[1:] and sys.argv[1] == "read":
         analyze(sys.argv[2])
+    elif sys.argv[1:] and sys.argv[1] == "serve":
+        analyze_serving_trace(sys.argv[2])
     else:
         run()
