@@ -31,7 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.models import gpt as gpt_lib
 from deepspeed_tpu.models.gpt import (GPTConfig, _dense,
-                                      _norm)
+                                      _norm, _qkv_split_rotary)
 from deepspeed_tpu.parallel import mesh as mesh_lib
 from deepspeed_tpu.parallel import sharding as sharding_lib
 from deepspeed_tpu.utils.logging import log_dist, logger
@@ -197,6 +197,46 @@ def _block_decode(x, k_cache, v_cache, pos, p, cfg: GPTConfig,
     return x + _ffn(h, p, cfg), k_cache, v_cache
 
 
+def _block_extend(x, k_cache, v_cache, pos, p, cfg: GPTConfig):
+    """Decode block for G new tokens at STATIC cache positions
+    [pos, pos+G) — the chunk-verify block of the static speculative path
+    (inference/speculative.py), shared here so the paged verify block
+    below and the static path dedupe one copy of the G-query decode
+    math. x: [B, G, D]; caches [B, S_max, Hkv, Dh]. Causality: query i
+    sees cache slots <= pos + i (its own prefix included)."""
+    B, G, D = x.shape
+    H, Dh = cfg.n_heads, cfg.head_dim
+    Hkv = cfg.kv_heads
+    group = H // Hkv
+    S_max = k_cache.shape[1]
+
+    h = _norm(x, p["ln1"], cfg)
+    qkv = _dense(h, p["qkv"])
+    q, k, v = _qkv_split_rotary(qkv, cfg, pos + jnp.arange(G), B, G)
+    k_cache = jax.lax.dynamic_update_slice_in_dim(k_cache, k, pos, axis=1)
+    v_cache = jax.lax.dynamic_update_slice_in_dim(v_cache, v, pos, axis=1)
+
+    qg = q.reshape(B, G, Hkv, group, Dh)
+    scores = jnp.einsum("bqkgd,bskd->bkgqs", qg,
+                        k_cache).astype(jnp.float32)
+    scores *= cfg.attn_scale if cfg.attn_scale is not None \
+        else 1.0 / np.sqrt(Dh)
+    idx = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, 1, S_max), 4)
+    qi = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, G, 1), 3)
+    scores = jnp.where(idx <= pos + qi, scores, -1e30)
+    if cfg.attn_window is not None:
+        scores = jnp.where(idx > pos + qi - cfg.attn_window, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    attn = jnp.einsum("bkgqs,bskd->bqkgd", probs, v_cache)
+    attn = attn.reshape(B, G, D)
+    attn = _dense(attn, p["attn_out"])
+    if cfg.parallel_residual:
+        return x + attn + _ffn(h, p, cfg), k_cache, v_cache
+    x = x + attn
+    h = _norm(x, p["ln2"], cfg)
+    return x + _ffn(h, p, cfg), k_cache, v_cache
+
+
 def _gather_blocks(pool, tables):
     """Gather a block pool [N, block, Hkv, Dh] through block tables
     [B, NB] into the virtual contiguous cache [B, NB*block, Hkv, Dh].
@@ -274,6 +314,73 @@ def _block_decode_paged(x, k_pool, v_pool, tables, lengths, active, p,
             scores = jnp.where(idx > pos - cfg.attn_window, scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
         attn = jnp.einsum("bkgs,bskd->bkgd", probs, vc).reshape(B, 1, D)
+    attn = _dense(attn, p["attn_out"])
+    if cfg.parallel_residual:
+        return x + attn + _ffn(h, p, cfg), k_pool, v_pool
+    x = x + attn
+    h = _norm(x, p["ln2"], cfg)
+    return x + _ffn(h, p, cfg), k_pool, v_pool
+
+
+def _block_verify_paged(x, k_pool, v_pool, tables, lengths, active, p,
+                        cfg: GPTConfig, impl: str = "gather"):
+    """One block for a G-token SPECULATIVE CHUNK per slot, K/V addressed
+    through block tables — the q_len>1 generalization of
+    _block_decode_paged for draft/verify serving. x: [B, G, D]; chunk
+    token i of slot b sits at cache position lengths[b] + i. The chunk's
+    K/V are scattered into the slot's CURRENT blocks before attention
+    (within-chunk causality is then just the position mask); after the
+    scheduler's accept/reject, ``lengths`` advances past the accepted
+    prefix only — stale rejected entries are overwritten by the next
+    chunk before any query can attend them, no copy needed.
+
+    Writes beyond the slot's allocated capacity (tokens_per_slot) route
+    to the trash block, mirroring _block_decode_paged: the scheduler
+    caps acceptance at the allocated capacity so logits from those
+    positions are never used."""
+    B, G, D = x.shape
+    H, Dh = cfg.n_heads, cfg.head_dim
+    Hkv = cfg.kv_heads
+    group = H // Hkv
+    bs = k_pool.shape[1]
+    NB = tables.shape[1]
+
+    h = _norm(x, p["ln1"], cfg)
+    qkv = _dense(h, p["qkv"])
+    pos = lengths[:, None] + jnp.arange(G, dtype=jnp.int32)[None]  # [B, G]
+    q, k, v = _qkv_split_rotary(qkv, cfg, pos, B, G)
+    qg = q.reshape(B, G, Hkv, group, Dh)
+
+    # scatter the chunk's K/V through the block table; out-of-capacity
+    # or inactive lanes land in trash block 0 (same belt-and-suspender
+    # as the one-token decode scatter)
+    in_cap = pos < NB * bs
+    blk = jnp.take_along_axis(tables, jnp.clip(pos // bs, 0, NB - 1),
+                              axis=1)                            # [B, G]
+    blk = jnp.where(jnp.logical_and(active[:, None], in_cap), blk, 0)
+    off = pos % bs
+    k_pool = k_pool.at[blk, off].set(k)
+    v_pool = v_pool.at[blk, off].set(v)
+
+    scale = cfg.attn_scale if cfg.attn_scale is not None \
+        else 1.0 / np.sqrt(Dh)
+    if impl == "pallas":
+        from deepspeed_tpu.ops.attention.paged import paged_verify_attention
+        attn = paged_verify_attention(
+            qg, k_pool, v_pool, tables, lengths, scale=float(scale),
+            window=cfg.attn_window).reshape(B, G, D)
+    else:
+        kc = _gather_blocks(k_pool, tables)  # [B, NB*bs, Hkv, Dh]
+        vc = _gather_blocks(v_pool, tables)
+        scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, kc).astype(jnp.float32)
+        scores *= scale
+        idx = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, 1, NB * bs), 4)
+        qpos = pos[:, None, None, :, None]
+        scores = jnp.where(idx <= qpos, scores, -1e30)
+        if cfg.attn_window is not None:
+            scores = jnp.where(idx > qpos - cfg.attn_window, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        attn = jnp.einsum("bkgqs,bskd->bqkgd", probs, vc).reshape(B, G, D)
     attn = _dense(attn, p["attn_out"])
     if cfg.parallel_residual:
         return x + attn + _ffn(h, p, cfg), k_pool, v_pool
@@ -449,6 +556,19 @@ class InferenceEngine:
             self._decode_slots = jax.jit(self._decode_slots_fn,
                                          donate_argnums=(1, 2),
                                          static_argnums=(7,))
+            # speculative verify: all k+1 chunk positions per slot in
+            # ONE extended-decode program — when serving runs with
+            # spec_decode on, this REPLACES the plain decode program in
+            # steady state (the chunk width G is fixed per serving
+            # engine, so one program serves every step)
+            self._verify_slots = jax.jit(self._verify_slots_fn,
+                                         donate_argnums=(1, 2),
+                                         static_argnums=(7,))
+            # static-path chunk verify (inference/speculative.py): the
+            # dense-cache twin of _verify_slots, kept here so the
+            # speculative module shares the engine's compiled program
+            # cache instead of duplicating the block math
+            self._extend = jax.jit(self._extend_fn, donate_argnums=(1,))
             # prefix-cache copy-on-write block copy: src/dst are traced
             # scalars, so every divergence reuses ONE compiled program
             # (warmed at ServingEngine construction — the steady-state
@@ -608,6 +728,60 @@ class InferenceEngine:
                                    (params["block"], k_pool, v_pool))
         return self._logits(params, x), ks, vs
 
+    def _verify_slots_fn(self, params, k_pool, v_pool, tables, lengths,
+                         tokens, active, impl="gather"):
+        """One speculative VERIFY step for every serving slot at once:
+        score all G chunk positions (pending token + G-1 draft tokens)
+        per slot in one compiled program. tokens: [B, G] (chunk token i
+        of slot b sits at cache position lengths[b] + i); returns logits
+        [B, G, V] + updated (donated) pools. The slot-batched shape and
+        the chunk width are static, so any mix of requests — across
+        eviction, requeue and prefix-cache hits — reuses this ONE
+        program; impl is a static jit argument exactly like
+        _decode_slots_fn."""
+        cfg = self.cfg
+        B, G = tokens.shape
+        x = params["wte"]["embedding"][tokens]
+        if cfg.use_wpe:
+            pos = lengths[:, None] + jnp.arange(G, dtype=jnp.int32)[None]
+            safe = jnp.clip(pos, 0, self.max_seq_len - 1)
+            x = x + params["wpe"]["embedding"][safe]
+
+        def body(x, layer):
+            layer_p, kp, vp = layer
+            y, kp, vp = _block_verify_paged(x, kp, vp, tables, lengths,
+                                            active, layer_p, cfg,
+                                            impl=impl)
+            return y, (kp, vp)
+
+        x, (ks, vs) = jax.lax.scan(body, x,
+                                   (params["block"], k_pool, v_pool))
+        return self._logits(params, x), ks, vs
+
+    def _extend_fn(self, params, cache, tokens, pos):
+        """G-token chunk verify over the STATIC dense cache (the
+        speculative.py path): logits [B, G, V] + updated cache.
+        tokens: [B, G]; pos: scalar first cache index of the chunk.
+        The paged twin is _verify_slots_fn."""
+        cfg = self.cfg
+
+        x = params["wte"]["embedding"][tokens]
+        if cfg.use_wpe:
+            G = tokens.shape[1]
+            x = x + jax.lax.dynamic_slice_in_dim(
+                params["wpe"]["embedding"], pos, G)[None]
+
+        def body(x, layer):
+            layer_p, kc, vc = layer
+            y, kc, vc = _block_extend(x, kc, vc, pos, layer_p, cfg)
+            return y, (kc, vc)
+
+        x, (ks, vs) = jax.lax.scan(body, x,
+                                   (params["block"], cache["k"],
+                                    cache["v"]))
+        logits = self._logits(params, x)
+        return logits, {"k": ks, "v": vs}
+
     def _cow_blocks_fn(self, k_pool, v_pool, src, dst):
         """Copy pool block ``src`` -> ``dst`` across every layer — the
         device half of prefix-cache copy-on-write (paged_cache._cow).
@@ -646,6 +820,23 @@ class InferenceEngine:
         from deepspeed_tpu.utils.faults import maybe_fire
         maybe_fire("engine.decode")
         return self._decode_slots(
+            self.params, k_pool, v_pool,
+            jnp.asarray(tables, jnp.int32),
+            jnp.asarray(lengths, jnp.int32),
+            jnp.asarray(tokens, jnp.int32), jnp.asarray(active, bool),
+            self.decode_impl if impl is None else impl)
+
+    def verify_slots(self, k_pool, v_pool, tables, lengths, tokens, active,
+                     impl=None):
+        """Speculative chunk verify for every serving slot (tokens:
+        [B, G] — each slot's pending token followed by its draft
+        proposals). The ``engine.verify`` fault site fires BEFORE the
+        dispatch touches the donated pools, so the serving engine can
+        degrade a faulted verify to a plain one-token decode against
+        intact buffers."""
+        from deepspeed_tpu.utils.faults import maybe_fire
+        maybe_fire("engine.verify")
+        return self._verify_slots(
             self.params, k_pool, v_pool,
             jnp.asarray(tables, jnp.int32),
             jnp.asarray(lengths, jnp.int32),

@@ -405,6 +405,42 @@ class PagedKVCache:
         self.peak_tokens_in_flight = max(self.peak_tokens_in_flight,
                                          self.tokens_in_flight)
 
+    def capacity_tokens(self, slot: int) -> int:
+        """Token positions the slot's allocated blocks cover — the cap
+        on how far a speculative chunk may advance before rollback."""
+        return len(self._owned[slot]) * self.block_size
+
+    def rollback(self, slot: int, n_tokens: int) -> None:
+        """Shrink the slot's logical length to ``n_tokens`` and RELEASE
+        any owned tail block the shorter length no longer covers — the
+        speculative-decode rollback contract: a rejected draft chunk
+        that straddled a block edge must not leave the now-unused tail
+        block referenced in the block table (it would silently pin a
+        pool block per reject until the request finished). Stale K/V
+        inside the kept partial block is safe: the next chunk rewrites
+        those positions before any query attends them.
+
+        Hardening: only a non-negative length within the currently
+        allocated capacity is a legal rollback target (growing is
+        ``advance``'s job), and only blocks this slot OWNS are released
+        — shared prefix blocks sit below the prompt boundary, which a
+        rollback can never cross (``n_tokens`` >= the pre-chunk length
+        >= the prompt length)."""
+        if not self.active[slot]:
+            raise ValueError(f"slot {slot} is not active")
+        n_tokens = int(n_tokens)
+        if not 0 <= n_tokens <= self.capacity_tokens(slot):
+            raise ValueError(
+                f"rollback target {n_tokens} outside the allocated "
+                f"capacity [0, {self.capacity_tokens(slot)}] of slot "
+                f"{slot}")
+        keep = self.blocks_for(n_tokens)
+        while len(self._owned[slot]) > keep:
+            bid = self._owned[slot].pop()
+            self.tables[slot, len(self._owned[slot])] = 0
+            self._release(bid)
+        self.lengths[slot] = n_tokens
+
     def free(self, slot: int) -> None:
         """Release the slot's references. Idempotent: freeing an already-
         free slot is a no-op (retry/requeue paths may race a finish).

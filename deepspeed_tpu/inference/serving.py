@@ -64,8 +64,30 @@ is chunk-boundary invariant (fixed-width chunks, gather over the full
 table, causal mask), so starting at a nonzero offset over shared blocks
 reproduces the cold logits bit-for-bit (tests/test_prefix_cache.py).
 
-The steady state is two compiled programs (prefill chunk, slot decode)
-regardless of arrival pattern; all scheduling state is host numpy. None
+Speculative decoding (``spec_decode=True`` / ``DS_SPEC_DECODE=on``,
+docs/SPECULATIVE.md): each decode iteration a DRAFTER (prompt-lookup
+n-grams by default — no second model) proposes ``spec_k`` tokens per
+live slot; one compiled verify program (``engine.verify_slots``) scores
+all ``spec_k + 1`` chunk positions per slot against the paged cache,
+and each slot independently accepts its longest draft prefix agreeing
+with the target's own greedy argmax, emitting ``accepted + 1`` tokens
+(the ``+1`` is the target's correction — the classic draft-verify
+free token). The first reject rolls the slot's cache back
+(``cache.rollback``): lengths shrink past the rejected suffix and tail
+blocks only that suffix touched return to the pool; stale K/V inside
+kept blocks is overwritten by the next chunk before any query attends
+it. Greedy-target-equality acceptance makes spec-on output BIT-
+IDENTICAL to spec-off greedy serving (tests/test_spec_serving.py pins
+this across eviction/requeue and prefix-cache hits); speculation only
+changes how many steps the same tokens take. An injected draft/verify
+fault degrades that step to the plain one-token path
+(``stats["spec_fallbacks"]``) — chaos turns speculation off, never
+output wrong.
+
+The steady state is two compiled programs (prefill chunk, slot decode —
+with speculation on, the ``spec_k + 1``-position verify program REPLACES
+slot decode) regardless of arrival pattern; all scheduling state is
+host numpy. None
 of the robustness paths (deadlines, shedding, backoff, expiry) touch
 device shapes, so the compile-count contract is unchanged — pinned by
 ``test_serving_compile_count_contract`` and its chaos twin. The prefix
@@ -103,8 +125,12 @@ import numpy as np
 from deepspeed_tpu.inference.paged_cache import (CacheExhausted,
                                                  PagedKVCache,
                                                  resolve_prefix_cache)
+from deepspeed_tpu.inference.spec_decode import (make_draft,
+                                                 resolve_spec_decode,
+                                                 resolve_spec_k)
 from deepspeed_tpu.telemetry import (NOOP, MetricsRegistry, NoopTelemetry,
-                                     Telemetry, resolve_telemetry)
+                                     RATE_BUCKETS, Telemetry,
+                                     resolve_telemetry)
 from deepspeed_tpu.utils import faults as faults_lib
 from deepspeed_tpu.utils.faults import TransientDeviceError
 from deepspeed_tpu.utils.logging import logger
@@ -131,6 +157,12 @@ _STAT_FIELDS = (
     ("backpressure", "g", "queue fullness in [0, 1]"),
     ("prefix_hits", "c", "admissions that matched a cached prefix"),
     ("prefix_tokens_saved", "c", "prompt tokens served from shared blocks"),
+    ("spec_steps", "c", "speculative verify dispatches"),
+    ("spec_slot_steps", "c", "per-slot verify participations"),
+    ("spec_proposed", "c", "draft tokens offered for verification"),
+    ("spec_accepted", "c", "draft tokens accepted by the target"),
+    ("spec_emitted", "c", "tokens emitted by speculative steps"),
+    ("spec_fallbacks", "c", "spec steps degraded to plain decode"),
 )
 
 
@@ -237,6 +269,18 @@ class ServingEngine:
       :class:`~deepspeed_tpu.telemetry.Telemetry` instance is used
       as-is (share one across engines to aggregate), None defers to
       ``DS_TELEMETRY`` (default off — no-op plane, zero overhead).
+    - ``spec_decode`` / ``spec_k`` / ``spec_draft``: speculative decode
+      inside the batch (docs/SPECULATIVE.md) — each step a drafter
+      proposes ``spec_k`` tokens per slot and ONE verify program scores
+      all ``spec_k + 1`` positions; the accepted prefix (greedy-target
+      agreement) advances the slot, the first reject rolls the cache
+      back, so output is bit-identical to spec-off greedy serving.
+      ``spec_decode`` None defers to ``DS_SPEC_DECODE`` (default off —
+      plain one-token decode stays the bit-reference); ``spec_k`` None
+      to ``DS_SPEC_K`` (default 4); ``spec_draft`` takes ``"ngram"``
+      (prompt-lookup, default), a draft ``InferenceEngine``, or any
+      ``propose(context, k)`` object. Greedy-only: spec with
+      ``temperature > 0`` raises (acceptance needs the target argmax).
     """
 
     def __init__(self, engine, *, num_slots: int = 4, block_size: int = 16,
@@ -252,7 +296,10 @@ class ServingEngine:
                  watchdog_grace: int = 2,
                  max_retries: int = 3, retry_backoff_s: float = 0.02,
                  faults: Optional[faults_lib.FaultInjector] = None,
-                 telemetry=None):
+                 telemetry=None,
+                 spec_decode: Optional[bool] = None,
+                 spec_k: Optional[int] = None,
+                 spec_draft=None):
         if engine.is_encoder:
             raise ValueError("serving needs a causal decoder engine")
         self.engine = engine
@@ -308,6 +355,17 @@ class ServingEngine:
         self.watchdog_grace = max(1, int(watchdog_grace))
         self.max_retries = int(max_retries)
         self.retry_backoff_s = float(retry_backoff_s)
+        # speculative decode: drafter + chunk length resolved once at
+        # construction (spec_k is baked into the verify program's static
+        # G = spec_k + 1 token dimension, so it cannot change per step)
+        self.spec_decode = resolve_spec_decode(spec_decode)
+        self.spec_k = resolve_spec_k(spec_k)
+        self.draft = make_draft(spec_draft) if self.spec_decode else None
+        if self.spec_decode and self.temperature > 0:
+            raise ValueError(
+                "spec_decode is greedy-only (acceptance compares drafts "
+                "against the target argmax); got temperature="
+                f"{self.temperature}")
         self._rng = jax.random.PRNGKey(seed)
         self.queue: deque = deque()
         self.slots: List[Optional[ServeRequest]] = [None] * num_slots
@@ -355,6 +413,15 @@ class ServingEngine:
                 "serving_hbm_blocks_free", "free-list blocks")
             self._g_hit_rate = reg.gauge(
                 "serving_prefix_hit_rate", "prefix hits / admissions")
+            self._h_accept = reg.histogram(
+                "serving_spec_accept_rate",
+                "per-verify-step draft acceptance rate",
+                buckets=RATE_BUCKETS)
+            self._h_tps = reg.histogram(
+                "serving_spec_tokens_per_step",
+                "tokens emitted per live slot per verify step",
+                buckets=tuple(float(i)
+                              for i in range(1, self.spec_k + 2)))
 
             def _on_fault(site: str, kind: str, visit: int) -> None:
                 # injected faults land in the SAME timeline as the
@@ -368,6 +435,7 @@ class ServingEngine:
             self.faults.add_listener(self._fault_listener)
         else:
             self._h_ttft = self._h_tpot = self._h_qwait = self._h_occ = None
+            self._h_accept = self._h_tps = None
             self._fault_listener = None
 
     # -- API -----------------------------------------------------------
@@ -637,6 +705,14 @@ class ServingEngine:
                 if r is not None and r.state == "decode"]
         if not live:
             return 0
+        if self.spec_decode:
+            occ = self._spec_decode_step(live, now)
+            if occ is not None:
+                return occ
+            # draft/verify faulted before dispatch: degrade THIS step to
+            # the plain one-token path below (forward progress over
+            # speed; the donated pools are intact, the live list is
+            # unchanged — no slot was advanced or emitted into)
         tokens = np.zeros((self.num_slots,), np.int32)
         active = np.zeros((self.num_slots,), bool)
         for i in live:
@@ -649,32 +725,142 @@ class ServingEngine:
             self.cache.k, self.cache.v, self.cache.tables,
             self.cache.lengths, tokens, active, self.decode_impl)
         if budget is not None:
-            elapsed = time.perf_counter() - t0
-            if elapsed > budget:
-                self._over_budget += 1
-                self._stat["watchdog_trips"].inc()
-                self.telemetry.tracer.event(
-                    "watchdog", step=self._step_clock,
-                    elapsed_s=round(elapsed, 6),
-                    strikes=self._over_budget)
-                if self._over_budget >= self.watchdog_grace:
-                    # this step's tokens are still emitted below: raise
-                    # AFTER bookkeeping (step() rethrows) so nothing is
-                    # lost or double-counted on resume
-                    self._watchdog_msg = (
-                        f"decode step over budget "
-                        f"({elapsed * 1e3:.1f}ms > "
-                        f"{budget * 1e3:.1f}ms) {self._over_budget} "
-                        f"consecutive times — degraded")
-            else:
-                self._over_budget = 0
+            self._watchdog_note(time.perf_counter() - t0)
         self._stat["decode_steps"].inc()
         for i in live:
             self.cache.advance(i, 1)
             self._emit(i, self.slots[i], logits[i:i + 1], now)
         return len(live)
 
+    def _spec_decode_step(self, live: List[int], now: float) -> Optional[int]:
+        """One speculative iteration over the decoding slots: draft
+        ``spec_k`` tokens per slot, verify all ``spec_k + 1`` positions
+        in ONE program, accept each slot's longest draft prefix that
+        matches the target's own greedy choices, emit accepted tokens
+        plus the target's correction, roll the cache back past the first
+        reject. Returns the occupancy, or None to degrade this step to
+        the plain one-token path (an injected draft/verify fault — both
+        fire BEFORE dispatch, so no slot state has moved).
+
+        Capacity is opportunistic: the chunk wants ``spec_k + 1`` tokens
+        of room, but a slot that cannot grow (pool pressure, per-slot
+        budget) just speculates shallower this step — eviction is never
+        triggered FOR draft tokens, only for the one committed token the
+        plain preamble already guaranteed."""
+        G = self.spec_k + 1
+        try:
+            self.faults.fire("serving.spec_draft")
+            proposals = {
+                i: np.asarray(  # dslint: disable=DS001 — drafter output is host numpy (prompt-lookup never touches the device); this normalizes dtype/shape, no sync
+                    self.draft.propose(self.slots[i].tokens, self.spec_k),
+                    np.int32).ravel()
+                for i in live}
+        except TransientDeviceError:
+            self._stat["spec_fallbacks"].inc()
+            logger.warning("serving: draft fault; degrading this step "
+                           "to plain decode")
+            return None
+        caps = {}
+        for i in live:
+            length = int(self.cache.lengths[i])
+            want = min(length + G, self.cache.tokens_per_slot)
+            if want > self.cache.capacity_tokens(i):
+                try:
+                    self.cache.ensure_capacity(i, want)
+                except CacheExhausted:
+                    pass      # speculate into whatever room exists
+            caps[i] = min(self.cache.capacity_tokens(i),
+                          self.cache.tokens_per_slot) - length
+        tokens = np.zeros((self.num_slots, G), np.int32)
+        active = np.zeros((self.num_slots,), bool)
+        for i in live:
+            tokens[i, 0] = self.slots[i].out[-1]   # the pending token
+            tokens[i, 1:] = proposals[i][:self.spec_k]
+            active[i] = True
+        budget = self.step_time_budget_s
+        t0 = time.perf_counter() if budget is not None else 0.0
+        try:
+            # no retry wrapper: a verify fault degrades to the plain
+            # path (which retries) instead of re-speculating — the fault
+            # fires before dispatch, so the donated pools are intact
+            logits, self.cache.k, self.cache.v = self.engine.verify_slots(
+                self.cache.k, self.cache.v, self.cache.tables,
+                self.cache.lengths, tokens, active, self.decode_impl)
+        except TransientDeviceError:
+            self._stat["spec_fallbacks"].inc()
+            logger.warning("serving: verify fault; degrading this step "
+                           "to plain decode")
+            return None
+        if budget is not None:
+            self._watchdog_note(time.perf_counter() - t0)
+        self._stat["decode_steps"].inc()
+        self._stat["spec_steps"].inc()
+        # the target's greedy choice at every chunk position — the SAME
+        # fp32-cast device argmax _sample takes, so accepted tokens are
+        # bit-identical to what plain decode would have emitted
+        greedy = np.asarray(jax.device_get(  # dslint: disable=DS001 — accept/reject is host control flow; one transfer per verify step replaces spec_k+1 plain-decode transfers
+            jnp.argmax(logits.astype(jnp.float32), axis=-1)))
+        proposed = accepted = emitted = 0
+        accept_by_slot = {}
+        for i in live:
+            req = self.slots[i]
+            # leading agreement, capped so lengths never outgrow the
+            # blocks actually allocated (caps >= 1: the plain preamble
+            # guaranteed room for the committed token)
+            k_live = max(0, min(self.spec_k, caps[i] - 1))
+            prop = proposals[i]
+            acc = 0
+            while acc < k_live and greedy[i, acc] == prop[acc]:
+                acc += 1
+            proposed += k_live
+            accepted += acc
+            accept_by_slot[i] = acc
+            # commit acc + 1 tokens (accepted drafts + the pending one
+            # whose K/V this chunk wrote), then trim any tail block only
+            # the rejected draft suffix was using
+            new_len = int(self.cache.lengths[i]) + acc + 1
+            self.cache.advance(i, acc + 1)
+            self.cache.rollback(i, new_len)
+            self._stat["spec_slot_steps"].inc()
+            for tok in [int(t) for t in prop[:acc]] + [int(greedy[i, acc])]:
+                emitted += 1
+                self._emit_token(i, req, tok, now)
+                if req.state in TERMINAL_STATES:
+                    break      # max_new/eos truncation, same order as off
+        self._stat["spec_proposed"].inc(proposed)
+        self._stat["spec_accepted"].inc(accepted)
+        self._stat["spec_emitted"].inc(emitted)
+        if self._h_accept is not None:
+            if proposed:
+                self._h_accept.observe(accepted / proposed)
+            self._h_tps.observe(emitted / len(live))
+        self.telemetry.tracer.event(
+            "spec_verify", step=self._step_clock, k=self.spec_k,
+            accepted=accept_by_slot, emitted=emitted)
+        return len(live)
+
     # -- helpers ---------------------------------------------------------
+    def _watchdog_note(self, elapsed: float) -> None:
+        """Score one decode/verify dispatch against the step budget:
+        consecutive over-budget dispatches accumulate strikes until the
+        grace runs out, then ``step()`` raises DegradedError AFTER this
+        step's bookkeeping (nothing lost or double-counted on resume)."""
+        budget = self.step_time_budget_s
+        if elapsed > budget:
+            self._over_budget += 1
+            self._stat["watchdog_trips"].inc()
+            self.telemetry.tracer.event(
+                "watchdog", step=self._step_clock,
+                elapsed_s=round(elapsed, 6),
+                strikes=self._over_budget)
+            if self._over_budget >= self.watchdog_grace:
+                self._watchdog_msg = (
+                    f"decode step over budget "
+                    f"({elapsed * 1e3:.1f}ms > "
+                    f"{budget * 1e3:.1f}ms) {self._over_budget} "
+                    f"consecutive times — degraded")
+        else:
+            self._over_budget = 0
     def _device_call(self, site: str, fn, *args):
         """Run a slot program with fault injection + transient-error
         retry. Faults (and any real pre-dispatch failure) fire BEFORE
@@ -752,9 +938,18 @@ class ServingEngine:
             state=state, generated=len(req.out))
 
     def _emit(self, slot: int, req: ServeRequest, logits, now: float) -> None:
+        """Sample one token from last-position ``logits`` and emit it."""
         self._rng, r = jax.random.split(self._rng)
         tok = int(np.asarray(self.engine._sample(
             logits, r, self.temperature, self.top_k))[0])
+        self._emit_token(slot, req, tok, now)
+
+    def _emit_token(self, slot: int, req: ServeRequest, tok: int,
+                    now: float) -> None:
+        """Record one emitted token: output list, latency stamps,
+        TTFT/TPOT histograms, terminal-state check (max_new/eos). The
+        speculative path calls this directly — its tokens are already
+        the target's greedy choices, so there is nothing to sample."""
         prev = req.token_times[-1] if req.token_times else None
         req.out.append(tok)
         req.token_times.append(now)

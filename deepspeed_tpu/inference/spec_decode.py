@@ -1,0 +1,174 @@
+"""Draft proposers for speculative decoding inside continuous batching.
+
+The serving scheduler (inference/serving.py, ``spec_decode=True`` /
+``DS_SPEC_DECODE=on``) asks a DRAFTER for ``k`` candidate tokens per
+active slot each step, then verifies all ``k+1`` positions in one
+engine program (``InferenceEngine.verify_slots``) and accepts the
+longest prefix agreeing with the target's own greedy choice — so the
+drafter affects LATENCY only, never output (docs/SPECULATIVE.md).
+
+The drafting interface is one duck-typed method::
+
+    propose(context: np.ndarray[int], k: int) -> np.ndarray[int32, (k,)]
+
+``context`` is the slot's prompt + everything generated so far
+(including the pending token the verify chunk starts with); the return
+is exactly ``k`` tokens — static shape, so the verify program never
+retraces. Anything with that method plugs in via
+``ServingEngine(spec_draft=...)``.
+
+Two drafters ship:
+
+- :class:`NGramDraft` (default, ``DS_SPEC_DRAFT=ngram``) — prompt-lookup
+  decoding (Saxena 2023; the technique behind vLLM's
+  ``speculative_model="[ngram]"``): match the slot's trailing n-gram
+  against its OWN earlier context and propose the continuation of the
+  most recent earlier occurrence. Zero model cost, host-side numpy
+  only, and strong on the shared-suffix traffic serving actually sees
+  (quoting, code completion, templated answers, greedy loops).
+- :class:`ModelDraft` (``spec_draft=<draft InferenceEngine>``) — the
+  classic small-draft-model path (Leviathan et al., ICML 2023), the
+  same economics as the static ``generate_speculative`` but behind the
+  serving interface. Costs k draft forwards per slot per step; worth it
+  only when the draft is much smaller than the target.
+"""
+
+import os
+from typing import Any, Optional
+
+import numpy as np
+
+
+def resolve_spec_decode(flag: Optional[bool] = None) -> bool:
+    """Resolve the speculative-serving switch.
+
+    Explicit argument wins, else the ``DS_SPEC_DECODE`` env var
+    (``on``/``off``, also ``1``/``0``/``true``/``false``), else OFF —
+    plain one-token decode stays the behavioral bit-reference."""
+    if flag is not None:
+        return bool(flag)
+    v = os.environ.get("DS_SPEC_DECODE", "")  # dslint: disable=DS005 — documented serving knob, resolved once at engine construction and overridable per ServingEngine
+    v = v.strip().lower()
+    if v in ("", "off", "0", "false", "no"):
+        return False
+    if v in ("on", "1", "true", "yes"):
+        return True
+    # ValueError, not assert: validates user env input, survives python -O
+    raise ValueError(f"DS_SPEC_DECODE={v!r}: expected 'on' or 'off'")
+
+
+def resolve_spec_draft(spec: Optional[str] = None) -> str:
+    """Resolve the drafter NAME: explicit argument, else
+    ``DS_SPEC_DRAFT``, else ``"ngram"`` (the no-second-model default)."""
+    if spec is None:
+        spec = os.environ.get("DS_SPEC_DRAFT", "")  # dslint: disable=DS005 — documented serving knob, resolved once at engine construction and overridable per ServingEngine
+        spec = spec.strip().lower() or "ngram"
+    if spec != "ngram":
+        raise ValueError(
+            f"DS_SPEC_DRAFT={spec!r}: 'ngram' is the only named drafter "
+            f"(pass a draft InferenceEngine or a propose()-bearing "
+            f"object as spec_draft= for the model path)")
+    return spec
+
+
+def resolve_spec_k(k: Optional[int] = None) -> int:
+    """Draft chunk length: explicit argument, else ``DS_SPEC_K``, else
+    4 (docs/SPECULATIVE.md discusses tuning)."""
+    if k is None:
+        v = os.environ.get("DS_SPEC_K", "")  # dslint: disable=DS005 — documented serving knob, resolved once at engine construction and overridable per ServingEngine
+        k = int(v) if v.strip() else 4
+    k = int(k)
+    if k < 1:
+        raise ValueError(f"spec_k={k}: need at least one draft token")
+    return k
+
+
+class NGramDraft:
+    """Prompt-lookup n-gram drafter: propose the continuation of the
+    most recent earlier occurrence of the context's trailing n-gram,
+    longest ``n`` first (``max_ngram`` down to ``min_ngram``). No match
+    anywhere falls back to repeating the last token — still a valid
+    proposal (the verifier rejects wrong tokens for free, and repeat
+    runs are common in greedy decoding)."""
+
+    name = "ngram"
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1):
+        if not 1 <= min_ngram <= max_ngram:
+            raise ValueError(f"need 1 <= min_ngram <= max_ngram, got "
+                             f"({min_ngram}, {max_ngram})")
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+
+    def propose(self, context, k: int) -> np.ndarray:
+        ctx = np.asarray(context, np.int64).ravel()
+        if ctx.size == 0:
+            return np.zeros((k,), np.int32)
+        for n in range(min(self.max_ngram, ctx.size - 1),
+                       self.min_ngram - 1, -1):
+            # candidate starts exclude the trailing n-gram itself
+            L = ctx.size - n
+            if L <= 0:
+                continue
+            pat = ctx[-n:]
+            win = np.lib.stride_tricks.sliding_window_view(ctx, n)[:L]
+            hits = np.flatnonzero((win == pat).all(axis=1))
+            if hits.size:
+                s = int(hits[-1])            # most recent occurrence
+                cont = ctx[s + n:s + n + k]
+                out = np.empty((k,), np.int64)
+                out[:cont.size] = cont
+                out[cont.size:] = cont[-1] if cont.size else ctx[-1]
+                return out.astype(np.int32)
+        return np.full((k,), ctx[-1], np.int32)
+
+
+class ModelDraft:
+    """Draft-model proposer over a second :class:`InferenceEngine`:
+    greedy k-token continuation of a fixed-width, left-padded context
+    window. The fixed window keeps the draft's prefill/decode programs
+    shape-stable across calls (one compile, like every other serving
+    program); the cost is re-prefilling the window each proposal — the
+    simple-and-correct baseline, acceptable when the draft is tiny
+    relative to the target."""
+
+    name = "model"
+
+    def __init__(self, engine, window: int = 64):
+        if getattr(engine, "is_encoder", False):
+            raise ValueError("draft model must be a causal decoder")
+        self.engine = engine
+        self.window = int(window)
+
+    def propose(self, context, k: int) -> np.ndarray:
+        ctx = np.asarray(context, np.int32).ravel()[-self.window:]
+        W = self.window
+        if W + k > self.engine.max_seq_len:
+            raise ValueError(
+                f"draft window {W} + k {k} exceeds the draft engine's "
+                f"max_seq_len {self.engine.max_seq_len}")
+        toks = np.zeros((1, W), np.int32)
+        toks[0, W - ctx.size:] = ctx
+        mask = np.zeros((1, W), np.float32)
+        mask[0, W - ctx.size:] = 1.0
+        out = self.engine.generate(toks, max_new_tokens=k,
+                                   attention_mask=mask)
+        return np.asarray(out[0, W:W + k], np.int32)
+
+
+def make_draft(spec: Any = None) -> Any:
+    """Build the drafter from whatever ``ServingEngine(spec_draft=)``
+    was given: None/str resolve by name (env ``DS_SPEC_DRAFT``), a
+    ``propose()``-bearing object is used as-is, a draft
+    :class:`InferenceEngine` (anything with ``generate``) is wrapped in
+    :class:`ModelDraft`."""
+    if spec is None or isinstance(spec, str):
+        resolve_spec_draft(spec)      # "ngram" is the only named drafter
+        return NGramDraft()
+    if hasattr(spec, "propose"):
+        return spec
+    if hasattr(spec, "generate"):
+        return ModelDraft(spec)
+    raise ValueError(
+        f"spec_draft={spec!r}: expected 'ngram', a draft "
+        f"InferenceEngine, or an object with propose(context, k)")

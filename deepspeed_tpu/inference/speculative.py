@@ -15,94 +15,21 @@ rejections from norm(max(0, p-q)) — whose OUTPUT DISTRIBUTION equals
 sampling the target alone (verified against the exact two-step
 marginal in tests/test_speculative.py).
 
-The chunk-verify step is `_extend_fn`: the decode block generalized
-from 1 to G query tokens — queries attend the cache plus the causal
-prefix of their own chunk. Cache slots past a partial acceptance hold
-stale K/V, which is safe by construction: the next round REWRITES those
-positions before any query reads them (position-addressed writes happen
-before attention in the same step).
+The chunk-verify step is the engine's ``_extend`` program
+(inference/engine.py ``_extend_fn`` / ``_block_extend``): the decode
+block generalized from 1 to G query tokens — queries attend the cache
+plus the causal prefix of their own chunk. The same block math drives
+the PAGED serving verify (``_verify_slots_fn`` / ``_block_verify_paged``
+behind ``ServingEngine(spec_decode=True)``, docs/SPECULATIVE.md), so
+this static path and continuous-batching speculation share one
+implementation. Cache slots past a partial acceptance hold stale K/V,
+which is safe by construction: the next round REWRITES those positions
+before any query reads them (position-addressed writes happen before
+attention in the same step).
 """
 
-from functools import partial
-
-import jax
 import jax.numpy as jnp
 import numpy as np
-
-from deepspeed_tpu.models.gpt import _dense, _norm, _qkv_split_rotary
-
-
-def _block_extend(x, k_cache, v_cache, pos, p, cfg):
-    """Decode block for G new tokens at cache positions [pos, pos+G).
-    x: [B, G, D]; caches [B, S_max, Hkv, Dh]. Causality: query i sees
-    cache slots <= pos + i (its own prefix included)."""
-    B, G, D = x.shape
-    H, Dh = cfg.n_heads, cfg.head_dim
-    Hkv = cfg.kv_heads
-    group = H // Hkv
-    S_max = k_cache.shape[1]
-
-    h = _norm(x, p["ln1"], cfg)
-    qkv = _dense(h, p["qkv"])
-    q, k, v = _qkv_split_rotary(qkv, cfg, pos + jnp.arange(G), B, G)
-    k_cache = jax.lax.dynamic_update_slice_in_dim(k_cache, k, pos, axis=1)
-    v_cache = jax.lax.dynamic_update_slice_in_dim(v_cache, v, pos, axis=1)
-
-    qg = q.reshape(B, G, Hkv, group, Dh)
-    scores = jnp.einsum("bqkgd,bskd->bkgqs", qg,
-                        k_cache).astype(jnp.float32)
-    scores *= cfg.attn_scale if cfg.attn_scale is not None \
-        else 1.0 / np.sqrt(Dh)
-    idx = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, 1, S_max), 4)
-    qi = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, G, 1), 3)
-    scores = jnp.where(idx <= pos + qi, scores, -1e30)
-    if cfg.attn_window is not None:
-        scores = jnp.where(idx > pos + qi - cfg.attn_window, scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-    attn = jnp.einsum("bkgqs,bskd->bqkgd", probs, v_cache)
-    attn = attn.reshape(B, G, D)
-    attn = _dense(attn, p["attn_out"])
-    if cfg.parallel_residual:
-        from deepspeed_tpu.inference.engine import _ffn
-        return x + attn + _ffn(h, p, cfg), k_cache, v_cache
-    x = x + attn
-    h = _norm(x, p["ln2"], cfg)
-    from deepspeed_tpu.inference.engine import _ffn
-    return x + _ffn(h, p, cfg), k_cache, v_cache
-
-
-def _extend_jit(engine):
-    """The engine-cached compiled verify step (one per engine; jit
-    retraces per distinct chunk width and caches across calls). The
-    cache argument is donated, matching the engine's own decode step —
-    a fresh jit per generate call would recompile the whole model every
-    request and double peak cache HBM."""
-    fn = getattr(engine, "_spec_extend", None)
-    if fn is None:
-        fn = jax.jit(partial(_extend_fn, engine), donate_argnums=(1,))
-        engine._spec_extend = fn
-    return fn
-
-
-def _extend_fn(engine, params, cache, tokens, pos):
-    """G-token target verify step: logits [B, G, V] + updated cache.
-    tokens: [B, G]; pos: scalar first cache index of the chunk."""
-    cfg = engine.cfg
-    G = tokens.shape[1]
-    x = params["wte"]["embedding"][tokens]
-    if cfg.use_wpe:
-        x = x + jax.lax.dynamic_slice_in_dim(
-            params["wpe"]["embedding"], pos, G)[None]
-
-    def body(x, layer):
-        layer_p, kc, vc = layer
-        y, kc, vc = _block_extend(x, kc, vc, pos, layer_p, cfg)
-        return y, (kc, vc)
-
-    x, (ks, vs) = jax.lax.scan(body, x,
-                               (params["block"], cache["k"], cache["v"]))
-    logits = engine._logits(params, x)
-    return logits, {"k": ks, "v": vs}
 
 
 def generate_speculative(target, draft, tokens, max_new_tokens: int = 32,
@@ -167,7 +94,9 @@ def generate_speculative(target, draft, tokens, max_new_tokens: int = 32,
 
     t_logits, t_cache = target._prefill(target.params, jnp.asarray(tokens))
     d_logits, d_cache = draft._prefill(draft.params, jnp.asarray(tokens))
-    extend_t = _extend_jit(target)
+    # the engine's compiled chunk-verify program (cache donated; jit
+    # retraces per distinct chunk width and caches across calls)
+    extend_t = target._extend
 
     out = [tokens]
     # first target token comes straight from the prefill logits

@@ -98,17 +98,19 @@ def paged_hbm_bytes_per_token(cfg, num_slots: int, mean_len: float,
     return int(num_slots * mean_len) * per_tok
 
 
-def _kv_index_map(bs: int, nb: int, window: Optional[int]):
+def _kv_index_map(bs: int, nb: int, window: Optional[int], q_len: int = 1):
     """Block index map for the K/V pools when the grid is (b, j) and the
     pools are scalar-prefetch-addressed: step (b, j) fetches pool block
     ``tables[b, clamp(j)]``. Steps past the slot's last occupied block
     clamp DOWN to it, steps below the sliding-window band clamp UP to
     the band's first block — either way the skipped step's index equals
     a run step's (or its neighbor's), so Mosaic elides the DMA exactly
-    like the causal clamp in ops/attention/flash.py."""
+    like the causal clamp in ops/attention/flash.py. With a verify
+    chunk (``q_len > 1``) the last query sits at ``lengths + q_len - 1``,
+    so the high clamp covers that block too."""
     def imap(b, j, tables_ref, lengths_ref):
         pos = lengths_ref[b]
-        hi = jnp.minimum(pos // bs, nb - 1)
+        hi = jnp.minimum((pos + (q_len - 1)) // bs, nb - 1)
         jj = jnp.minimum(j, hi)
         if window is not None:
             lo = jnp.clip((pos - window + 1) // bs, 0, nb - 1)
@@ -120,18 +122,25 @@ def _kv_index_map(bs: int, nb: int, window: Optional[int]):
 
 def _paged_decode_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref,
                          o_ref, m_scratch, l_scratch, acc_scratch, *,
-                         bs: int, n_kv: int, group: int, scale: float,
-                         window: Optional[int], nb: int):
+                         bs: int, n_kv: int, group: int, q_len: int,
+                         scale: float, window: Optional[int], nb: int):
     """One (slot, pool-block) grid step of flash-decode.
 
-    q_ref: [1, H, Dh] (H = n_kv * group, grouped head-major); k_ref /
-    v_ref: [1, bs, Hkv, Dh] — ONE pool block, already table-indirected
-    by the index_map; scratch: running max / sum / fp32 accumulator per
-    query head, persistent across the j (block) iterations of slot b."""
+    q_ref: [1, H*q_len, Dh] (H = n_kv * group; rows ordered (kv head,
+    group member, chunk offset) so each kv head's queries are one
+    contiguous MXU matmul); k_ref / v_ref: [1, bs, Hkv, Dh] — ONE pool
+    block, already table-indirected by the index_map; scratch: running
+    max / sum / fp32 accumulator per query row, persistent across the j
+    (block) iterations of slot b. q_len == 1 is plain decode; q_len > 1
+    is the speculative verify chunk — query row with chunk offset g is
+    causal at position ``lengths[b] + g`` (within-chunk causality falls
+    out of the same position mask, since the chunk's K/V are already
+    scattered into the pool)."""
     b = pl.program_id(0)
     j = pl.program_id(1)
     pos = lengths_ref[b]
-    hi = jnp.minimum(pos // bs, nb - 1)      # last occupied block
+    # last block any query in the chunk may touch
+    hi = jnp.minimum((pos + (q_len - 1)) // bs, nb - 1)
 
     @pl.when(j == 0)
     def _init():
@@ -141,36 +150,47 @@ def _paged_decode_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref,
 
     run = j <= hi
     if window is not None:
+        # band start of the FIRST query; later queries' bands begin
+        # higher and are enforced per element below
         lo = jnp.clip((pos - window + 1) // bs, 0, nb - 1)
         run = jnp.logical_and(run, j >= lo)
 
+    R = group * q_len                         # query rows per kv head
+
     @pl.when(run)
     def _body():
-        q = q_ref[0]                          # [H, Dh]
+        q = q_ref[0]                          # [H*q_len, Dh]
         k = k_ref[0]                          # [bs, Hkv, Dh]
         v = v_ref[0]
         # positions of this block's slots in the slot's virtual cache;
         # the final partial block masks by position exactly like the
-        # gather path (idx <= pos, and the window band below it)
-        cols = jax.lax.broadcasted_iota(jnp.int32, (group, bs), 1) + j * bs
-        valid = cols <= pos
+        # gather path (idx <= pos + chunk offset, window band below it)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (R, bs), 1) + j * bs
+        qpos = pos
+        if q_len > 1:
+            # row r of a kv-head slice is (group member r // q_len,
+            # chunk offset r % q_len): each chunk query is causal at
+            # its own position
+            qpos = pos + jax.lax.broadcasted_iota(
+                jnp.int32, (R, bs), 0) % q_len
+        valid = cols <= qpos
         if window is not None:
-            valid = jnp.logical_and(valid, cols > pos - window)
+            valid = jnp.logical_and(valid, cols > qpos - window)
 
         for h in range(n_kv):                 # static unroll: Hkv is small
-            rows = slice(h * group, (h + 1) * group)
-            qh = q[rows, :]                   # [group, Dh] — one MXU matmul
+            rows = slice(h * R, (h + 1) * R)
+            qh = q[rows, :]                   # [R, Dh] — one MXU matmul
             kh = k[:, h, :]                   # [bs, Dh]     covers the whole
             vh = v[:, h, :]                   # GQA group of this kv head
             s = jax.lax.dot_general(
                 qh, kh, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * scale   # [group, bs]
+                preferred_element_type=jnp.float32) * scale   # [R, bs]
             s = jnp.where(valid, s, NEG_INF)
 
-            m_prev = m_scratch[rows, :1]                     # [group, 1]
+            m_prev = m_scratch[rows, :1]                     # [R, 1]
             m_cur = jnp.max(s, axis=-1, keepdims=True)
             m_new = jnp.maximum(m_prev, m_cur)
-            p = jnp.exp(s - m_new)                           # [group, bs]
+            p = jnp.exp(s - m_new)                           # [R, bs]
             alpha = jnp.exp(m_prev - m_new)
             l_new = alpha * l_scratch[rows, :1] \
                 + jnp.sum(p, axis=-1, keepdims=True)
@@ -179,9 +199,9 @@ def _paged_decode_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref,
                     p.astype(vh.dtype), vh, (((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32)
             m_scratch[rows, :] = jnp.broadcast_to(
-                m_new, (group, m_scratch.shape[1]))
+                m_new, (R, m_scratch.shape[1]))
             l_scratch[rows, :] = jnp.broadcast_to(
-                l_new, (group, l_scratch.shape[1]))
+                l_new, (R, l_scratch.shape[1]))
 
     @pl.when(j == hi)
     def _finish():
@@ -209,16 +229,56 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     True off-TPU so the same call tests on CPU (interpret mode) and
     compiles through Mosaic on chip."""
     B, n_kv, group, Dh = q.shape
+    return _paged_attention_call(
+        q.reshape(B, n_kv * group, Dh), k_pool, v_pool, tables, lengths,
+        n_kv=n_kv, group=group, q_len=1, scale=scale, window=window,
+        interpret=interpret).reshape(B, n_kv, group, Dh)
+
+
+def paged_verify_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
+                           v_pool: jnp.ndarray, tables: jnp.ndarray,
+                           lengths: jnp.ndarray, *, scale: float,
+                           window: Optional[int] = None,
+                           interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Flash-verify a G-token speculative chunk per slot THROUGH the
+    block table — the ``q_len > 1`` generalization of
+    :func:`paged_decode_attention` for draft/verify serving.
+
+    q: [B, G, Hkv, group, Dh] post-rotary chunk queries; the chunk's
+    K/V must already be scattered into the pools at positions
+    ``lengths[b] .. lengths[b] + G - 1`` (writes-before-attention, so
+    within-chunk causality is just the position mask: chunk query i of
+    slot b attends cache positions <= lengths[b] + i). Same grid and
+    per-block DMA economics as decode — the chunk only widens the MXU
+    matmul per fetched block, which is exactly why verify is nearly
+    free on TPU. Returns [B, G, Hkv, group, Dh] in q's dtype."""
+    B, G, n_kv, group, Dh = q.shape
+    # head-major row packing (kv head, group member, chunk offset):
+    # each kv head's group*G query rows stay one contiguous matmul
+    q_rows = q.transpose(0, 2, 3, 1, 4).reshape(B, n_kv * group * G, Dh)
+    out = _paged_attention_call(
+        q_rows, k_pool, v_pool, tables, lengths, n_kv=n_kv, group=group,
+        q_len=G, scale=scale, window=window, interpret=interpret)
+    return out.reshape(B, n_kv, group, G, Dh).transpose(0, 3, 1, 2, 4)
+
+
+def _paged_attention_call(q_rows, k_pool, v_pool, tables, lengths, *,
+                          n_kv: int, group: int, q_len: int, scale: float,
+                          window: Optional[int],
+                          interpret: Optional[bool]) -> jnp.ndarray:
+    """Shared pallas_call plumbing for decode (q_len=1) and verify
+    (q_len=G). q_rows: [B, n_kv*group*q_len, Dh], head-major rows."""
+    B, rows, Dh = q_rows.shape
     N, bs, Hkv, Dh_p = k_pool.shape
-    assert (n_kv, Dh) == (Hkv, Dh_p), (q.shape, k_pool.shape)
+    assert (n_kv, Dh, rows) == (Hkv, Dh_p, n_kv * group * q_len), \
+        (q_rows.shape, k_pool.shape, (n_kv, group, q_len))
     assert v_pool.shape == k_pool.shape, (v_pool.shape, k_pool.shape)
     nb = tables.shape[1]
-    H = n_kv * group
     if interpret is None:
         from deepspeed_tpu.utils import on_tpu
         interpret = not on_tpu()
 
-    kvmap = _kv_index_map(bs, nb, window)
+    kvmap = _kv_index_map(bs, nb, window, q_len)
 
     def qmap(b, j, tables_ref, lengths_ref):
         return (b, 0, 0)
@@ -227,30 +287,29 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
         num_scalar_prefetch=2,
         grid=(B, nb),
         in_specs=[
-            pl.BlockSpec((1, H, Dh), qmap),
+            pl.BlockSpec((1, rows, Dh), qmap),
             pl.BlockSpec((1, bs, Hkv, Dh), kvmap),
             pl.BlockSpec((1, bs, Hkv, Dh), kvmap),
         ],
-        out_specs=pl.BlockSpec((1, H, Dh), qmap),
+        out_specs=pl.BlockSpec((1, rows, Dh), qmap),
         scratch_shapes=[
-            pltpu.VMEM((H, LANES), jnp.float32),
-            pltpu.VMEM((H, LANES), jnp.float32),
-            pltpu.VMEM((H, Dh), jnp.float32),
+            pltpu.VMEM((rows, LANES), jnp.float32),
+            pltpu.VMEM((rows, LANES), jnp.float32),
+            pltpu.VMEM((rows, Dh), jnp.float32),
         ],
     )
     kernel = functools.partial(
-        _paged_decode_kernel, bs=bs, n_kv=n_kv, group=group,
+        _paged_decode_kernel, bs=bs, n_kv=n_kv, group=group, q_len=q_len,
         scale=float(scale), window=window, nb=nb)
-    out = pl.pallas_call(
+    return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, H, Dh), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, rows, Dh), q_rows.dtype),
         compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(jnp.asarray(tables, jnp.int32), jnp.asarray(lengths, jnp.int32),
-      q.reshape(B, H, Dh), k_pool, v_pool)
-    return out.reshape(B, n_kv, group, Dh)
+      q_rows, k_pool, v_pool)
 
 
 def paged_decode_reference(q, k_pool, v_pool, tables, lengths, *, scale,
@@ -272,3 +331,25 @@ def paged_decode_reference(q, k_pool, v_pool, tables, lengths, *, scale,
         s = jnp.where(idx > pos - window, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     return jnp.einsum("bkgs,bskd->bkgd", p, vc)
+
+
+def paged_verify_reference(q, k_pool, v_pool, tables, lengths, *, scale,
+                           window=None):
+    """Dense gather reference of :func:`paged_verify_attention` — the
+    same math as the engine's gather-path verify block
+    (inference/engine.py _block_verify_paged), minus the model.
+    q: [B, G, Hkv, group, Dh]."""
+    B, G, n_kv, group, Dh = q.shape
+    bs = k_pool.shape[1]
+    nb = tables.shape[1]
+    kc = k_pool[tables].reshape(B, nb * bs, n_kv, Dh)
+    vc = v_pool[tables].reshape(B, nb * bs, n_kv, Dh)
+    s = jnp.einsum("bqkgd,bskd->bkgqs", q, kc).astype(jnp.float32) * scale
+    idx = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, 1, nb * bs), 4)
+    qpos = lengths[:, None, None, None, None] + jax.lax.broadcasted_iota(
+        jnp.int32, (1, 1, 1, G, 1), 3)
+    s = jnp.where(idx <= qpos, s, NEG_INF)
+    if window is not None:
+        s = jnp.where(idx > qpos - window, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bkgqs,bskd->bqkgd", p, vc)

@@ -33,6 +33,13 @@ DEFAULT_BUCKETS: Tuple[float, ...] = (
     1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 1e-2, 2.5e-2, 5e-2,
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0)
 
+# even ladder over [0, 1] for ratio-valued histograms (speculative
+# accept_rate, hit rates): 5%-wide buckets keep the p50/p95 of a rate
+# meaningful where the timing ladder above would dump every sample
+# into two buckets
+RATE_BUCKETS: Tuple[float, ...] = tuple(
+    round(0.05 * i, 2) for i in range(1, 21))
+
 
 def _fmt(v) -> str:
     """Prometheus sample formatting: integral values render without the

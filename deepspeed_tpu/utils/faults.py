@@ -27,6 +27,11 @@ Sites currently instrumented:
                        ANY bookkeeping mutates); ``cache_exhausted``
                        raises CacheExhausted — the admission retries
 ``engine.decode``      ``InferenceEngine.decode_slots`` public wrapper
+``engine.verify``      ``InferenceEngine.verify_slots`` public wrapper
+                       (speculative verify); the scheduler degrades the
+                       step to plain one-token decode, never retries
+``serving.spec_draft`` before the per-slot draft proposals each
+                       speculative step; same degrade-to-plain contract
 ``checkpoint.pre_commit``  after state write, BEFORE the tag dir commit
 ``checkpoint.commit``  after the tag dir commit, BEFORE ``latest`` update
 ====================== =====================================================

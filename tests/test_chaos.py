@@ -142,9 +142,13 @@ def test_chaos_parity_under_injected_faults(eng):
              Fault("serving.decode", "slow", step=6, param=0.005),
              Fault("cache.ensure", "cache_exhausted", step=5)]
     with faults_lib.injected(*chaos, seed=0) as inj:
+        # spec pinned off here and below: these tests exercise the
+        # PLAIN decode path's fault sites (serving.decode fires per
+        # one-token dispatch); the speculative sites' chaos contract is
+        # test_spec_serving.py's job
         srv = ServingEngine(eng, num_slots=2, block_size=4, num_blocks=24,
                             prefill_chunk=8, max_retries=3,
-                            retry_backoff_s=0.001)
+                            retry_backoff_s=0.001, spec_decode=False)
         out = srv.run([ServeRequest(rid=i, prompt=p, max_new_tokens=6)
                        for i, p in enumerate(prompts)])
     for i, ref in enumerate(refs):
@@ -229,7 +233,8 @@ def test_watchdog_degraded_error_keeps_everything(eng):
     with faults_lib.injected(
             Fault("serving.decode", "slow", step=4, count=2, param=0.05)):
         srv = ServingEngine(eng, num_slots=2, block_size=4, num_blocks=24,
-                            step_time_budget_s=0.005, watchdog_grace=2)
+                            step_time_budget_s=0.005, watchdog_grace=2,
+                            spec_decode=False)
         with pytest.raises(DegradedError, match="over budget") as ei:
             srv.run([ServeRequest(rid="a", prompt=p1, max_new_tokens=12),
                      ServeRequest(rid="b", prompt=p2, max_new_tokens=3)])
@@ -253,7 +258,8 @@ def test_retry_backoff_survives_transient_burst(eng):
     with faults_lib.injected(
             Fault("serving.decode", "device_error", step=1, count=2)):
         srv = ServingEngine(eng, num_slots=1, block_size=4, num_blocks=24,
-                            max_retries=3, retry_backoff_s=0.001)
+                            max_retries=3, retry_backoff_s=0.001,
+                            spec_decode=False)
         out = srv.run([ServeRequest(rid=0, prompt=p, max_new_tokens=5)])
     np.testing.assert_array_equal(out[0], ref)
     assert srv.stats["retries"] == 2
@@ -266,7 +272,8 @@ def test_retry_exhaustion_propagates(eng):
     with faults_lib.injected(
             Fault("serving.decode", "device_error", step=0, count=10)):
         srv = ServingEngine(eng, num_slots=1, block_size=4, num_blocks=24,
-                            max_retries=2, retry_backoff_s=0.001)
+                            max_retries=2, retry_backoff_s=0.001,
+                            spec_decode=False)
         with pytest.raises(TransientDeviceError):
             srv.run([ServeRequest(rid=0, prompt=p, max_new_tokens=5)])
     assert srv.stats["retries"] == 2
@@ -308,7 +315,8 @@ def test_chaos_compile_count_contract(eng):
                                 num_blocks=7, prefill_chunk=8,
                                 max_queue=4, max_retries=3,
                                 retry_backoff_s=0.001,
-                                step_time_budget_s=10.0)
+                                step_time_budget_s=10.0,
+                                spec_decode=False)
             srv.cache.watermark = 0
             out = srv.run(
                 [ServeRequest(rid="a", prompt=p1, max_new_tokens=12,
@@ -354,7 +362,8 @@ def test_chaos_prefix_cache_sites_parity(eng):
     with faults_lib.injected(*chaos, seed=0) as inj:
         srv = ServingEngine(eng, num_slots=1, block_size=8, num_blocks=24,
                             prefill_chunk=16, prefix_cache=True,
-                            max_retries=3, retry_backoff_s=0.001)
+                            max_retries=3, retry_backoff_s=0.001,
+                            spec_decode=False)
         out = srv.run([ServeRequest(rid=i, prompt=p, max_new_tokens=6)
                        for i, p in enumerate((base, base, div))])
     fired_sites = {s for s, _k, _v in inj.fired}

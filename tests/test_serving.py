@@ -206,8 +206,10 @@ def test_serving_staggered_arrival_joins_running_batch(devices):
     p1, p2 = prompts_of((6, 8), seed=11)
     ref1 = _solo_refs(eng, [p1], 12)[0]
     ref2 = _solo_refs(eng, [p2], 6)[0]
+    # spec pinned off: the step-4 arrival must catch r1 mid-decode,
+    # which assumes one token per step (spec timing has its own suite)
     srv = ServingEngine(eng, num_slots=2, block_size=4, num_blocks=24,
-                        prefill_chunk=8)
+                        prefill_chunk=8, spec_decode=False)
     srv.submit(ServeRequest(rid="r1", prompt=p1, max_new_tokens=12), now=0)
     occ = []
     step = 0
@@ -292,9 +294,12 @@ def test_serving_compile_count_contract(devices):
 
     def run_workload():
         # tight pool + zero watermark: both requests admit, decode
-        # growth exhausts the free list, the youngest evicts + requeues
+        # growth exhausts the free list, the youngest evicts + requeues.
+        # spec pinned off: this pins the PLAIN decode program contract
+        # (the spec twin lives in test_spec_serving.py, where verify
+        # replaces decode)
         srv = ServingEngine(eng, num_slots=2, block_size=4, num_blocks=7,
-                            prefill_chunk=8)
+                            prefill_chunk=8, spec_decode=False)
         srv.cache.watermark = 0
         out = srv.run([ServeRequest(rid="a", prompt=p1, max_new_tokens=12),
                        ServeRequest(rid="b", prompt=p2, max_new_tokens=10)])

@@ -43,7 +43,7 @@ elif [[ "${1:-}" == "quick" ]]; then
     # files by name heuristic; plus the always-on smoke set
     # (engine/config/gpt cover the load-bearing core; telemetry guards
     # the serving observability plane and its no-op contract)
-    tests="tests/test_engine.py tests/test_config.py tests/test_gpt.py tests/test_telemetry.py"
+    tests="tests/test_engine.py tests/test_config.py tests/test_gpt.py tests/test_telemetry.py tests/test_spec_serving.py"
     tests="$tests $(git diff --name-only --diff-filter=d HEAD -- 'tests/test_*.py' | tr '\n' ' ')"
     changed=$(git diff --name-only --diff-filter=d HEAD -- 'deepspeed_tpu/**.py' \
               | xargs -rn1 basename | sed 's/\.py$//')
@@ -75,6 +75,14 @@ else
     echo "gate: serving smoke (DS_TELEMETRY=on)"
     DS_TELEMETRY=on python -m pytest tests/test_serving.py \
         tests/test_telemetry.py tests/test_chaos.py -q
+    # speculative-decode knob smoke: the suite default leaves
+    # DS_SPEC_DECODE unset (= off, the plain-decode bit-reference), so
+    # run the serving + chaos suites once with per-slot draft/verify
+    # forced ON — greedy parity, eviction/requeue and the fault-degrade
+    # path must hold with speculation active (docs/SPECULATIVE.md)
+    echo "gate: serving smoke (DS_SPEC_DECODE=on)"
+    DS_SPEC_DECODE=on python -m pytest tests/test_serving.py \
+        tests/test_spec_serving.py tests/test_chaos.py -q
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 fi
 echo "gate: green"

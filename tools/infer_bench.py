@@ -183,7 +183,8 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
                   prompt_lens=(8, 48), new_tokens=24, num_slots=4,
                   block_size=16, num_blocks=None, prefill_chunk=32,
                   int8=False, int8_fused=False, seed=0, decode_impl=None,
-                  prefix_cache=None, shared_prefix_len=0, emit=True):
+                  prefix_cache=None, shared_prefix_len=0,
+                  spec_decode=None, spec_k=None, emit=True):
     """Continuous-batching serving row: synthetic Poisson arrivals driven
     through ServingEngine.step, wall-clock tokens/s, TTFT/TPOT latency
     percentiles from the telemetry registry's histograms, decode-slot
@@ -205,6 +206,12 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
     shared-prefix KV cache on/off (None = ``DS_PREFIX_CACHE``). Rows
     report ``prefix_hit_rate``/``prefix_tokens_saved``/``prefill_chunks``
     so the on/off comparison shows the prefill work the cache removes.
+
+    ``spec_decode``/``spec_k`` pin speculative decoding inside the batch
+    (None = ``DS_SPEC_DECODE``/``DS_SPEC_K``); rows report the registry-
+    sourced ``accept_rate`` (drafts the target agreed with) and
+    ``tokens_per_step`` (emitted per slot per verify step — the
+    speculative speedup factor; 1.0 with speculation off).
     """
     from deepspeed_tpu.models import gpt
     import deepspeed_tpu
@@ -236,6 +243,7 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
     srv = ServingEngine(eng, num_slots=num_slots, block_size=block_size,
                         num_blocks=num_blocks, prefill_chunk=prefill_chunk,
                         decode_impl=decode_impl, prefix_cache=prefix_cache,
+                        spec_decode=spec_decode, spec_k=spec_k,
                         telemetry=Telemetry())
 
     rng = np.random.default_rng(seed)
@@ -260,7 +268,8 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
     # warmup: compile both slot programs before the timed drive
     w = ServingEngine(eng, num_slots=num_slots, block_size=block_size,
                       num_blocks=num_blocks, prefill_chunk=prefill_chunk,
-                      decode_impl=decode_impl, prefix_cache=prefix_cache)
+                      decode_impl=decode_impl, prefix_cache=prefix_cache,
+                      spec_decode=spec_decode, spec_k=spec_k)
     w.run([ServeRequest(rid="w", prompt=reqs[0].prompt.copy(),
                         max_new_tokens=2)])
 
@@ -321,6 +330,23 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
             st["prefix_hits"] / max(st["admitted"], 1), 3),
         "prefix_tokens_saved": st["prefix_tokens_saved"],
         "prefill_chunks": st["prefill_chunks"],
+        # speculative-decode columns, registry-sourced: accept_rate is
+        # drafts-the-target-agreed-with over drafts offered;
+        # tokens_per_step is emitted tokens per slot per verify step
+        # (the speedup factor — 1.0 exactly when speculation is off);
+        # ms_per_token is the TPOT histogram mean, the wall-clock the
+        # acceptance actually buys down
+        "spec_decode": bool(srv.spec_decode),
+        "spec_k": srv.spec_k if srv.spec_decode else 0,
+        "decode_steps": st["decode_steps"],
+        "accept_rate": round(
+            st["spec_accepted"] / max(st["spec_proposed"], 1), 3),
+        "tokens_per_step": round(
+            st["spec_emitted"] / st["spec_slot_steps"], 2)
+        if st["spec_slot_steps"] else 1.0,
+        "spec_fallbacks": st["spec_fallbacks"],
+        "ms_per_token": round(tpot_h.sum / tpot_h.count * 1e3, 3)
+        if tpot_h.count else 0.0,
         "cache_stats": cache.stats(),
     }
     if emit:
@@ -380,6 +406,29 @@ def bench_serving_prefix_compare(name, shared_prefix_len=64, **kw):
     }), flush=True)
 
 
+def bench_serving_spec_compare(name, **kw):
+    """Same serving drive with speculative decoding OFF then ON: greedy
+    streams must be identical (acceptance is target-argmax equality, so
+    speculation changes step count, never tokens), and the row is the
+    acceptance and per-token-latency delta the draft/verify loop buys."""
+    off = bench_serving(f"{name}[off]", spec_decode=False, **kw)
+    on = bench_serving(f"{name}[on]", spec_decode=True, **kw)
+    print(json.dumps({
+        "config": name, "preset": off["preset"],
+        "spec_decode": "off-vs-on", "spec_k": on["spec_k"],
+        "output_identical": off["_results"] == on["_results"],
+        "accept_rate": on["accept_rate"],
+        "tokens_per_step": on["tokens_per_step"],
+        "spec_fallbacks": on["spec_fallbacks"],
+        "decode_steps_off": off["decode_steps"],
+        "decode_steps_on": on["decode_steps"],
+        "ms_per_token_off": off["ms_per_token"],
+        "ms_per_token_on": on["ms_per_token"],
+        "tokens_per_s_off": off["tokens_per_s"],
+        "tokens_per_s_on": on["tokens_per_s"],
+    }), flush=True)
+
+
 SERVE_CONFIGS = [
     # CPU-verifiable smoke: staggered Poisson arrivals must batch
     # (mean_occupancy > 1) and the paged footprint must undercut the
@@ -426,6 +475,18 @@ SERVE_COMPARE_CONFIGS = [
         mean_gap_steps=1.5, prompt_lens=(16, 128), new_tokens=64,
         num_slots=8, block_size=16, prefill_chunk=128,
         shared_prefix_len=256)),
+    # speculative decoding on vs off over a self-similar greedy workload
+    # (tiny-model greedy loops repeat, exactly what the prompt-lookup
+    # drafter exploits): streams must be identical and the on row must
+    # report accept_rate > 0 / tokens_per_step > 1.0
+    ("serve-spec-smoke", dict(mode="spec", num_requests=8,
+                              mean_gap_steps=2.0, prompt_lens=(6, 20),
+                              new_tokens=16, num_slots=2, block_size=8,
+                              prefill_chunk=16)),
+    ("serve-spec-gpt2-medium", dict(
+        mode="spec", preset="gpt2-medium", num_requests=32,
+        mean_gap_steps=1.5, prompt_lens=(64, 384), new_tokens=64,
+        num_slots=8, block_size=16, prefill_chunk=128)),
 ]
 
 
@@ -461,8 +522,9 @@ def main():
     for name, kw in SERVE_COMPARE_CONFIGS:
         kw = dict(kw)
         mode = kw.pop("mode", "impl")
-        compare = (bench_serving_prefix_compare if mode == "prefix"
-                   else bench_serving_impl_compare)
+        compare = {"prefix": bench_serving_prefix_compare,
+                   "spec": bench_serving_spec_compare,
+                   }.get(mode, bench_serving_impl_compare)
         try:
             compare(name, **kw)
         except MemoryGuardError as e:
