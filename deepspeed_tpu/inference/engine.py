@@ -30,6 +30,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.models import gpt as gpt_lib
+from deepspeed_tpu.ops import quantizer
 from deepspeed_tpu.models.gpt import (GPTConfig, _dense,
                                       _norm, _qkv_split_rotary)
 from deepspeed_tpu.parallel import mesh as mesh_lib
@@ -249,7 +250,8 @@ def _gather_blocks(pool, tables):
 
 
 def _block_decode_paged(x, k_pool, v_pool, tables, lengths, active, p,
-                        cfg: GPTConfig, impl: str = "gather"):
+                        cfg: GPTConfig, impl: str = "gather",
+                        k_scale=None, v_scale=None):
     """One block for ONE new token per slot, K/V addressed through block
     tables — the paged generalization of _block_decode. x: [B, 1, D];
     pools [N, block, Hkv, Dh]; tables [B, NB]; lengths [B] per-slot
@@ -260,7 +262,14 @@ def _block_decode_paged(x, k_pool, v_pool, tables, lengths, active, p,
     impl="gather" materializes the virtual cache with _gather_blocks
     (the bit-reference, portable everywhere); impl="pallas" attends
     THROUGH the table with the flash-decode kernel (ops/attention/
-    paged.py) — one pool-block DMA per occupied block, no dense copy."""
+    paged.py) — one pool-block DMA per occupied block, no dense copy.
+
+    With ``k_scale``/``v_scale`` (``[N, Hkv]`` fp32) the pools are int8:
+    the write becomes read-modify-requantize of each slot's current
+    block (dequantize, insert the token, zero stale lanes, requantize —
+    ops/quantizer KV helpers), the scales update alongside, and the
+    returns grow to a 5-tuple. ``k_scale=None`` (the default) traces the
+    exact pre-quant program — the bit-reference path is untouched."""
     B, _, D = x.shape
     H, Dh = cfg.n_heads, cfg.head_dim
     Hkv = cfg.kv_heads
@@ -290,8 +299,23 @@ def _block_decode_paged(x, k_pool, v_pool, tables, lengths, active, p,
         tables, jnp.clip(lengths // bs, 0, NB - 1)[:, None], axis=1)[:, 0]
     blk = jnp.where(jnp.logical_and(active, in_cap), blk, 0)
     off = lengths % bs
-    k_pool = k_pool.at[blk, off].set(k)
-    v_pool = v_pool.at[blk, off].set(v)
+    if k_scale is None:
+        k_pool = k_pool.at[blk, off].set(k)
+        v_pool = v_pool.at[blk, off].set(v)
+    else:
+        kb = quantizer.kv_dequantize_blocks(k_pool[blk], k_scale[blk])
+        vb = quantizer.kv_dequantize_blocks(v_pool[blk], v_scale[blk])
+        rows = jnp.arange(B)
+        kb = kb.at[rows, off].set(k.astype(jnp.float32))
+        vb = vb.at[rows, off].set(v.astype(jnp.float32))
+        # lanes past the new token are a previous owner's garbage
+        live = jnp.arange(bs)[None, :] <= off[:, None]
+        kq, ksn = quantizer.kv_requantize_blocks(kb, live)
+        vq, vsn = quantizer.kv_requantize_blocks(vb, live)
+        k_pool = k_pool.at[blk].set(kq)
+        v_pool = v_pool.at[blk].set(vq)
+        k_scale = k_scale.at[blk].set(ksn)
+        v_scale = v_scale.at[blk].set(vsn)
 
     scale = cfg.attn_scale if cfg.attn_scale is not None \
         else 1.0 / np.sqrt(Dh)
@@ -299,10 +323,19 @@ def _block_decode_paged(x, k_pool, v_pool, tables, lengths, active, p,
         from deepspeed_tpu.ops.attention.paged import paged_decode_attention
         attn = paged_decode_attention(
             q, k_pool, v_pool, tables, lengths, scale=float(scale),
-            window=cfg.attn_window).reshape(B, 1, D)
+            window=cfg.attn_window, k_scale=k_scale,
+            v_scale=v_scale).reshape(B, 1, D)
     else:
-        kc = _gather_blocks(k_pool, tables)  # [B, NB*bs, Hkv, Dh]
-        vc = _gather_blocks(v_pool, tables)
+        if k_scale is None:
+            kc = _gather_blocks(k_pool, tables)  # [B, NB*bs, Hkv, Dh]
+            vc = _gather_blocks(v_pool, tables)
+        else:
+            kc = quantizer.kv_dequantize_blocks(
+                k_pool[tables], k_scale[tables],
+                dtype=x.dtype).reshape(B, NB * bs, Hkv, Dh)
+            vc = quantizer.kv_dequantize_blocks(
+                v_pool[tables], v_scale[tables],
+                dtype=x.dtype).reshape(B, NB * bs, Hkv, Dh)
         scores = jnp.einsum("bkgd,bskd->bkgs", q, kc).astype(jnp.float32)
         scores *= scale
         idx = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, NB * bs), 3)
@@ -316,14 +349,19 @@ def _block_decode_paged(x, k_pool, v_pool, tables, lengths, active, p,
         attn = jnp.einsum("bkgs,bskd->bkgd", probs, vc).reshape(B, 1, D)
     attn = _dense(attn, p["attn_out"])
     if cfg.parallel_residual:
-        return x + attn + _ffn(h, p, cfg), k_pool, v_pool
-    x = x + attn
-    h = _norm(x, p["ln2"], cfg)
-    return x + _ffn(h, p, cfg), k_pool, v_pool
+        y = x + attn + _ffn(h, p, cfg)
+    else:
+        x = x + attn
+        h = _norm(x, p["ln2"], cfg)
+        y = x + _ffn(h, p, cfg)
+    if k_scale is None:
+        return y, k_pool, v_pool
+    return y, k_pool, v_pool, k_scale, v_scale
 
 
 def _block_verify_paged(x, k_pool, v_pool, tables, lengths, active, p,
-                        cfg: GPTConfig, impl: str = "gather"):
+                        cfg: GPTConfig, impl: str = "gather",
+                        k_scale=None, v_scale=None):
     """One block for a G-token SPECULATIVE CHUNK per slot, K/V addressed
     through block tables — the q_len>1 generalization of
     _block_decode_paged for draft/verify serving. x: [B, G, D]; chunk
@@ -337,7 +375,12 @@ def _block_verify_paged(x, k_pool, v_pool, tables, lengths, active, p,
     Writes beyond the slot's allocated capacity (tokens_per_slot) route
     to the trash block, mirroring _block_decode_paged: the scheduler
     caps acceptance at the allocated capacity so logits from those
-    positions are never used."""
+    positions are never used.
+
+    With ``k_scale``/``v_scale`` the pools are int8 and the write is a
+    read-modify-requantize of the W consecutive blocks the G-token chunk
+    can straddle (W = 1 + ceil((G-1)/block)); returns grow to a 5-tuple.
+    ``k_scale=None`` traces the exact pre-quant program."""
     B, G, D = x.shape
     H, Dh = cfg.n_heads, cfg.head_dim
     Hkv = cfg.kv_heads
@@ -355,12 +398,52 @@ def _block_verify_paged(x, k_pool, v_pool, tables, lengths, active, p,
     # or inactive lanes land in trash block 0 (same belt-and-suspender
     # as the one-token decode scatter)
     in_cap = pos < NB * bs
-    blk = jnp.take_along_axis(tables, jnp.clip(pos // bs, 0, NB - 1),
-                              axis=1)                            # [B, G]
-    blk = jnp.where(jnp.logical_and(active[:, None], in_cap), blk, 0)
-    off = pos % bs
-    k_pool = k_pool.at[blk, off].set(k)
-    v_pool = v_pool.at[blk, off].set(v)
+    if k_scale is None:
+        blk = jnp.take_along_axis(tables, jnp.clip(pos // bs, 0, NB - 1),
+                                  axis=1)                        # [B, G]
+        blk = jnp.where(jnp.logical_and(active[:, None], in_cap), blk, 0)
+        off = pos % bs
+        k_pool = k_pool.at[blk, off].set(k)
+        v_pool = v_pool.at[blk, off].set(v)
+    else:
+        # read-modify-requantize the W consecutive table entries the
+        # chunk can touch, starting at the block holding position
+        # lengths[b]
+        W = 1 + (G + bs - 2) // bs
+        j0 = lengths // bs                                       # [B]
+        wj = j0[:, None] + jnp.arange(W, dtype=jnp.int32)[None]  # [B, W]
+        wjc = jnp.clip(wj, 0, NB - 1)
+        blkw = jnp.take_along_axis(tables, wjc, axis=1)          # [B, W]
+        kb = quantizer.kv_dequantize_blocks(k_pool[blkw], k_scale[blkw])
+        vb = quantizer.kv_dequantize_blocks(v_pool[blkw], v_scale[blkw])
+        # chunk token i of slot b lands at window-flat lane
+        # (pos//bs - j0)*bs + pos%bs; masked lanes drop out of bounds
+        tgt = (pos // bs - j0[:, None]) * bs + pos % bs          # [B, G]
+        writable = jnp.logical_and(active[:, None], in_cap)
+        tgt = jnp.where(writable, tgt, W * bs)
+        rows = jnp.arange(B, dtype=jnp.int32)[:, None]
+        kb = kb.reshape(B, W * bs, Hkv, Dh).at[rows, tgt].set(
+            k.astype(jnp.float32),
+            mode="drop").reshape(B, W, bs, Hkv, Dh)
+        vb = vb.reshape(B, W * bs, Hkv, Dh).at[rows, tgt].set(
+            v.astype(jnp.float32),
+            mode="drop").reshape(B, W, bs, Hkv, Dh)
+        # lanes at global positions past the chunk's end are stale
+        glob = wj[:, :, None] * bs + \
+            jnp.arange(bs, dtype=jnp.int32)[None, None, :]       # [B, W, bs]
+        new_len = jnp.minimum(lengths + G, NB * bs)
+        live = glob < new_len[:, None, None]
+        kq, ksn = quantizer.kv_requantize_blocks(kb, live)
+        vq, vsn = quantizer.kv_requantize_blocks(vb, live)
+        # window entries past the slot's last written block (and inactive
+        # slots entirely) route to the trash block
+        jhi = jnp.minimum((lengths + G - 1) // bs, NB - 1)
+        touched = jnp.logical_and(wj <= jhi[:, None], active[:, None])
+        blkw = jnp.where(touched, blkw, 0)
+        k_pool = k_pool.at[blkw].set(kq)
+        v_pool = v_pool.at[blkw].set(vq)
+        k_scale = k_scale.at[blkw].set(ksn)
+        v_scale = v_scale.at[blkw].set(vsn)
 
     scale = cfg.attn_scale if cfg.attn_scale is not None \
         else 1.0 / np.sqrt(Dh)
@@ -368,10 +451,19 @@ def _block_verify_paged(x, k_pool, v_pool, tables, lengths, active, p,
         from deepspeed_tpu.ops.attention.paged import paged_verify_attention
         attn = paged_verify_attention(
             qg, k_pool, v_pool, tables, lengths, scale=float(scale),
-            window=cfg.attn_window).reshape(B, G, D)
+            window=cfg.attn_window, k_scale=k_scale,
+            v_scale=v_scale).reshape(B, G, D)
     else:
-        kc = _gather_blocks(k_pool, tables)  # [B, NB*bs, Hkv, Dh]
-        vc = _gather_blocks(v_pool, tables)
+        if k_scale is None:
+            kc = _gather_blocks(k_pool, tables)  # [B, NB*bs, Hkv, Dh]
+            vc = _gather_blocks(v_pool, tables)
+        else:
+            kc = quantizer.kv_dequantize_blocks(
+                k_pool[tables], k_scale[tables],
+                dtype=x.dtype).reshape(B, NB * bs, Hkv, Dh)
+            vc = quantizer.kv_dequantize_blocks(
+                v_pool[tables], v_scale[tables],
+                dtype=x.dtype).reshape(B, NB * bs, Hkv, Dh)
         scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, kc).astype(jnp.float32)
         scores *= scale
         idx = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, 1, NB * bs), 4)
@@ -383,14 +475,18 @@ def _block_verify_paged(x, k_pool, v_pool, tables, lengths, active, p,
         attn = jnp.einsum("bkgqs,bskd->bqkgd", probs, vc).reshape(B, G, D)
     attn = _dense(attn, p["attn_out"])
     if cfg.parallel_residual:
-        return x + attn + _ffn(h, p, cfg), k_pool, v_pool
-    x = x + attn
-    h = _norm(x, p["ln2"], cfg)
-    return x + _ffn(h, p, cfg), k_pool, v_pool
+        y = x + attn + _ffn(h, p, cfg)
+    else:
+        x = x + attn
+        h = _norm(x, p["ln2"], cfg)
+        y = x + _ffn(h, p, cfg)
+    if k_scale is None:
+        return y, k_pool, v_pool
+    return y, k_pool, v_pool, k_scale, v_scale
 
 
 def _block_prefill_paged(x, k_pool, v_pool, table_row, positions, n_valid,
-                         p, cfg: GPTConfig):
+                         p, cfg: GPTConfig, k_scale=None, v_scale=None):
     """Forward one block over a PROMPT CHUNK for one slot, writing the
     chunk's K/V through the slot's block table and attending over the
     slot's full cache so far (history from earlier chunks + this chunk)
@@ -398,7 +494,15 @@ def _block_prefill_paged(x, k_pool, v_pool, table_row, positions, n_valid,
     long prompts. x: [1, C, D]; positions: [C] global cache positions of
     the chunk tokens; n_valid: how many of the C lanes are real (the
     chunk is padded to a fixed width so ONE compiled program serves
-    every chunk)."""
+    every chunk).
+
+    With ``k_scale``/``v_scale`` the pools are int8: the slot's whole
+    virtual row (gathered for attention anyway) is dequantized, the
+    chunk inserted, and ONLY the chunk-touched blocks requantized —
+    untouched blocks (including shared prefix blocks mapped read-only)
+    are written back byte-identical, so sharing semantics are
+    preserved. Returns grow to a 5-tuple; ``k_scale=None`` traces the
+    exact pre-quant program."""
     B, C, D = x.shape
     H, Dh = cfg.n_heads, cfg.head_dim
     Hkv = cfg.kv_heads
@@ -411,14 +515,54 @@ def _block_prefill_paged(x, k_pool, v_pool, table_row, positions, n_valid,
     q, k, v = gpt_lib._qkv_split_rotary(qkv, cfg, positions[None], B, C)
 
     valid = jnp.arange(C) < n_valid
-    blk = table_row[jnp.clip(positions // bs, 0, NB - 1)]
-    blk = jnp.where(valid, blk, 0)           # padded lanes -> trash block
-    off = positions % bs
-    k_pool = k_pool.at[blk, off].set(k[0])
-    v_pool = v_pool.at[blk, off].set(v[0])
+    if k_scale is None:
+        blk = table_row[jnp.clip(positions // bs, 0, NB - 1)]
+        blk = jnp.where(valid, blk, 0)       # padded lanes -> trash block
+        off = positions % bs
+        k_pool = k_pool.at[blk, off].set(k[0])
+        v_pool = v_pool.at[blk, off].set(v[0])
 
-    kc = k_pool[table_row].reshape(NB * bs, Hkv, Dh)
-    vc = v_pool[table_row].reshape(NB * bs, Hkv, Dh)
+        kc = k_pool[table_row].reshape(NB * bs, Hkv, Dh)
+        vc = v_pool[table_row].reshape(NB * bs, Hkv, Dh)
+    else:
+        kb = quantizer.kv_dequantize_blocks(k_pool[table_row],
+                                            k_scale[table_row])
+        vb = quantizer.kv_dequantize_blocks(v_pool[table_row],
+                                            v_scale[table_row])
+        tgt = jnp.where(jnp.logical_and(valid, positions < NB * bs),
+                        positions, NB * bs)  # padded lanes drop
+        kb = kb.reshape(NB * bs, Hkv, Dh).at[tgt].set(
+            k[0].astype(jnp.float32), mode="drop").reshape(NB, bs, Hkv, Dh)
+        vb = vb.reshape(NB * bs, Hkv, Dh).at[tgt].set(
+            v[0].astype(jnp.float32), mode="drop").reshape(NB, bs, Hkv, Dh)
+        start = positions[0]
+        new_total = start + n_valid
+        glob = jnp.arange(NB, dtype=jnp.int32)[:, None] * bs + \
+            jnp.arange(bs, dtype=jnp.int32)[None]
+        live = glob < new_total
+        kq, ksn = quantizer.kv_requantize_blocks(kb, live)
+        vq, vsn = quantizer.kv_requantize_blocks(vb, live)
+        # requantize only the chunk-touched blocks; everything else is
+        # scattered back byte-identical (shared prefix blocks included)
+        j = jnp.arange(NB, dtype=jnp.int32)
+        j0 = start // bs
+        j1 = jnp.maximum(start + n_valid - 1, start) // bs
+        touched = jnp.logical_and(j >= j0, j <= j1)
+        kq = jnp.where(touched[:, None, None, None], kq,
+                       k_pool[table_row])
+        vq = jnp.where(touched[:, None, None, None], vq,
+                       v_pool[table_row])
+        ksn = jnp.where(touched[:, None], ksn, k_scale[table_row])
+        vsn = jnp.where(touched[:, None], vsn, v_scale[table_row])
+        k_pool = k_pool.at[table_row].set(kq)
+        v_pool = v_pool.at[table_row].set(vq)
+        k_scale = k_scale.at[table_row].set(ksn)
+        v_scale = v_scale.at[table_row].set(vsn)
+        # attend over exactly what the pool now holds
+        kc = quantizer.kv_dequantize_blocks(
+            kq, ksn, dtype=x.dtype).reshape(NB * bs, Hkv, Dh)
+        vc = quantizer.kv_dequantize_blocks(
+            vq, vsn, dtype=x.dtype).reshape(NB * bs, Hkv, Dh)
     qg = q[0].reshape(C, Hkv, group, Dh)
     scores = jnp.einsum("ckgd,skd->ckgs", qg, kc).astype(jnp.float32)
     scores *= cfg.attn_scale if cfg.attn_scale is not None \
@@ -432,10 +576,14 @@ def _block_prefill_paged(x, k_pool, v_pool, table_row, positions, n_valid,
     attn = jnp.einsum("ckgs,skd->ckgd", probs, vc).reshape(1, C, D)
     attn = _dense(attn, p["attn_out"])
     if cfg.parallel_residual:
-        return x + attn + _ffn(h, p, cfg), k_pool, v_pool
-    x = x + attn
-    h = _norm(x, p["ln2"], cfg)
-    return x + _ffn(h, p, cfg), k_pool, v_pool
+        y = x + attn + _ffn(h, p, cfg)
+    else:
+        x = x + attn
+        h = _norm(x, p["ln2"], cfg)
+        y = x + _ffn(h, p, cfg)
+    if k_scale is None:
+        return y, k_pool, v_pool
+    return y, k_pool, v_pool, k_scale, v_scale
 
 
 class InferenceEngine:
@@ -576,6 +724,21 @@ class InferenceEngine:
             # cache on)
             self._cow_blocks = jax.jit(self._cow_blocks_fn,
                                        donate_argnums=(0, 1))
+            # int8 KV-cache twins (DS_KV_QUANT=int8): same program COUNT
+            # as the fp path — a quantized serving run compiles ONLY
+            # these (the fp programs above stay cold), so the steady-
+            # state compile contract is unchanged. The scale pools are
+            # donated alongside the int8 pools.
+            self._prefill_slot_q = jax.jit(self._prefill_slot_q_fn,
+                                           donate_argnums=(1, 2, 3, 4))
+            self._decode_slots_q = jax.jit(self._decode_slots_q_fn,
+                                           donate_argnums=(1, 2, 3, 4),
+                                           static_argnums=(9,))
+            self._verify_slots_q = jax.jit(self._verify_slots_q_fn,
+                                           donate_argnums=(1, 2, 3, 4),
+                                           static_argnums=(9,))
+            self._cow_blocks_q = jax.jit(self._cow_blocks_q_fn,
+                                         donate_argnums=(0, 1, 2, 3))
         log_dist(f"inference engine: {config.n_layers}L/{config.d_model}d "
                  f"mp={mp_size} dtype={jnp.dtype(dtype).name} "
                  f"{'encoder' if self.is_encoder else 'decoder'}",
@@ -794,6 +957,90 @@ class InferenceEngine:
                                 jnp.asarray(src, jnp.int32),
                                 jnp.asarray(dst, jnp.int32))
 
+    def _prefill_slot_q_fn(self, params, k_pool, v_pool, k_scale, v_scale,
+                           table_row, tokens, start, n_valid):
+        """int8-pool twin of _prefill_slot_fn: the per-layer scale pools
+        ([L, N, Hkv] fp32) thread through the scan alongside the pools
+        and the block write is the read-modify-requantize path of
+        _block_prefill_paged."""
+        cfg = self.cfg
+        C = tokens.shape[0]
+        positions = start + jnp.arange(C, dtype=jnp.int32)
+        x = params["wte"]["embedding"][tokens][None]
+        if cfg.use_wpe:
+            safe = jnp.clip(positions, 0, self.max_seq_len - 1)
+            x = x + params["wpe"]["embedding"][safe][None]
+
+        def body(x, layer):
+            layer_p, kp, vp, ksp, vsp = layer
+            y, kp, vp, ksp, vsp = _block_prefill_paged(
+                x, kp, vp, table_row, positions, n_valid, layer_p, cfg,
+                k_scale=ksp, v_scale=vsp)
+            return y, (kp, vp, ksp, vsp)
+
+        x, (ks, vs, kss, vss) = jax.lax.scan(
+            body, x, (params["block"], k_pool, v_pool, k_scale, v_scale))
+        last = jnp.clip(n_valid - 1, 0, C - 1)
+        x_last = jax.lax.dynamic_slice_in_dim(x, last, 1, axis=1)
+        return self._logits(params, x_last), ks, vs, kss, vss
+
+    def _decode_slots_q_fn(self, params, k_pool, v_pool, k_scale, v_scale,
+                           tables, lengths, tokens, active, impl="gather"):
+        """int8-pool twin of _decode_slots_fn (see _block_decode_paged's
+        quantized write path)."""
+        cfg = self.cfg
+        x = params["wte"]["embedding"][tokens[:, None]]
+        if cfg.use_wpe:
+            safe = jnp.clip(lengths, 0, self.max_seq_len - 1)
+            x = x + params["wpe"]["embedding"][safe][:, None]
+
+        def body(x, layer):
+            layer_p, kp, vp, ksp, vsp = layer
+            y, kp, vp, ksp, vsp = _block_decode_paged(
+                x, kp, vp, tables, lengths, active, layer_p, cfg,
+                impl=impl, k_scale=ksp, v_scale=vsp)
+            return y, (kp, vp, ksp, vsp)
+
+        x, (ks, vs, kss, vss) = jax.lax.scan(
+            body, x, (params["block"], k_pool, v_pool, k_scale, v_scale))
+        return self._logits(params, x), ks, vs, kss, vss
+
+    def _verify_slots_q_fn(self, params, k_pool, v_pool, k_scale, v_scale,
+                           tables, lengths, tokens, active, impl="gather"):
+        """int8-pool twin of _verify_slots_fn (see _block_verify_paged's
+        quantized write path)."""
+        cfg = self.cfg
+        B, G = tokens.shape
+        x = params["wte"]["embedding"][tokens]
+        if cfg.use_wpe:
+            pos = lengths[:, None] + jnp.arange(G, dtype=jnp.int32)[None]
+            safe = jnp.clip(pos, 0, self.max_seq_len - 1)
+            x = x + params["wpe"]["embedding"][safe]
+
+        def body(x, layer):
+            layer_p, kp, vp, ksp, vsp = layer
+            y, kp, vp, ksp, vsp = _block_verify_paged(
+                x, kp, vp, tables, lengths, active, layer_p, cfg,
+                impl=impl, k_scale=ksp, v_scale=vsp)
+            return y, (kp, vp, ksp, vsp)
+
+        x, (ks, vs, kss, vss) = jax.lax.scan(
+            body, x, (params["block"], k_pool, v_pool, k_scale, v_scale))
+        return self._logits(params, x), ks, vs, kss, vss
+
+    def _cow_blocks_q_fn(self, k_pool, v_pool, k_scale, v_scale, src, dst):
+        """Quantized-pool COW: the block's scales travel with its int8
+        payload (paged_cache._cow wires this in when kv_quant=int8)."""
+        return (k_pool.at[:, dst].set(k_pool[:, src]),
+                v_pool.at[:, dst].set(v_pool[:, src]),
+                k_scale.at[:, dst].set(k_scale[:, src]),
+                v_scale.at[:, dst].set(v_scale[:, src]))
+
+    def cow_blocks_q(self, k_pool, v_pool, k_scale, v_scale, src, dst):
+        return self._cow_blocks_q(k_pool, v_pool, k_scale, v_scale,
+                                  jnp.asarray(src, jnp.int32),
+                                  jnp.asarray(dst, jnp.int32))
+
     def sync(self, *values) -> None:
         """Barrier on device values (pools, logits): the telemetry
         step-time breakdown's sampled sync point — same discipline as
@@ -806,38 +1053,66 @@ class InferenceEngine:
     # donated pools, so a TransientDeviceError here is retryable by the
     # serving engine against intact buffers (utils/faults).
     def prefill_into_slot(self, k_pool, v_pool, table_row, tokens, start,
-                          n_valid):
+                          n_valid, k_scale=None, v_scale=None):
         from deepspeed_tpu.utils.faults import maybe_fire
         maybe_fire("engine.prefill")
-        return self._prefill_slot(
-            self.params, k_pool, v_pool,
+        if k_scale is None:
+            return self._prefill_slot(
+                self.params, k_pool, v_pool,
+                jnp.asarray(table_row, jnp.int32),
+                jnp.asarray(tokens, jnp.int32),
+                jnp.asarray(start, jnp.int32),
+                jnp.asarray(n_valid, jnp.int32))
+        # ``cache.quantize`` fires before the dispatch touches the
+        # donated pools OR scale pools: a TransientDeviceError here is
+        # retryable against intact buffers
+        maybe_fire("cache.quantize")
+        return self._prefill_slot_q(
+            self.params, k_pool, v_pool, k_scale, v_scale,  # dslint: disable=DS003 — exclusive branch: the fp dispatch above already returned
             jnp.asarray(table_row, jnp.int32),
             jnp.asarray(tokens, jnp.int32),
             jnp.asarray(start, jnp.int32), jnp.asarray(n_valid, jnp.int32))
 
     def decode_slots(self, k_pool, v_pool, tables, lengths, tokens, active,
-                     impl=None):
+                     impl=None, k_scale=None, v_scale=None):
         from deepspeed_tpu.utils.faults import maybe_fire
         maybe_fire("engine.decode")
-        return self._decode_slots(
-            self.params, k_pool, v_pool,
+        if k_scale is None:
+            return self._decode_slots(
+                self.params, k_pool, v_pool,
+                jnp.asarray(tables, jnp.int32),
+                jnp.asarray(lengths, jnp.int32),
+                jnp.asarray(tokens, jnp.int32), jnp.asarray(active, bool),
+                self.decode_impl if impl is None else impl)
+        maybe_fire("cache.quantize")
+        return self._decode_slots_q(
+            self.params, k_pool, v_pool, k_scale, v_scale,  # dslint: disable=DS003 — exclusive branch: the fp dispatch above already returned
             jnp.asarray(tables, jnp.int32),
             jnp.asarray(lengths, jnp.int32),
             jnp.asarray(tokens, jnp.int32), jnp.asarray(active, bool),
             self.decode_impl if impl is None else impl)
 
     def verify_slots(self, k_pool, v_pool, tables, lengths, tokens, active,
-                     impl=None):
+                     impl=None, k_scale=None, v_scale=None):
         """Speculative chunk verify for every serving slot (tokens:
         [B, G] — each slot's pending token followed by its draft
-        proposals). The ``engine.verify`` fault site fires BEFORE the
-        dispatch touches the donated pools, so the serving engine can
-        degrade a faulted verify to a plain one-token decode against
-        intact buffers."""
+        proposals). The ``engine.verify`` fault site (and
+        ``cache.quantize`` with int8 pools) fires BEFORE the dispatch
+        touches the donated pools, so the serving engine can degrade a
+        faulted verify to a plain one-token decode against intact
+        buffers."""
         from deepspeed_tpu.utils.faults import maybe_fire
         maybe_fire("engine.verify")
-        return self._verify_slots(
-            self.params, k_pool, v_pool,
+        if k_scale is None:
+            return self._verify_slots(
+                self.params, k_pool, v_pool,
+                jnp.asarray(tables, jnp.int32),
+                jnp.asarray(lengths, jnp.int32),
+                jnp.asarray(tokens, jnp.int32), jnp.asarray(active, bool),
+                self.decode_impl if impl is None else impl)
+        maybe_fire("cache.quantize")
+        return self._verify_slots_q(
+            self.params, k_pool, v_pool, k_scale, v_scale,  # dslint: disable=DS003 — exclusive branch: the fp dispatch above already returned
             jnp.asarray(tables, jnp.int32),
             jnp.asarray(lengths, jnp.int32),
             jnp.asarray(tokens, jnp.int32), jnp.asarray(active, bool),

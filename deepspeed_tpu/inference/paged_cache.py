@@ -50,6 +50,7 @@ import numpy as np
 from deepspeed_tpu.inference.prefix_index import PrefixIndex, PrefixMatch
 from deepspeed_tpu.models import gpt as gpt_lib
 from deepspeed_tpu.models.gpt import GPTConfig
+from deepspeed_tpu.ops.quantizer import resolve_kv_quant
 
 
 class CacheExhausted(Exception):
@@ -87,6 +88,19 @@ def _cow_copy_fn(k_pool, v_pool, src, dst):
 _default_cow = jax.jit(_cow_copy_fn, donate_argnums=(0, 1))
 
 
+def _cow_copy_fn_q(k_pool, v_pool, k_scale, v_scale, src, dst):
+    """Quantized-pool COW: the block's per-(block, kv_head) scales travel
+    with its int8 payload — a shared block and its copy dequantize to the
+    same values."""
+    return (k_pool.at[:, dst].set(k_pool[:, src]),
+            v_pool.at[:, dst].set(v_pool[:, src]),
+            k_scale.at[:, dst].set(k_scale[:, src]),
+            v_scale.at[:, dst].set(v_scale[:, src]))
+
+
+_default_cow_q = jax.jit(_cow_copy_fn_q, donate_argnums=(0, 1, 2, 3))
+
+
 class PagedKVCache:
     """Pool + allocator + per-slot block tables (+ optional prefix index).
 
@@ -103,6 +117,13 @@ class PagedKVCache:
     ``copy_fn(k, v, src, dst) -> (k, v)`` performs the COW block copy —
     the serving engine wires the engine's donated program in; standalone
     caches fall back to a module-level jitted copy.
+
+    With ``kv_quant="int8"`` (or ``DS_KV_QUANT=int8``) the pools store
+    int8 with fp32 per-(block, kv_head) scales in parallel ``k_scale`` /
+    ``v_scale`` pools ``[L, N_blocks, Hkv]``; ``copy_fn`` then takes and
+    returns the scale pools too (``(k, v, ks, vs, src, dst) -> 4-tuple``)
+    so scales travel with blocks on COW. ``"off"`` (default) keeps the
+    fp pools byte-identical to the unquantized cache — the bit-reference.
     """
 
     def __init__(self, cfg: GPTConfig, *, num_slots: int,
@@ -112,7 +133,8 @@ class PagedKVCache:
                  watermark: Optional[int] = None, faults=None,
                  prefix_cache: bool = False,
                  copy_fn: Optional[Callable] = None,
-                 tracer=None):
+                 tracer=None,
+                 kv_quant: Optional[str] = None):
         self.cfg = cfg
         # telemetry hook (telemetry/tracer.RequestTracer): COW copies
         # and index-block reclaims land in the serving timeline; None
@@ -130,26 +152,47 @@ class PagedKVCache:
         self.blocks_per_slot, self.tokens_per_slot = gpt_lib.decode_geometry(
             cfg, self.block_size, max_seq_len)
         self.dtype = jnp.dtype(dtype)
-        self.bytes_per_token = gpt_lib.kv_bytes_per_token(cfg, dtype)
+        # KV quantization: int8 pools + fp32 per-(block, kv_head) scale
+        # pools ("off" keeps the fp pools bit-identical to before)
+        self.kv_quant = resolve_kv_quant(kv_quant)
+        self.quantized = self.kv_quant == "int8"
+        L, Hkv, Dh = cfg.n_layers, cfg.kv_heads, cfg.head_dim
+        self.pool_dtype = jnp.dtype(jnp.int8) if self.quantized \
+            else self.dtype
+        self.bytes_per_token = gpt_lib.kv_bytes_per_token(
+            cfg, self.pool_dtype)
+        # scale overhead: 2 pools (K and V) × L layers × Hkv heads × fp32
+        # per block — amortized it is 2*L*Hkv*4/block_size bytes/token
+        self.scale_bytes_per_block = (2 * L * Hkv * 4) if self.quantized \
+            else 0
         if num_blocks is None:
-            if not hbm_budget_bytes:
+            if hbm_budget_bytes:
+                per_block = (self.bytes_per_token * self.block_size
+                             + self.scale_bytes_per_block)
+                num_blocks = int(hbm_budget_bytes // per_block)
+            else:
                 # default pool: the static reservation's worth of blocks
                 # (num_slots full sequences) — usage accounting then shows
-                # how far actual tokens-in-flight undercut it
-                hbm_budget_bytes = (self.num_slots * self.tokens_per_slot
-                                    * self.bytes_per_token)
-            per_block = self.bytes_per_token * self.block_size
-            num_blocks = int(hbm_budget_bytes // per_block)
+                # how far actual tokens-in-flight undercut it. Counted in
+                # blocks, not bytes: under kv_quant the scale sidecar must
+                # not shave the pool below its own slots' capacity
+                num_blocks = self.num_slots * self.blocks_per_slot
         # +1: block 0 is the reserved trash block, never allocated
         self.num_blocks = int(num_blocks) + 1
         if self.num_blocks < 2:
             raise ValueError(
                 f"HBM budget covers {self.num_blocks - 1} blocks; the "
                 f"pool needs at least 1 allocatable block")
-        L, Hkv, Dh = cfg.n_layers, cfg.kv_heads, cfg.head_dim
         self.k = jnp.zeros((L, self.num_blocks, self.block_size, Hkv, Dh),
-                           dtype)
+                           self.pool_dtype)
         self.v = jnp.zeros_like(self.k)
+        if self.quantized:
+            self.k_scale = jnp.zeros((L, self.num_blocks, Hkv),
+                                     jnp.float32)
+            self.v_scale = jnp.zeros_like(self.k_scale)
+        else:
+            self.k_scale = None
+            self.v_scale = None
         self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
         self._owned: List[List[int]] = [[] for _ in range(num_slots)]
         self._refcount = np.zeros((self.num_blocks,), np.int32)
@@ -231,8 +274,10 @@ class PagedKVCache:
     def used_block_bytes(self) -> int:
         """Bytes actually held by allocated blocks — what the bench's
         'paged peak HBM' row reports (scales with tokens in flight,
-        block-quantized)."""
-        return self.used_blocks * self.block_size * self.bytes_per_token
+        block-quantized). Includes the per-block scale overhead when the
+        pool is int8."""
+        return self.used_blocks * (self.block_size * self.bytes_per_token
+                                   + self.scale_bytes_per_block)
 
     def static_equivalent_bytes(self, batch: int,
                                 max_seq_len: Optional[int] = None) -> int:
@@ -483,13 +528,23 @@ class PagedKVCache:
         so the first real divergence — possibly inside a CompileWatch-
         guarded steady state — hits a warm cache."""
         if self.prefix_cache:
-            fn = self.copy_fn if self.copy_fn is not None else _default_cow
-            self.k, self.v = fn(self.k, self.v, np.int32(0), np.int32(0))
+            self._run_cow(np.int32(0), np.int32(0))
 
     # -- internals -----------------------------------------------------
+    def _run_cow(self, src, dst) -> None:
+        """Dispatch the (quant-aware) COW copy program, rebinding pools
+        (and scale pools when quantized) from its donated outputs."""
+        if self.quantized:
+            fn = self.copy_fn if self.copy_fn is not None \
+                else _default_cow_q
+            (self.k, self.v, self.k_scale, self.v_scale) = fn(
+                self.k, self.v, self.k_scale, self.v_scale, src, dst)
+        else:
+            fn = self.copy_fn if self.copy_fn is not None else _default_cow
+            self.k, self.v = fn(self.k, self.v, src, dst)
+
     def _cow(self, src: int, dst: int) -> None:
-        fn = self.copy_fn if self.copy_fn is not None else _default_cow
-        self.k, self.v = fn(self.k, self.v, np.int32(src), np.int32(dst))
+        self._run_cow(np.int32(src), np.int32(dst))
         self.cow_copies += 1
         if self.tracer is not None:
             self.tracer.event("cow", src=src, dst=dst)

@@ -128,6 +128,7 @@ from deepspeed_tpu.inference.paged_cache import (CacheExhausted,
 from deepspeed_tpu.inference.spec_decode import (make_draft,
                                                  resolve_spec_decode,
                                                  resolve_spec_k)
+from deepspeed_tpu.ops.quantizer import resolve_kv_quant
 from deepspeed_tpu.telemetry import (NOOP, MetricsRegistry, NoopTelemetry,
                                      RATE_BUCKETS, Telemetry,
                                      resolve_telemetry)
@@ -281,6 +282,11 @@ class ServingEngine:
       (prompt-lookup, default), a draft ``InferenceEngine``, or any
       ``propose(context, k)`` object. Greedy-only: spec with
       ``temperature > 0`` raises (acceptance needs the target argmax).
+    - ``kv_quant``: int8 paged KV-cache blocks with per-block scales
+      (docs/KV_QUANT.md) — ~2x decode slots at the same cache HBM.
+      ``"int8"``/``"off"``; None defers to ``DS_KV_QUANT`` (default
+      off — the unquantized pool stays the bit-reference; int8 is
+      held to a documented greedy-match tolerance, not bit equality).
     """
 
     def __init__(self, engine, *, num_slots: int = 4, block_size: int = 16,
@@ -299,7 +305,8 @@ class ServingEngine:
                  telemetry=None,
                  spec_decode: Optional[bool] = None,
                  spec_k: Optional[int] = None,
-                 spec_draft=None):
+                 spec_draft=None,
+                 kv_quant: Optional[str] = None):
         if engine.is_encoder:
             raise ValueError("serving needs a causal decoder engine")
         self.engine = engine
@@ -321,12 +328,20 @@ class ServingEngine:
             self.decode_impl = resolve_decode_impl(decode_impl)
         self.faults = faults if faults is not None else faults_lib.active()
         self.prefix_cache = resolve_prefix_cache(prefix_cache)
+        # int8 KV-cache pools with per-block scales (DS_KV_QUANT=int8):
+        # resolved once here, pinned for the run — the quantized slot
+        # programs are separate executables, so a run uses EITHER the fp
+        # set or the int8 set, never both
+        self.kv_quant = resolve_kv_quant(kv_quant)
+        self._quant = self.kv_quant == "int8"
+        cow = getattr(engine, "cow_blocks_q" if self._quant
+                      else "cow_blocks", None)
         self.cache = PagedKVCache(
             engine.cfg, num_slots=num_slots, block_size=block_size,
             num_blocks=num_blocks, hbm_budget_bytes=hbm_budget_bytes,
             dtype=engine.dtype, max_seq_len=engine.max_seq_len,
             faults=self.faults, prefix_cache=self.prefix_cache,
-            copy_fn=getattr(engine, "cow_blocks", None),
+            copy_fn=cow, kv_quant=self.kv_quant,
             tracer=self.telemetry.tracer
             if self.telemetry.enabled else None)
         mesh = getattr(engine, "mesh", None)
@@ -340,6 +355,11 @@ class ServingEngine:
             pool_sh = NamedSharding(mesh, PartitionSpec())
             self.cache.k = jax.device_put(self.cache.k, pool_sh)
             self.cache.v = jax.device_put(self.cache.v, pool_sh)
+            if self._quant:
+                self.cache.k_scale = jax.device_put(self.cache.k_scale,
+                                                    pool_sh)
+                self.cache.v_scale = jax.device_put(self.cache.v_scale,
+                                                    pool_sh)
         # compile the COW copy program now (after pool placement, so the
         # warmed executable matches steady-state shardings): the first
         # mid-block divergence must not add a compile inside the
@@ -422,6 +442,28 @@ class ServingEngine:
                 "tokens emitted per live slot per verify step",
                 buckets=tuple(float(i)
                               for i in range(1, self.spec_k + 2)))
+            # KV-pool shape of THIS run (static per run, gauges so the
+            # Prometheus text path exports them next to the block
+            # gauges): bytes/token includes the amortized per-block
+            # scale overhead under int8
+            self._g_kv_bpt = reg.gauge(
+                "kv_cache_bytes_per_token",
+                "KV pool bytes per cached token (all layers, K+V, "
+                "including per-block scale overhead when quantized)")
+            self._g_kv_bpt.set(
+                self.cache.bytes_per_token
+                + self.cache.scale_bytes_per_block / self.cache.block_size)
+            self._g_kv_dtype = reg.gauge(
+                "kv_pool_dtype", "KV pool element width in bits "
+                "(8 = int8 quantized, 16 = bf16, 32 = f32)")
+            self._g_kv_dtype.set(self.cache.pool_dtype.itemsize * 8)
+            self._h_kv_err = reg.histogram(
+                "serving_kv_quant_error",
+                "sampled upper bound on the max-abs KV dequantization "
+                "error (half the hottest block's quantization step)",
+                buckets=(1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3,
+                         1e-2, 2.5e-2, 5e-2, 1e-1, 2.5e-1)) \
+                if self._quant else None
 
             def _on_fault(site: str, kind: str, visit: int) -> None:
                 # injected faults land in the SAME timeline as the
@@ -436,6 +478,7 @@ class ServingEngine:
         else:
             self._h_ttft = self._h_tpot = self._h_qwait = self._h_occ = None
             self._h_accept = self._h_tps = None
+            self._h_kv_err = None
             self._fault_listener = None
 
     # -- API -----------------------------------------------------------
@@ -634,10 +677,18 @@ class ServingEngine:
             n = min(self.prefill_chunk, len(req._work) - done)
             chunk = np.zeros((self.prefill_chunk,), np.int32)
             chunk[:n] = req._work[done:done + n]
-            logits, self.cache.k, self.cache.v = self._device_call(
-                "serving.prefill", self.engine.prefill_into_slot,
-                self.cache.k, self.cache.v, self.cache.tables[slot],
-                chunk, done, n)
+            if self._quant:
+                (logits, self.cache.k, self.cache.v, self.cache.k_scale,
+                 self.cache.v_scale) = self._device_call(
+                    "serving.prefill", self.engine.prefill_into_slot,
+                    self.cache.k, self.cache.v, self.cache.tables[slot],
+                    chunk, done, n, self.cache.k_scale,
+                    self.cache.v_scale)
+            else:
+                logits, self.cache.k, self.cache.v = self._device_call(
+                    "serving.prefill", self.engine.prefill_into_slot,
+                    self.cache.k, self.cache.v, self.cache.tables[slot],
+                    chunk, done, n)
             self.cache.advance(slot, n)
             self._progress[slot] = done + n
             self._stat["prefill_chunks"].inc()
@@ -720,10 +771,18 @@ class ServingEngine:
             active[i] = True
         budget = self.step_time_budget_s
         t0 = time.perf_counter() if budget is not None else 0.0
-        logits, self.cache.k, self.cache.v = self._device_call(
-            "serving.decode", self.engine.decode_slots,
-            self.cache.k, self.cache.v, self.cache.tables,
-            self.cache.lengths, tokens, active, self.decode_impl)
+        if self._quant:
+            (logits, self.cache.k, self.cache.v, self.cache.k_scale,
+             self.cache.v_scale) = self._device_call(
+                "serving.decode", self.engine.decode_slots,
+                self.cache.k, self.cache.v, self.cache.tables,
+                self.cache.lengths, tokens, active, self.decode_impl,
+                self.cache.k_scale, self.cache.v_scale)
+        else:
+            logits, self.cache.k, self.cache.v = self._device_call(
+                "serving.decode", self.engine.decode_slots,
+                self.cache.k, self.cache.v, self.cache.tables,
+                self.cache.lengths, tokens, active, self.decode_impl)
         if budget is not None:
             self._watchdog_note(time.perf_counter() - t0)
         self._stat["decode_steps"].inc()
@@ -783,9 +842,18 @@ class ServingEngine:
             # no retry wrapper: a verify fault degrades to the plain
             # path (which retries) instead of re-speculating — the fault
             # fires before dispatch, so the donated pools are intact
-            logits, self.cache.k, self.cache.v = self.engine.verify_slots(
-                self.cache.k, self.cache.v, self.cache.tables,
-                self.cache.lengths, tokens, active, self.decode_impl)
+            if self._quant:
+                (logits, self.cache.k, self.cache.v, self.cache.k_scale,
+                 self.cache.v_scale) = self.engine.verify_slots(
+                    self.cache.k, self.cache.v, self.cache.tables,
+                    self.cache.lengths, tokens, active, self.decode_impl,
+                    self.cache.k_scale, self.cache.v_scale)
+            else:
+                logits, self.cache.k, self.cache.v = \
+                    self.engine.verify_slots(
+                        self.cache.k, self.cache.v, self.cache.tables,
+                        self.cache.lengths, tokens, active,
+                        self.decode_impl)
         except TransientDeviceError:
             self._stat["spec_fallbacks"].inc()
             logger.warning("serving: verify fault; degrading this step "
@@ -899,7 +967,12 @@ class ServingEngine:
         the phase that dispatched it. Only the breakdown calls this,
         and only on sampled steps — the unsampled hot path stays
         sync-free (dslint DS001)."""
-        jax.block_until_ready((self.cache.k, self.cache.v))
+        if self._quant:
+            jax.block_until_ready((self.cache.k, self.cache.v,
+                                   self.cache.k_scale,
+                                   self.cache.v_scale))
+        else:
+            jax.block_until_ready((self.cache.k, self.cache.v))
 
     def _sample_gauges(self) -> None:
         """Sampled-step gauge refresh: HBM block states + prefix hit
@@ -912,6 +985,13 @@ class ServingEngine:
         self._g_hit_rate.set(
             round(self._stat["prefix_hits"].value / admitted, 4)
             if admitted else 0.0)
+        if self._h_kv_err is not None:
+            # half the hottest block's quantization step — an upper
+            # bound on the elementwise |dequant - original| error; one
+            # device_get, riding the sampled cadence only
+            step = jax.device_get(jnp.maximum(  # dslint: disable=DS001 — sampled-cadence pull, mirrors the gauge refresh above
+                jnp.max(self.cache.k_scale), jnp.max(self.cache.v_scale)))
+            self._h_kv_err.observe(float(step) / 2.0)
 
     def _degraded(self, message: str) -> DegradedError:
         return DegradedError(

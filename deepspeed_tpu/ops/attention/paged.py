@@ -83,22 +83,33 @@ def resolve_decode_impl(impl: Optional[str] = None) -> str:
 
 def paged_hbm_bytes_per_token(cfg, num_slots: int, mean_len: float,
                               max_len: int, dtype=jnp.bfloat16,
-                              impl: str = "pallas") -> int:
+                              impl: str = "pallas",
+                              block_size: Optional[int] = None,
+                              scale_bytes_per_block: int = 0) -> int:
     """Analytic HBM bytes the attention cache path moves per decoded
     token (all layers, K+V) — the PERF.md comparison unit.
 
     gather: reads the whole ``[B, NB*block, ...]`` virtual cache out of
     the pool AND writes the transient gathered copy, then the einsums
     read the copy again — 3 passes over ``num_slots * max_len`` tokens.
-    pallas: reads only the occupied blocks of each live slot, once."""
-    per_tok = int(2 * cfg.n_layers * cfg.kv_heads * cfg.head_dim
-                  * jnp.dtype(dtype).itemsize)
+    pallas: reads only the occupied blocks of each live slot, once.
+
+    ``dtype`` must be the ACTUAL pool dtype (int8 under DS_KV_QUANT,
+    bf16/f32 otherwise — the bench passes ``cache.pool_dtype``);
+    ``scale_bytes_per_block`` + ``block_size`` fold the quantized pools'
+    per-block fp32 scale overhead into the per-token cost."""
+    per_tok = 2.0 * cfg.n_layers * cfg.kv_heads * cfg.head_dim \
+        * jnp.dtype(dtype).itemsize
+    if scale_bytes_per_block and block_size:
+        # the scale pools are read alongside every block DMA
+        per_tok += scale_bytes_per_block / float(block_size)
     if impl == "gather":
-        return 3 * num_slots * int(max_len) * per_tok
-    return int(num_slots * mean_len) * per_tok
+        return int(3 * num_slots * int(max_len) * per_tok)
+    return int(int(num_slots * mean_len) * per_tok)
 
 
-def _kv_index_map(bs: int, nb: int, window: Optional[int], q_len: int = 1):
+def _kv_index_map(bs: int, nb: int, window: Optional[int], q_len: int = 1,
+                  rank: int = 4):
     """Block index map for the K/V pools when the grid is (b, j) and the
     pools are scalar-prefetch-addressed: step (b, j) fetches pool block
     ``tables[b, clamp(j)]``. Steps past the slot's last occupied block
@@ -107,7 +118,12 @@ def _kv_index_map(bs: int, nb: int, window: Optional[int], q_len: int = 1):
     a run step's (or its neighbor's), so Mosaic elides the DMA exactly
     like the causal clamp in ops/attention/flash.py. With a verify
     chunk (``q_len > 1``) the last query sits at ``lengths + q_len - 1``,
-    so the high clamp covers that block too."""
+    so the high clamp covers that block too.
+
+    ``rank=4`` addresses the K/V pools ``[N, block, Hkv, Dh]``;
+    ``rank=2`` addresses the int8 mode's scale pools ``[N, Hkv]`` with
+    the SAME table indirection, so each grid step's scale rides the
+    same prefetch discipline as its block."""
     def imap(b, j, tables_ref, lengths_ref):
         pos = lengths_ref[b]
         hi = jnp.minimum((pos + (q_len - 1)) // bs, nb - 1)
@@ -115,15 +131,15 @@ def _kv_index_map(bs: int, nb: int, window: Optional[int], q_len: int = 1):
         if window is not None:
             lo = jnp.clip((pos - window + 1) // bs, 0, nb - 1)
             jj = jnp.maximum(jj, lo)
-        return (tables_ref[b, jj], 0, 0, 0)
+        return (tables_ref[b, jj],) + (0,) * (rank - 1)
 
     return imap
 
 
 def _paged_decode_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref,
-                         o_ref, m_scratch, l_scratch, acc_scratch, *,
-                         bs: int, n_kv: int, group: int, q_len: int,
-                         scale: float, window: Optional[int], nb: int):
+                         *rest, bs: int, n_kv: int, group: int, q_len: int,
+                         scale: float, window: Optional[int], nb: int,
+                         quant: bool = False):
     """One (slot, pool-block) grid step of flash-decode.
 
     q_ref: [1, H*q_len, Dh] (H = n_kv * group; rows ordered (kv head,
@@ -135,7 +151,17 @@ def _paged_decode_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref,
     is the speculative verify chunk — query row with chunk offset g is
     causal at position ``lengths[b] + g`` (within-chunk causality falls
     out of the same position mask, since the chunk's K/V are already
-    scattered into the pool)."""
+    scattered into the pool).
+
+    ``quant=True``: k_ref/v_ref hold int8 and two extra refs
+    ks_ref/vs_ref ([1, Hkv] fp32 per-block scales, same table
+    indirection) precede the output — the block is dequantized
+    IN-REGISTER right after its DMA (the ops/int8_matmul.py idiom), so
+    HBM traffic stays the int8 payload + one scale vector per block."""
+    if quant:
+        ks_ref, vs_ref, o_ref, m_scratch, l_scratch, acc_scratch = rest
+    else:
+        o_ref, m_scratch, l_scratch, acc_scratch = rest
     b = pl.program_id(0)
     j = pl.program_id(1)
     pos = lengths_ref[b]
@@ -182,6 +208,11 @@ def _paged_decode_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref,
             qh = q[rows, :]                   # [R, Dh] — one MXU matmul
             kh = k[:, h, :]                   # [bs, Dh]     covers the whole
             vh = v[:, h, :]                   # GQA group of this kv head
+            if quant:
+                # in-register dequantize: int8 block × its fp32 scale
+                qh = qh.astype(jnp.float32)
+                kh = kh.astype(jnp.float32) * ks_ref[0, h]
+                vh = vh.astype(jnp.float32) * vs_ref[0, h]
             s = jax.lax.dot_general(
                 qh, kh, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale   # [R, bs]
@@ -214,7 +245,8 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
                            v_pool: jnp.ndarray, tables: jnp.ndarray,
                            lengths: jnp.ndarray, *, scale: float,
                            window: Optional[int] = None,
-                           interpret: Optional[bool] = None) -> jnp.ndarray:
+                           interpret: Optional[bool] = None,
+                           k_scale=None, v_scale=None) -> jnp.ndarray:
     """Flash-decode one new token per serving slot THROUGH the block
     table — no dense cache materialization.
 
@@ -224,6 +256,8 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     tables: [B, NB] int32 block tables (trash-block-0 convention for
     unused entries); lengths: [B] int32 per-slot cache positions (slot b
     attends positions <= lengths[b], banded by ``window`` when set).
+    ``k_scale``/``v_scale`` ([N, Hkv] fp32): int8 pools, dequantized
+    in-register after each block DMA (DS_KV_QUANT=int8).
 
     Returns [B, Hkv, group, Dh] in q's dtype. ``interpret`` defaults to
     True off-TPU so the same call tests on CPU (interpret mode) and
@@ -232,14 +266,16 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     return _paged_attention_call(
         q.reshape(B, n_kv * group, Dh), k_pool, v_pool, tables, lengths,
         n_kv=n_kv, group=group, q_len=1, scale=scale, window=window,
-        interpret=interpret).reshape(B, n_kv, group, Dh)
+        interpret=interpret, k_scale=k_scale,
+        v_scale=v_scale).reshape(B, n_kv, group, Dh)
 
 
 def paged_verify_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
                            v_pool: jnp.ndarray, tables: jnp.ndarray,
                            lengths: jnp.ndarray, *, scale: float,
                            window: Optional[int] = None,
-                           interpret: Optional[bool] = None) -> jnp.ndarray:
+                           interpret: Optional[bool] = None,
+                           k_scale=None, v_scale=None) -> jnp.ndarray:
     """Flash-verify a G-token speculative chunk per slot THROUGH the
     block table — the ``q_len > 1`` generalization of
     :func:`paged_decode_attention` for draft/verify serving.
@@ -258,21 +294,26 @@ def paged_verify_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     q_rows = q.transpose(0, 2, 3, 1, 4).reshape(B, n_kv * group * G, Dh)
     out = _paged_attention_call(
         q_rows, k_pool, v_pool, tables, lengths, n_kv=n_kv, group=group,
-        q_len=G, scale=scale, window=window, interpret=interpret)
+        q_len=G, scale=scale, window=window, interpret=interpret,
+        k_scale=k_scale, v_scale=v_scale)
     return out.reshape(B, n_kv, group, G, Dh).transpose(0, 3, 1, 2, 4)
 
 
 def _paged_attention_call(q_rows, k_pool, v_pool, tables, lengths, *,
                           n_kv: int, group: int, q_len: int, scale: float,
                           window: Optional[int],
-                          interpret: Optional[bool]) -> jnp.ndarray:
+                          interpret: Optional[bool],
+                          k_scale=None, v_scale=None) -> jnp.ndarray:
     """Shared pallas_call plumbing for decode (q_len=1) and verify
-    (q_len=G). q_rows: [B, n_kv*group*q_len, Dh], head-major rows."""
+    (q_len=G). q_rows: [B, n_kv*group*q_len, Dh], head-major rows.
+    ``k_scale``/``v_scale`` ([N, Hkv] fp32) switch the int8 dequantize-
+    in-kernel mode on (pools must then be int8)."""
     B, rows, Dh = q_rows.shape
     N, bs, Hkv, Dh_p = k_pool.shape
     assert (n_kv, Dh, rows) == (Hkv, Dh_p, n_kv * group * q_len), \
         (q_rows.shape, k_pool.shape, (n_kv, group, q_len))
     assert v_pool.shape == k_pool.shape, (v_pool.shape, k_pool.shape)
+    quant = k_scale is not None
     nb = tables.shape[1]
     if interpret is None:
         from deepspeed_tpu.utils import on_tpu
@@ -283,14 +324,22 @@ def _paged_attention_call(q_rows, k_pool, v_pool, tables, lengths, *,
     def qmap(b, j, tables_ref, lengths_ref):
         return (b, 0, 0)
 
+    in_specs = [
+        pl.BlockSpec((1, rows, Dh), qmap),
+        pl.BlockSpec((1, bs, Hkv, Dh), kvmap),
+        pl.BlockSpec((1, bs, Hkv, Dh), kvmap),
+    ]
+    operands = [q_rows, k_pool, v_pool]
+    if quant:
+        smap = _kv_index_map(bs, nb, window, q_len, rank=2)
+        in_specs += [pl.BlockSpec((1, Hkv), smap),
+                     pl.BlockSpec((1, Hkv), smap)]
+        operands += [k_scale, v_scale]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, nb),
-        in_specs=[
-            pl.BlockSpec((1, rows, Dh), qmap),
-            pl.BlockSpec((1, bs, Hkv, Dh), kvmap),
-            pl.BlockSpec((1, bs, Hkv, Dh), kvmap),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, rows, Dh), qmap),
         scratch_shapes=[
             pltpu.VMEM((rows, LANES), jnp.float32),
@@ -300,7 +349,7 @@ def _paged_attention_call(q_rows, k_pool, v_pool, tables, lengths, *,
     )
     kernel = functools.partial(
         _paged_decode_kernel, bs=bs, n_kv=n_kv, group=group, q_len=q_len,
-        scale=float(scale), window=window, nb=nb)
+        scale=float(scale), window=window, nb=nb, quant=quant)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -309,20 +358,36 @@ def _paged_attention_call(q_rows, k_pool, v_pool, tables, lengths, *,
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(jnp.asarray(tables, jnp.int32), jnp.asarray(lengths, jnp.int32),
-      q_rows, k_pool, v_pool)
+      *operands)
+
+
+def _gather_dequant(pool, scale_pool, tables, dtype):
+    """Gather pool blocks through the tables and dequantize with the
+    per-(block, kv_head) scales — the quantized twin of the engine's
+    ``_gather_blocks``, shared by both bit-reference paths."""
+    from deepspeed_tpu.ops import quantizer
+    g = quantizer.kv_dequantize_blocks(pool[tables], scale_pool[tables],
+                                       dtype=dtype)
+    B, nb, bs = g.shape[0], g.shape[1], g.shape[2]
+    return g.reshape(B, nb * bs, g.shape[3], g.shape[4])
 
 
 def paged_decode_reference(q, k_pool, v_pool, tables, lengths, *, scale,
-                           window=None):
+                           window=None, k_scale=None, v_scale=None):
     """Dense gather reference of :func:`paged_decode_attention` for the
     parity tests — the same math as the engine's gather path
     (inference/engine.py _block_decode_paged), minus the model around
-    it."""
+    it. With ``k_scale``/``v_scale`` the pools are int8 and the gather
+    dequantizes through the ops/quantizer KV helpers."""
     B, n_kv, group, Dh = q.shape
     bs = k_pool.shape[1]
     nb = tables.shape[1]
-    kc = k_pool[tables].reshape(B, nb * bs, n_kv, Dh)
-    vc = v_pool[tables].reshape(B, nb * bs, n_kv, Dh)
+    if k_scale is None:
+        kc = k_pool[tables].reshape(B, nb * bs, n_kv, Dh)
+        vc = v_pool[tables].reshape(B, nb * bs, n_kv, Dh)
+    else:
+        kc = _gather_dequant(k_pool, k_scale, tables, q.dtype)
+        vc = _gather_dequant(v_pool, v_scale, tables, q.dtype)
     s = jnp.einsum("bkgd,bskd->bkgs", q, kc).astype(jnp.float32) * scale
     idx = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, nb * bs), 3)
     pos = lengths[:, None, None, None]
@@ -334,7 +399,7 @@ def paged_decode_reference(q, k_pool, v_pool, tables, lengths, *, scale,
 
 
 def paged_verify_reference(q, k_pool, v_pool, tables, lengths, *, scale,
-                           window=None):
+                           window=None, k_scale=None, v_scale=None):
     """Dense gather reference of :func:`paged_verify_attention` — the
     same math as the engine's gather-path verify block
     (inference/engine.py _block_verify_paged), minus the model.
@@ -342,8 +407,12 @@ def paged_verify_reference(q, k_pool, v_pool, tables, lengths, *, scale,
     B, G, n_kv, group, Dh = q.shape
     bs = k_pool.shape[1]
     nb = tables.shape[1]
-    kc = k_pool[tables].reshape(B, nb * bs, n_kv, Dh)
-    vc = v_pool[tables].reshape(B, nb * bs, n_kv, Dh)
+    if k_scale is None:
+        kc = k_pool[tables].reshape(B, nb * bs, n_kv, Dh)
+        vc = v_pool[tables].reshape(B, nb * bs, n_kv, Dh)
+    else:
+        kc = _gather_dequant(k_pool, k_scale, tables, q.dtype)
+        vc = _gather_dequant(v_pool, v_scale, tables, q.dtype)
     s = jnp.einsum("bqkgd,bskd->bkgqs", q, kc).astype(jnp.float32) * scale
     idx = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, 1, nb * bs), 4)
     qpos = lengths[:, None, None, None, None] + jax.lax.broadcasted_iota(

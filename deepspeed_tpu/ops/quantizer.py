@@ -22,6 +22,7 @@ Conventions
   uses curand inside the kernel.
 """
 
+import os
 from functools import partial
 from typing import Optional, Tuple
 
@@ -181,3 +182,87 @@ def quantized_matmul(x: jnp.ndarray,
     (ref: csrc/transformer/inference qkv_gemm int8 variants)."""
     w = dequantize(q_weight, scale, groups=groups, dtype=x.dtype)
     return x @ w
+
+
+# ----------------------------------------------------------------------
+# paged KV-cache block quantization (int8 storage, per-block×kv-head scales)
+# ----------------------------------------------------------------------
+#
+# Unlike the group helpers above (reference scale convention
+# ``x ≈ q / scale``), the KV helpers use the multiply convention of
+# ``ops/int8_matmul.py`` / ``engine.quantize_weights_int8``:
+#
+#     scale = absmax / 127,   q = round(x / scale) in [-127, 127],
+#     x ≈ q.astype(f32) * scale
+#
+# A "block" is one paged-cache block ``[..., block_size, kv_heads,
+# head_dim]``; the scale is reduced over the token and head_dim axes so
+# each (block, kv_head) pair carries one fp32 scale — the layout the
+# paged-attention kernel dequantizes in-register after the block DMA.
+
+KV_QMAX = 127.0
+
+
+def resolve_kv_quant(mode=None) -> str:
+    """Resolve the KV-cache quantization mode: ``"off"`` or ``"int8"``.
+
+    Explicit ``mode`` wins; otherwise the ``DS_KV_QUANT`` env var;
+    otherwise off. Same knob pattern as ``resolve_prefix_cache`` /
+    ``resolve_decode_impl``.
+    """
+    if mode is not None:
+        if isinstance(mode, bool):
+            mode = "int8" if mode else "off"
+        mode = str(mode).strip().lower()
+    else:
+        # dslint: disable=DS005 — knob resolver, read once at construction
+        mode = os.environ.get("DS_KV_QUANT", "").strip().lower() or "off"
+    if mode in ("off", "0", "false", "no", "none"):
+        return "off"
+    if mode in ("int8", "on", "1", "true", "yes"):
+        return "int8"
+    raise ValueError(
+        f"DS_KV_QUANT={mode!r}: expected 'int8' or 'off'")
+
+
+def kv_block_scales(x: jnp.ndarray) -> jnp.ndarray:
+    """Symmetric per-(block, kv_head) scales for ``x`` shaped
+    ``[..., block_size, kv_heads, head_dim]`` → ``[..., kv_heads]``.
+
+    ``scale = absmax / 127``; an all-zero block yields scale 0 (the
+    trash block stays finite: quantize guards the divide, dequantize
+    multiplies by 0).
+    """
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=(-3, -1))
+    return absmax / KV_QMAX
+
+
+def kv_quantize_blocks(x: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    """Quantize ``x`` ``[..., bs, Hkv, Dh]`` to int8 with per-(block,
+    kv_head) ``scale`` ``[..., Hkv]`` (multiply convention)."""
+    safe = jnp.where(scale > 0, scale, 1.0)[..., None, :, None]
+    q = jnp.round(x.astype(jnp.float32) / safe)
+    return jnp.clip(q, -KV_QMAX, KV_QMAX).astype(jnp.int8)
+
+
+def kv_requantize_blocks(x: jnp.ndarray,
+                         live: Optional[jnp.ndarray] = None
+                         ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Quantize blocks ``x`` ``[..., bs, Hkv, Dh]``, zeroing stale token
+    rows first (``live`` ``[..., bs]`` bool), so garbage from a block's
+    previous owner never inflates the absmax. Returns ``(q, scale)``.
+    """
+    x = x.astype(jnp.float32)
+    if live is not None:
+        x = jnp.where(live[..., None, None], x, 0.0)
+    scale = kv_block_scales(x)
+    return kv_quantize_blocks(x, scale), scale
+
+
+def kv_dequantize_blocks(q: jnp.ndarray,
+                         scale: jnp.ndarray,
+                         dtype=jnp.float32) -> jnp.ndarray:
+    """Inverse of :func:`kv_quantize_blocks`: ``q * scale`` broadcast
+    back over ``[..., bs, Hkv, Dh]``."""
+    out = q.astype(jnp.float32) * scale[..., None, :, None]
+    return out.astype(dtype)

@@ -26,6 +26,11 @@ Sites currently instrumented:
 ``cache.cow``          before the copy-on-write block copy (and before
                        ANY bookkeeping mutates); ``cache_exhausted``
                        raises CacheExhausted — the admission retries
+``cache.quantize``     inside the engine's paged public wrappers when
+                       ``kv_quant=int8``, after the ``engine.*`` site
+                       and still BEFORE the device dispatch — donated
+                       pool/scale buffers are untouched, so the
+                       serving retry replays the step safely
 ``engine.decode``      ``InferenceEngine.decode_slots`` public wrapper
 ``engine.verify``      ``InferenceEngine.verify_slots`` public wrapper
                        (speculative verify); the scheduler degrades the

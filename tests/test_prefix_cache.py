@@ -367,11 +367,17 @@ def test_serving_compile_contract_with_prefix_cache(devices):
         srv.run([ServeRequest(rid=2, prompt=div, max_new_tokens=4)])
     assert srv.cache.cow_copies >= 1                  # COW ran inside watch
     assert srv.stats["prefix_hits"] >= 2
-    n_prefill = cache_size(eng._prefill_slot)
+    # under DS_KV_QUANT=int8 the active set is the _q jit twins — the
+    # per-program count contract (incl. the COW copy) is the same
+    quant = srv.kv_quant == "int8"
+    pf = eng._prefill_slot_q if quant else eng._prefill_slot
+    dc = eng._decode_slots_q if quant else eng._decode_slots
+    cw = eng._cow_blocks_q if quant else eng._cow_blocks
+    n_prefill = cache_size(pf)
     if n_prefill is not None:
         assert n_prefill == 1
-        assert cache_size(eng._decode_slots) == 1
-        assert cache_size(eng._cow_blocks) == 1
+        assert cache_size(dc) == 1
+        assert cache_size(cw) == 1
 
 
 def test_serving_env_knob_smoke(eng):

@@ -105,8 +105,11 @@ def test_paged_cache_hbm_budget_watermark(devices):
     cfg, _ = tiny()
     per_tok = gpt.kv_bytes_per_token(cfg, jnp.float32)
     budget = per_tok * 4 * 10            # exactly 10 4-token blocks
+    # kv_quant pinned off: this pins the FP pool's budget arithmetic
+    # (the int8 layout's budget math lives in test_kv_quant.py)
     c = PagedKVCache(cfg, num_slots=2, block_size=4,
-                     hbm_budget_bytes=budget, dtype=jnp.float32)
+                     hbm_budget_bytes=budget, dtype=jnp.float32,
+                     kv_quant="off")
     assert c.free_blocks == 10
     c.allocate(0, 6)
     assert c.used_block_bytes() == 2 * 4 * per_tok
@@ -308,25 +311,30 @@ def test_serving_compile_count_contract(devices):
     srv, warm_out = run_workload()
     assert srv.stats["evictions"] >= 1     # the workload really preempts
     # exactly two compiled serving programs after warmup — one prefill
-    # (chunks are padded to prefill_chunk, so ONE shape) and one decode
-    n_prefill = cache_size(eng._prefill_slot)
-    n_decode = cache_size(eng._decode_slots)
+    # (chunks are padded to prefill_chunk, so ONE shape) and one decode.
+    # Under DS_KV_QUANT=int8 the active set is the _q jit twins; the
+    # program COUNT contract is identical either way
+    quant = srv.kv_quant == "int8"
+    pf = eng._prefill_slot_q if quant else eng._prefill_slot
+    dc = eng._decode_slots_q if quant else eng._decode_slots
+    n_prefill = cache_size(pf)
+    n_decode = cache_size(dc)
     if n_prefill is not None:
         assert (n_prefill, n_decode) == (1, 1), (
             f"serving steady state fragmented: prefill={n_prefill} "
             f"decode={n_decode} compiled programs (expected 1+1)")
 
     watch = CompileWatch(max_compiles=0, label="serving steady state")
-    watch.wrap(eng._prefill_slot)
-    watch.wrap(eng._decode_slots)
+    watch.wrap(pf)
+    watch.wrap(dc)
     with watch:                            # raises RecompileError on exit
         srv2, out = run_workload()         # if anything compiled
     assert srv2.stats["evictions"] >= 1
     for rid in ("a", "b"):                 # still the right tokens
         np.testing.assert_array_equal(out[rid], warm_out[rid])
     if n_prefill is not None:
-        assert cache_size(eng._prefill_slot) == 1
-        assert cache_size(eng._decode_slots) == 1
+        assert cache_size(pf) == 1
+        assert cache_size(dc) == 1
 
 
 def test_serving_rejects_oversized_request(devices):

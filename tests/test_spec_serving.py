@@ -313,9 +313,15 @@ def test_spec_compile_count_contract(devices):
 
     srv, warm_out = run_workload()
     assert srv.stats["evictions"] >= 1   # the workload really preempts
-    n_prefill = cache_size(eng._prefill_slot)
-    n_verify = cache_size(eng._verify_slots)
-    n_decode = cache_size(eng._decode_slots)
+    # under DS_KV_QUANT=int8 the active set is the _q jit twins; the
+    # verify-replaces-decode count contract is identical either way
+    quant = srv.kv_quant == "int8"
+    pf = eng._prefill_slot_q if quant else eng._prefill_slot
+    vf = eng._verify_slots_q if quant else eng._verify_slots
+    dc = eng._decode_slots_q if quant else eng._decode_slots
+    n_prefill = cache_size(pf)
+    n_verify = cache_size(vf)
+    n_decode = cache_size(dc)
     if n_prefill is not None:
         assert (n_prefill, n_verify, n_decode) == (1, 1, 0), (
             f"spec steady state fragmented: prefill={n_prefill} "
@@ -323,9 +329,9 @@ def test_spec_compile_count_contract(devices):
             f"verify replaces decode)")
 
     watch = CompileWatch(max_compiles=0, label="spec serving steady state")
-    watch.wrap(eng._prefill_slot)
-    watch.wrap(eng._verify_slots)
-    watch.wrap(eng._decode_slots)
+    watch.wrap(pf)
+    watch.wrap(vf)
+    watch.wrap(dc)
     with watch:
         srv2, out = run_workload()
     assert srv2.stats["evictions"] >= 1

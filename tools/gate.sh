@@ -83,6 +83,17 @@ else
     echo "gate: serving smoke (DS_SPEC_DECODE=on)"
     DS_SPEC_DECODE=on python -m pytest tests/test_serving.py \
         tests/test_spec_serving.py tests/test_chaos.py -q
+    # int8 KV-cache knob smoke: the suite default leaves DS_KV_QUANT
+    # unset (= off, the bf16/fp32 bit-reference pool), so rerun the
+    # serving, prefix-sharing and speculative suites once with the int8
+    # paged pool forced ON — scheduling, COW/rollback bookkeeping and
+    # the compile contract must hold on the quantized layout, and the
+    # smoke-sized models stay greedy-argmax-stable under the rounding
+    # (docs/KV_QUANT.md)
+    echo "gate: serving smoke (DS_KV_QUANT=int8)"
+    DS_KV_QUANT=int8 python -m pytest tests/test_serving.py \
+        tests/test_prefix_cache.py tests/test_spec_serving.py \
+        tests/test_kv_quant.py tests/test_kv_quant_serving.py -q
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 fi
 echo "gate: green"

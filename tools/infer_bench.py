@@ -184,7 +184,7 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
                   block_size=16, num_blocks=None, prefill_chunk=32,
                   int8=False, int8_fused=False, seed=0, decode_impl=None,
                   prefix_cache=None, shared_prefix_len=0,
-                  spec_decode=None, spec_k=None, emit=True):
+                  spec_decode=None, spec_k=None, kv_quant=None, emit=True):
     """Continuous-batching serving row: synthetic Poisson arrivals driven
     through ServingEngine.step, wall-clock tokens/s, TTFT/TPOT latency
     percentiles from the telemetry registry's histograms, decode-slot
@@ -212,6 +212,13 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
     sourced ``accept_rate`` (drafts the target agreed with) and
     ``tokens_per_step`` (emitted per slot per verify step — the
     speculative speedup factor; 1.0 with speculation off).
+
+    ``kv_quant`` pins int8 KV-cache block quantization ("int8" | "off",
+    None = ``DS_KV_QUANT``). The HBM columns are derived from the
+    ACTUAL pool dtype plus the per-block scale overhead, and
+    ``slots_admittable`` reports how many decode slots the unquantized
+    pool's HBM budget admits at the row's pool layout — the capacity-
+    per-chip headline (~2x for int8 over bf16).
     """
     from deepspeed_tpu.models import gpt
     import deepspeed_tpu
@@ -244,7 +251,7 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
                         num_blocks=num_blocks, prefill_chunk=prefill_chunk,
                         decode_impl=decode_impl, prefix_cache=prefix_cache,
                         spec_decode=spec_decode, spec_k=spec_k,
-                        telemetry=Telemetry())
+                        kv_quant=kv_quant, telemetry=Telemetry())
 
     rng = np.random.default_rng(seed)
     arrive = np.floor(np.cumsum(
@@ -269,7 +276,8 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
     w = ServingEngine(eng, num_slots=num_slots, block_size=block_size,
                       num_blocks=num_blocks, prefill_chunk=prefill_chunk,
                       decode_impl=decode_impl, prefix_cache=prefix_cache,
-                      spec_decode=spec_decode, spec_k=spec_k)
+                      spec_decode=spec_decode, spec_k=spec_k,
+                      kv_quant=kv_quant)
     w.run([ServeRequest(rid="w", prompt=reqs[0].prompt.copy(),
                         max_new_tokens=2)])
 
@@ -289,7 +297,17 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
     gen_tokens = sum(len(r.out) for r in srv.finished)
     st = srv.stats
     cache = srv.cache
-    blk_bytes = gpt.kv_bytes_per_token(cfg, cache.dtype) * block_size
+    # per-block bytes from the ACTUAL pool dtype (int8 under kv_quant)
+    # plus the fp32 per-block scale sidecar — not the activation dtype
+    blk_bytes = cache.bytes_per_token * block_size \
+        + cache.scale_bytes_per_block
+    # capacity at fixed HBM: the budget the UNQUANTIZED pool would spend
+    # on num_slots full slots, re-divided by the row's actual per-slot
+    # cost — bf16/fp32 rows report num_slots back, int8 rows ~2x it
+    fp_slot_bytes = cache.blocks_per_slot * block_size \
+        * gpt.kv_bytes_per_token(cfg, cache.dtype)
+    slots_admittable = int(num_slots * fp_slot_bytes
+                           // (cache.blocks_per_slot * blk_bytes))
     from deepspeed_tpu.ops.attention.paged import paged_hbm_bytes_per_token
     mean_len = float(np.mean([len(r.prompt) + len(r.out) / 2
                               for r in srv.finished])) if srv.finished else 0
@@ -314,7 +332,18 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
         "static_kv_bytes": int(cache.static_equivalent_bytes(num_slots)),
         "kv_hbm_bytes_per_token": paged_hbm_bytes_per_token(
             cfg, num_slots, mean_len, cache.tokens_per_slot,
-            dtype=cache.dtype, impl=srv.decode_impl),
+            dtype=cache.pool_dtype, impl=srv.decode_impl,
+            block_size=block_size,
+            scale_bytes_per_block=cache.scale_bytes_per_block),
+        # int8 KV-cache columns: pool dtype actually allocated, write
+        # bytes per cached token (pool + amortized scale sidecar), and
+        # the fixed-budget slot capacity defined above
+        "kv_quant": srv.kv_quant,
+        "kv_pool_dtype": str(np.dtype(cache.pool_dtype)),
+        "kv_cache_bytes_per_token": round(
+            cache.bytes_per_token
+            + cache.scale_bytes_per_block / block_size, 1),
+        "slots_admittable": slots_admittable,
         "completed": st["completed"],
         # robustness counters: zero in a clean run, nonzero under
         # deadlines/bounded queues/chaos (DS_FAULTS) — a bench row that
@@ -429,6 +458,43 @@ def bench_serving_spec_compare(name, **kw):
     }), flush=True)
 
 
+def bench_serving_kvquant_compare(name, **kw):
+    """Same serving drive with the int8 paged KV cache OFF then ON.
+    Unlike the prefix/spec comparisons the streams are NOT bit-equal
+    (int8 rounds the cache), so the row reports the greedy token match
+    rate instead; the headline columns are the fixed-HBM capacity ratio
+    (slots_admittable, ~2x) and the per-token cache traffic ratio."""
+    off = bench_serving(f"{name}[off]", kv_quant="off", **kw)
+    on = bench_serving(f"{name}[int8]", kv_quant="int8", **kw)
+    tot = match = 0
+    for rid, ref in off["_results"].items():
+        got = on["_results"].get(rid, [])
+        n = min(len(ref), len(got))
+        match += sum(a == b for a, b in zip(ref[:n], got[:n]))
+        tot += max(len(ref), len(got))
+    print(json.dumps({
+        "config": name, "preset": off["preset"],
+        "kv_quant": "off-vs-int8",
+        "token_match_rate": round(match / max(tot, 1), 4),
+        "kv_pool_dtype_off": off["kv_pool_dtype"],
+        "kv_pool_dtype_int8": on["kv_pool_dtype"],
+        "kv_cache_bytes_per_token_off": off["kv_cache_bytes_per_token"],
+        "kv_cache_bytes_per_token_int8": on["kv_cache_bytes_per_token"],
+        "cache_bytes_ratio": round(
+            off["kv_cache_bytes_per_token"]
+            / max(on["kv_cache_bytes_per_token"], 1e-9), 2),
+        "slots_admittable_off": off["slots_admittable"],
+        "slots_admittable_int8": on["slots_admittable"],
+        "capacity_ratio": round(
+            on["slots_admittable"]
+            / max(off["slots_admittable"], 1), 2),
+        "kv_hbm_bytes_per_token_off": off["kv_hbm_bytes_per_token"],
+        "kv_hbm_bytes_per_token_int8": on["kv_hbm_bytes_per_token"],
+        "tokens_per_s_off": off["tokens_per_s"],
+        "tokens_per_s_int8": on["tokens_per_s"],
+    }), flush=True)
+
+
 SERVE_CONFIGS = [
     # CPU-verifiable smoke: staggered Poisson arrivals must batch
     # (mean_occupancy > 1) and the paged footprint must undercut the
@@ -487,6 +553,18 @@ SERVE_COMPARE_CONFIGS = [
         mode="spec", preset="gpt2-medium", num_requests=32,
         mean_gap_steps=1.5, prompt_lens=(64, 384), new_tokens=64,
         num_slots=8, block_size=16, prefill_chunk=128)),
+    # int8 paged KV cache on vs off: the off row must admit num_slots
+    # at its own budget, the int8 row ~2x that (capacity_ratio >= 1.8
+    # on bf16 pools; larger on the fp32 CPU smoke), with a high but not
+    # bit-exact token_match_rate — the rounding tolerance is the price
+    ("serve-kvquant-smoke", dict(mode="kvquant", num_requests=8,
+                                 mean_gap_steps=2.0, prompt_lens=(8, 24),
+                                 new_tokens=12, num_slots=2, block_size=8,
+                                 prefill_chunk=16)),
+    ("serve-kvquant-gpt2-medium", dict(
+        mode="kvquant", preset="gpt2-medium", num_requests=32,
+        mean_gap_steps=1.5, prompt_lens=(64, 384), new_tokens=64,
+        num_slots=8, block_size=16, prefill_chunk=128)),
 ]
 
 
@@ -524,6 +602,7 @@ def main():
         mode = kw.pop("mode", "impl")
         compare = {"prefix": bench_serving_prefix_compare,
                    "spec": bench_serving_spec_compare,
+                   "kvquant": bench_serving_kvquant_compare,
                    }.get(mode, bench_serving_impl_compare)
         try:
             compare(name, **kw)
