@@ -1,5 +1,7 @@
 from deepspeed_tpu.inference.paged_cache import CacheExhausted, PagedKVCache
-from deepspeed_tpu.inference.serving import ServeRequest, ServingEngine
+from deepspeed_tpu.inference.router import ReplicaRouter
+from deepspeed_tpu.inference.serving import (DegradedError, ServeRequest,
+                                             ServingEngine)
 
-__all__ = ["CacheExhausted", "PagedKVCache", "ServeRequest",
-           "ServingEngine"]
+__all__ = ["CacheExhausted", "DegradedError", "PagedKVCache",
+           "ReplicaRouter", "ServeRequest", "ServingEngine"]

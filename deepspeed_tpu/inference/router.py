@@ -1,0 +1,538 @@
+"""Replica-fleet serving router: health-gated dispatch over N
+:class:`~deepspeed_tpu.inference.serving.ServingEngine` replicas.
+
+A single serving engine is a single failure domain: one watchdog trip
+or wedged device degrades ALL in-flight traffic. The router is the
+scale-out tier above it (the Orca/vLLM deployment shape): N replicas —
+each holding its own paged KV pool and slots — behind one
+:class:`~deepspeed_tpu.inference.serving.ServeRequest`-shaped front
+door, stepped round-robin in one host loop.
+
+**Dispatch** is least-loaded and deadline-aware, read off each
+replica's live scheduler state (queue depth + occupied slots — the
+same numbers its registry-backed ``stats`` export): a request lands on
+the replica with the most headroom. Requests WITHOUT a deadline first
+consult the prefix-affinity map — same-leading-tokens traffic (shared
+system prompts) returns to the replica whose prefix-cache blocks are
+already warm, unless that replica is more than
+``affinity_max_imbalance`` requests busier than the best candidate.
+Deadline-carrying requests skip affinity entirely: their enemy is
+queue wait, not a cold prefill.
+
+**Health** is a per-replica state machine with a consecutive-failure
+circuit breaker::
+
+    healthy --failure--> suspect --(breaker_threshold)--> broken
+       ^                   |                                 |
+       |<----success-------+                          warm restart
+       |                                                     v
+       +<--- probe completes --- recovering <----------------+
+
+A transient failure (retry exhaustion, an injected ``device_error`` at
+``router.step``) moves the replica to ``suspect``; ``breaker_threshold``
+consecutive failures trip the breaker to ``broken``. A ``crash`` or a
+replica-raised :class:`DegradedError` breaks it immediately. A broken
+replica takes no traffic until :meth:`restart_replica` rebuilds it via
+``replica_factory`` — warm-started from the newest VALID crash-safe
+checkpoint tag (``runtime/checkpointing.py`` walk-back: the ``latest``
+pointer if it validates, else newest-first over ``list_tags``) — and it
+rejoins as ``recovering``: half-open, admitting at most
+``probe_admissions`` in-flight probe requests; the first probe that
+completes cleanly closes the breaker (``healthy``), a failure while
+recovering re-opens it.
+
+**Drain** is the failure-isolation contract: when a replica breaks,
+the router merges its finished ``results``, takes its
+``pending_snapshot(release=True)`` (freeing the dead pool's block refs
+including prefix-cache pins), dedups entries already terminal
+fleet-wide, and resubmits the remainder onto survivors. A resumed
+request re-prefills prompt + already-emitted tokens — the same
+recompute-on-resume path eviction uses — so greedy drained output is
+TOKEN-IDENTICAL to an undisturbed run (tests/test_router.py pins this
+against solo references). When no dispatchable replica remains the
+router raises a fleet-level :class:`DegradedError` carrying merged
+results and the orphaned pending entries: total degrade still loses
+nothing.
+
+**Chaos**: three new fault sites — ``router.dispatch`` (after target
+choice, before submit), ``router.step`` (before each per-replica
+step), ``router.drain`` (before any drain state moves) — all fire
+before state mutates, so retries replay safely. The router itself is
+pure host scheduling: it adds ZERO device programs, and replicas
+sharing one ``InferenceEngine`` share its per-instance executables, so
+the fleet holds the serving compile contract (2 programs + 1 spec
++ 1 COW) under active chaos.
+
+**Telemetry** (docs/OBSERVABILITY.md): ``router_*`` metrics — per-
+replica health gauges (``router_replica_health_r<i>``: 0 healthy /
+1 suspect / 2 broken / 3 recovering), ``router_drained_requests``,
+``router_breaker_trips``, a ``router_dispatch_queue_wait`` histogram —
+plus ``dispatch`` / ``drain`` / ``breaker`` / ``restart`` tracer
+events in the same timeline as the replicas' request lifecycles.
+"""
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set
+
+import numpy as np
+
+from deepspeed_tpu.inference.serving import (DegradedError, ServeRequest,
+                                             ServingEngine, _StatsView,
+                                             snapshot_entry)
+from deepspeed_tpu.runtime.checkpointing import (get_latest_tag, list_tags,
+                                                 validate_tag)
+from deepspeed_tpu.telemetry import (NOOP, MetricsRegistry, NoopTelemetry,
+                                     Telemetry, resolve_telemetry)
+from deepspeed_tpu.utils import faults as faults_lib
+from deepspeed_tpu.utils.faults import InjectedCrash, TransientDeviceError
+from deepspeed_tpu.utils.logging import logger
+
+# health states, in escalation order; gauge codes are the indices
+HEALTHY, SUSPECT, BROKEN, RECOVERING = ("healthy", "suspect", "broken",
+                                        "recovering")
+HEALTH_CODES = {HEALTHY: 0, SUSPECT: 1, BROKEN: 2, RECOVERING: 3}
+
+_ROUTER_STAT_FIELDS = (
+    ("steps", "c", "router scheduler iterations"),
+    ("dispatched", "c", "requests dispatched to a replica"),
+    ("affinity_hits", "c", "dispatches routed by prefix affinity"),
+    ("redispatches", "c", "dispatch retries after a dispatch-site fault"),
+    ("drained_requests", "c",
+     "in-flight requests drained from a broken replica onto survivors"),
+    ("breaker_trips", "c", "circuit-breaker openings (replica -> broken)"),
+    ("restarts", "c", "replica warm restarts"),
+    ("fleet_degraded", "c",
+     "total-degrade events (no dispatchable replica left)"),
+)
+
+
+class _Replica:
+    """Router-side record for one replica: the engine, its health
+    state, the consecutive-failure count the breaker watches, and the
+    probe rids whose clean completion closes a half-open breaker."""
+
+    def __init__(self, idx: int, srv: ServingEngine):
+        self.idx = idx
+        self.srv = srv
+        self.health = HEALTHY
+        self.failures = 0            # consecutive, reset on success
+        self.probe_rids: Set[Any] = set()
+        self.restarts = 0
+
+
+class ReplicaRouter:
+    """Least-loaded / deadline-aware / prefix-affine dispatcher over N
+    serving replicas with circuit-breaker health tracking and drain-on-
+    failure (module docstring has the full contract).
+
+    - ``replicas``: the ServingEngine fleet (sharing one
+      ``InferenceEngine`` shares its compiled programs).
+    - ``replica_factory``: ``(replica_id, checkpoint_tag) ->
+      ServingEngine`` used by :meth:`restart_replica`; ``ckpt_dir``
+      points the warm restart at a crash-safe checkpoint directory
+      (tag resolved by newest-valid walk-back, None when absent).
+    - ``breaker_threshold``: consecutive transient failures before the
+      breaker trips the replica to ``broken``.
+    - ``probe_admissions``: max in-flight requests a ``recovering``
+      replica may hold (half-open admission window).
+    - ``affinity_tokens`` / ``affinity_max_imbalance``: prefix-affinity
+      key width and the extra backlog an affine replica may carry
+      before least-loaded wins.
+    - ``faults`` / ``telemetry``: as on ``ServingEngine`` (pass one
+      shared :class:`~deepspeed_tpu.telemetry.Telemetry` to aggregate
+      fleet metrics into one registry).
+    """
+
+    def __init__(self, replicas: Sequence[ServingEngine], *,
+                 replica_factory: Optional[Callable] = None,
+                 ckpt_dir: Optional[str] = None,
+                 breaker_threshold: int = 3,
+                 probe_admissions: int = 2,
+                 affinity_tokens: int = 16,
+                 affinity_max_imbalance: int = 4,
+                 faults: Optional[faults_lib.FaultInjector] = None,
+                 telemetry=None):
+        if not replicas:
+            raise ValueError("router needs at least one replica")
+        self.replicas = [_Replica(i, srv) for i, srv in enumerate(replicas)]
+        self.replica_factory = replica_factory
+        self.ckpt_dir = ckpt_dir
+        self.breaker_threshold = max(1, int(breaker_threshold))
+        self.probe_admissions = max(1, int(probe_admissions))
+        self.affinity_tokens = int(affinity_tokens)
+        self.affinity_max_imbalance = int(affinity_max_imbalance)
+        self.faults = faults if faults is not None else faults_lib.active()
+        if isinstance(telemetry, (Telemetry, NoopTelemetry)):
+            self.telemetry = telemetry
+        elif resolve_telemetry(telemetry):
+            self.telemetry = Telemetry()
+        else:
+            self.telemetry = NOOP
+        self.metrics = (self.telemetry.registry if self.telemetry.enabled
+                        else MetricsRegistry())
+        self._stat = {}
+        for key, kind, help_ in _ROUTER_STAT_FIELDS:
+            make = (self.metrics.counter if kind == "c"
+                    else self.metrics.gauge)
+            self._stat[key] = make(f"router_{key}", help_)
+        self.stats = _StatsView(self._stat)
+        # per-replica health gauges: the registry has no label support,
+        # so each replica gets its own name (scrape-stable: replica
+        # count is fixed for the router's lifetime)
+        self._g_health = [
+            self.metrics.gauge(
+                f"router_replica_health_r{i}",
+                "replica health (0 healthy / 1 suspect / 2 broken / "
+                "3 recovering)")
+            for i in range(len(self.replicas))]
+        self._h_qwait = (self.metrics.histogram(
+            "router_dispatch_queue_wait",
+            "submit-to-(re)dispatch wait (scheduler clock units; >0 "
+            "only for drained/redispatched requests)")
+            if self.telemetry.enabled else None)
+        # fleet-merged terminal state captured off broken replicas
+        # before their engines are discarded; live replicas keep their
+        # own `finished` until results() merges everything
+        self._results: Dict[Any, np.ndarray] = {}
+        self._finished: List[ServeRequest] = []
+        self._orphans: List[ServeRequest] = []   # undispatchable drain work
+        self._affinity: Dict[bytes, int] = {}
+        self._rr = 0                             # round-robin step cursor
+        self._clock = 0
+
+    # -- API -----------------------------------------------------------
+    def submit(self, req: ServeRequest, now: float = 0.0) -> bool:
+        """Dispatch ``req`` to the best dispatchable replica. Returns
+        the target's ``submit`` result (False = shed by its bounded
+        queue). Raises a fleet-level :class:`DegradedError` when no
+        replica can take traffic."""
+        ok = self._dispatch(req, now)
+        if self._orphans:
+            raise self._fleet_degraded(
+                f"no dispatchable replica for request {req.rid!r}")
+        return bool(ok)
+
+    @property
+    def busy(self) -> bool:
+        return any(rep.health != BROKEN and rep.srv.busy
+                   for rep in self.replicas)
+
+    def step(self, now: Optional[float] = None) -> int:
+        """One fleet iteration: step every non-broken busy replica once,
+        in round-robin rotation, firing the ``router.step`` chaos site
+        per replica. Failures feed the breaker; a broken replica's
+        in-flight work drains onto survivors before the step returns.
+        Returns the fleet-wide decode occupancy."""
+        if now is None:
+            now = float(self._clock)
+        occ = 0
+        n = len(self.replicas)
+        for k in range(n):
+            rep = self.replicas[(self._rr + k) % n]
+            if rep.health == BROKEN or not rep.srv.busy:
+                continue
+            try:
+                self.faults.fire("router.step")
+                occ += rep.srv.step(now)
+            except TransientDeviceError as e:
+                self._note_failure(rep, now, str(e))
+            except DegradedError as e:
+                # the replica's own watchdog/non-drain contract fired:
+                # its scheduler state is still consistent, so the
+                # standard drain path recovers everything it held
+                self._break(rep, now, f"degraded: {e}")
+                self._drain(rep, now)
+            except InjectedCrash as e:
+                self._break(rep, now, f"crash: {e}")
+                self._drain(rep, now)
+            else:
+                self._note_success(rep, now)
+        self._rr = (self._rr + 1) % n
+        self._clock += 1
+        self._stat["steps"].inc()
+        if self._orphans:
+            # a drain this step could not place everything: ONE
+            # fleet-level raise carrying every orphaned request
+            raise self._fleet_degraded(
+                "no dispatchable replica left for drained work")
+        return occ
+
+    def run(self, requests=None, max_steps: int = 1_000_000,
+            wall_clock: bool = False) -> Dict[Any, np.ndarray]:
+        """Submit ``requests`` and step the fleet until idle. Returns
+        fleet-merged {rid: prompt+generated}. Raises the fleet-level
+        :class:`DegradedError` (with merged results + pending) on total
+        degrade or non-drain."""
+        for r in (requests or []):
+            self.submit(r, now=time.perf_counter() if wall_clock else 0.0)
+        steps = 0
+        while self.busy:
+            self.step(time.perf_counter() if wall_clock else None)
+            steps += 1
+            if steps > max_steps:
+                raise self._fleet_degraded(
+                    f"fleet did not drain in {max_steps} steps")
+        return self.results()
+
+    def results(self) -> Dict[Any, np.ndarray]:
+        """Fleet-merged {rid: prompt+generated}: terminal work captured
+        off broken replicas, overlaid with every live replica's
+        finished list (a drained rid's survivor-side completion wins)."""
+        merged = dict(self._results)
+        for rep in self.replicas:
+            for r in rep.srv.finished:
+                merged[r.rid] = r.tokens
+        return merged
+
+    def health(self) -> List[str]:
+        """Per-replica health states, by replica index."""
+        return [rep.health for rep in self.replicas]
+
+    def restart_replica(self, idx: int, now: float = 0.0) -> Optional[str]:
+        """Warm-restart a broken replica through ``replica_factory``,
+        loading from the newest VALID checkpoint tag under ``ckpt_dir``
+        (walk-back semantics; None when no valid tag exists). The
+        rebuilt replica rejoins as ``recovering`` — half-open until a
+        probe request completes cleanly. Returns the tag used."""
+        rep = self.replicas[idx]
+        if rep.health != BROKEN:
+            raise ValueError(
+                f"replica {idx} is {rep.health}, not broken")
+        if self.replica_factory is None:
+            raise RuntimeError(
+                "restart_replica needs a replica_factory")
+        tag = self._restart_tag()
+        rep.srv = self.replica_factory(idx, tag)
+        rep.failures = 0
+        rep.probe_rids = set()
+        rep.restarts += 1
+        self._set_health(rep, RECOVERING, now, reason="warm restart")
+        self._stat["restarts"].inc()
+        self.telemetry.tracer.event("restart", step=self._clock,
+                                    replica=idx, tag=tag)
+        logger.info(f"router: replica {idx} warm-restarted from "
+                    f"checkpoint tag {tag!r}; recovering")
+        return tag
+
+    # -- dispatch ------------------------------------------------------
+    def _affinity_key(self, prompt) -> Optional[bytes]:
+        if len(prompt) == 0:
+            return None
+        lead = np.asarray(prompt[:self.affinity_tokens], np.int32)
+        return lead.tobytes()
+
+    def _load(self, rep: _Replica) -> int:
+        srv = rep.srv
+        return len(srv.queue) + sum(1 for s in srv.slots if s is not None)
+
+    def _dispatchable(self, rep: _Replica) -> bool:
+        if rep.health == BROKEN:
+            return False
+        if rep.health == RECOVERING:
+            # half-open: a recovering replica holds at most
+            # probe_admissions in-flight requests until a probe
+            # completion closes the breaker
+            return self._load(rep) < self.probe_admissions
+        return True
+
+    def _choose(self, req: ServeRequest,
+                excluded: Set[int]) -> Optional[_Replica]:
+        cands = [rep for rep in self.replicas
+                 if rep.idx not in excluded and self._dispatchable(rep)]
+        if not cands:
+            return None
+        best = min(cands, key=lambda rep: (self._load(rep), rep.idx))
+        if req.deadline is None:
+            key = self._affinity_key(req.prompt)
+            idx = self._affinity.get(key) if key is not None else None
+            if idx is not None and idx != best.idx:
+                aff = next((rep for rep in cands if rep.idx == idx), None)
+                if aff is not None and (self._load(aff) <= self._load(best)
+                                        + self.affinity_max_imbalance):
+                    self._stat["affinity_hits"].inc()
+                    return aff
+        return best
+
+    def _dispatch(self, req: ServeRequest, now: float,
+                  excluded: Optional[Set[int]] = None) -> Optional[bool]:
+        """Pick a target and submit. The ``router.dispatch`` site fires
+        AFTER the choice and BEFORE the submit, so nothing has mutated
+        when a fault retries the dispatch against the next-best
+        replica; a ``crash`` there kills the chosen replica (which then
+        drains). With no dispatchable replica left, the request joins
+        ``_orphans`` and None is returned — the CALLER raises the one
+        fleet-level DegradedError once it has orphaned everything it
+        holds, so the error's pending is complete."""
+        excluded = set(excluded or ())
+        while True:
+            rep = self._choose(req, excluded)
+            if rep is None:
+                self._orphans.append(req)
+                return None
+            try:
+                self.faults.fire("router.dispatch")
+            except TransientDeviceError as e:
+                self._stat["redispatches"].inc()
+                self._note_failure(rep, now, str(e))
+                excluded.add(rep.idx)
+                continue
+            except InjectedCrash as e:
+                self._break(rep, now, f"crash: {e}")
+                excluded.add(rep.idx)
+                self._drain(rep, now)
+                continue
+            if self._h_qwait is not None and req.submitted_at is not None:
+                self._h_qwait.observe(max(0.0, now - req.submitted_at))
+            ok = rep.srv.submit(req, now=now)
+            key = self._affinity_key(req.prompt)
+            if ok and key is not None:
+                self._affinity[key] = rep.idx
+            if ok and rep.health == RECOVERING:
+                rep.probe_rids.add(req.rid)
+            self._stat["dispatched"].inc()
+            self.telemetry.tracer.event(
+                "dispatch", rid=req.rid, step=self._clock,
+                replica=rep.idx, load=self._load(rep),
+                resumed=bool(req.out))
+            return ok
+
+    # -- health --------------------------------------------------------
+    def _set_health(self, rep: _Replica, state: str, now: float,
+                    reason: str = "") -> None:
+        if rep.health == state:
+            return
+        prev, rep.health = rep.health, state
+        self._g_health[rep.idx].set(HEALTH_CODES[state])
+        self.telemetry.tracer.event(
+            "breaker", step=self._clock, replica=rep.idx,
+            state=state, prev=prev, reason=reason)
+
+    def _break(self, rep: _Replica, now: float, reason: str) -> None:
+        if rep.health == BROKEN:
+            return
+        logger.warning(f"router: replica {rep.idx} broken ({reason})")
+        self._set_health(rep, BROKEN, now, reason=reason)
+        self._stat["breaker_trips"].inc()
+        rep.failures = 0
+
+    def _note_failure(self, rep: _Replica, now: float, reason: str) -> None:
+        """Feed the breaker: suspect on the first failure, broken (and
+        drained) at the threshold; any failure while recovering
+        re-opens the breaker immediately."""
+        rep.failures += 1
+        if rep.health == RECOVERING:
+            self._break(rep, now, f"probe failed: {reason}")
+            self._drain(rep, now)
+        elif rep.failures >= self.breaker_threshold:
+            self._break(rep, now,
+                        f"{rep.failures} consecutive failures: {reason}")
+            self._drain(rep, now)
+        elif rep.health == HEALTHY:
+            logger.warning(
+                f"router: replica {rep.idx} suspect ({reason})")
+            self._set_health(rep, SUSPECT, now, reason=reason)
+
+    def _note_success(self, rep: _Replica, now: float) -> None:
+        rep.failures = 0
+        if rep.health == SUSPECT:
+            self._set_health(rep, HEALTHY, now, reason="clean step")
+        elif rep.health == RECOVERING and rep.probe_rids:
+            # a probe that ran to state=done proves the rebuilt replica
+            # end-to-end (admission, prefill, decode, retire) — close
+            # the breaker
+            done = {r.rid for r in rep.srv.finished if r.state == "done"}
+            if rep.probe_rids & done:
+                rep.probe_rids = set()
+                self._set_health(rep, HEALTHY, now,
+                                 reason="probe completed")
+                logger.info(f"router: replica {rep.idx} recovered")
+
+    # -- drain ---------------------------------------------------------
+    def _drain(self, rep: _Replica, now: float) -> int:
+        """Move a broken replica's work to survivors: merge its
+        terminal results, snapshot-and-release its in-flight requests
+        (freeing the dead pool's block refs and prefix pins), dedup
+        rids already terminal fleet-wide, and resubmit the rest.
+
+        Never raises: undispatchable work (no survivors, or a ``crash``
+        injected at ``router.drain``) lands in ``_orphans``, and the
+        entry point that triggered the drain raises ONE fleet-level
+        :class:`DegradedError` carrying all of it — total degrade
+        loses nothing."""
+        crashed = False
+        for _attempt in range(3):
+            try:
+                self.faults.fire("router.drain")
+                break
+            except TransientDeviceError:
+                # fired before any state moved: retrying the drain is
+                # safe, and a drain must not die to a transient
+                self._stat["redispatches"].inc()
+                continue
+            except InjectedCrash:
+                # crash mid-drain: the drain logic is dead — orphan the
+                # whole snapshot (escalates to total degrade upstream)
+                crashed = True
+                break
+        self._absorb_terminal(rep)
+        snap = rep.srv.pending_snapshot(release=True)
+        reqs = [ServeRequest.from_snapshot(s) for s in snap
+                if s["rid"] not in self._results]
+        placed = 0
+        failed = crashed
+        for req in reqs:
+            if failed:
+                self._orphans.append(req)
+                continue
+            if self._dispatch(req, now, excluded={rep.idx}) is None:
+                failed = True        # req orphaned; orphan the rest too
+                continue
+            placed += 1
+            self._stat["drained_requests"].inc()
+        self.telemetry.tracer.event(
+            "drain", step=self._clock, replica=rep.idx,
+            resumed=placed, rids=[r.rid for r in reqs])
+        logger.warning(
+            f"router: drained {placed}/{len(reqs)} in-flight requests "
+            f"from replica {rep.idx} onto survivors")
+        return placed
+
+    def _absorb_terminal(self, rep: _Replica) -> None:
+        """Capture a dead replica's finished requests before its engine
+        is discarded (first writer wins: a rid already captured from an
+        earlier break keeps its tokens)."""
+        for r in rep.srv.finished:
+            if r.rid not in self._results:
+                self._results[r.rid] = r.tokens
+                self._finished.append(r)
+
+    def _fleet_degraded(self, message: str) -> DegradedError:
+        self._stat["fleet_degraded"].inc()
+        orphans, self._orphans = self._orphans, []
+        merged = self.results()
+        pending = [snapshot_entry(r) for r in orphans]
+        for rep in self.replicas:
+            if rep.health != BROKEN:
+                pending.extend(
+                    s for s in rep.srv.pending_snapshot()
+                    if s["rid"] not in merged)
+        self.telemetry.tracer.event("degraded", step=self._clock,
+                                    message=message)
+        return DegradedError(
+            message, results=merged, finished=list(self._finished),
+            pending=pending, stats=dict(self.stats))
+
+    # -- checkpoint walk-back ------------------------------------------
+    def _restart_tag(self) -> Optional[str]:
+        """Newest valid checkpoint tag under ``ckpt_dir``: the
+        ``latest`` pointer when it validates, else newest-first over
+        ``list_tags`` (a torn/corrupt tag is skipped, never loaded)."""
+        if self.ckpt_dir is None:
+            return None
+        tag = get_latest_tag(self.ckpt_dir)
+        if tag is not None and validate_tag(self.ckpt_dir, tag):
+            return tag
+        for cand in list_tags(self.ckpt_dir):
+            if validate_tag(self.ckpt_dir, cand):
+                return cand
+        return None

@@ -218,6 +218,23 @@ class ServeRequest:
         return np.concatenate(
             [self.prompt, np.asarray(self.out, np.int32)])
 
+    @classmethod
+    def from_snapshot(cls, entry: Dict) -> "ServeRequest":
+        """Rebuild a resumable request from a ``pending_snapshot()``
+        entry — the cold-resume half of the drain contract: submitting
+        the rebuilt request to a FRESH engine re-prefills prompt +
+        already-emitted tokens, and greedy decode continues from the
+        exact pre-failure position, so the drained output is token-
+        identical to an undisturbed run."""
+        return cls(
+            rid=entry["rid"],
+            prompt=np.asarray(entry["prompt"], np.int32),
+            max_new_tokens=int(entry["max_new_tokens"]),
+            eos_id=entry.get("eos_id"),
+            deadline=entry.get("deadline"),
+            out=[int(t) for t in entry.get("out", ())],
+            evictions=int(entry.get("evictions", 0)))
+
 
 class DegradedError(RuntimeError):
     """The engine cannot meet its contract (hung step, non-drain) but
@@ -237,6 +254,24 @@ class DegradedError(RuntimeError):
         self.finished = finished or []
         self.pending = pending or []
         self.stats = stats or {}
+
+
+def snapshot_entry(req: ServeRequest, **extra) -> Dict:
+    """One ``pending_snapshot()`` entry for ``req``: the resume-
+    sufficient host-side view :meth:`ServeRequest.from_snapshot`
+    round-trips, plus whatever position tags (``slot``/``queue_pos``)
+    the caller adds. Token lists are copied — mutating the live request
+    afterwards cannot skew an already-raised DegradedError."""
+    entry = {"rid": req.rid, "state": req.state,
+             "generated": len(req.out),
+             "evictions": req.evictions,
+             "prompt": [int(t) for t in req.prompt],
+             "out": [int(t) for t in req.out],
+             "max_new_tokens": req.max_new_tokens,
+             "eos_id": req.eos_id,
+             "deadline": req.deadline}
+    entry.update(extra)
+    return entry
 
 
 class ServingEngine:
@@ -497,7 +532,12 @@ class ServingEngine:
             raise ValueError(
                 f"request {req.rid} needs more blocks than the whole pool")
         req.submitted_at = now
-        req._work = np.asarray(req.prompt, np.int32)
+        # resume-aware working prompt: a request rebuilt from a
+        # pending snapshot (out non-empty) re-prefills prompt+partial —
+        # the same recompute-on-resume contract _preempt uses — so a
+        # drained request continues token-identically on a fresh engine
+        req._work = np.asarray(req.tokens if req.out else req.prompt,
+                               np.int32)
         self.telemetry.tracer.event("enqueue", rid=req.rid,
                                     step=self._step_clock,
                                     queue_len=len(self.queue))
@@ -582,18 +622,32 @@ class ServingEngine:
                     f"(queue {len(self.queue)})")
         return {r.rid: r.tokens for r in self.finished}
 
-    def pending_snapshot(self) -> List[Dict]:
+    def pending_snapshot(self, release: bool = False) -> List[Dict]:
         """Host-side view of in-flight work (attached to
-        :class:`DegradedError`): one entry per slot/queue request."""
+        :class:`DegradedError`): one entry per slot/queue request.
+
+        Entries carry everything :meth:`ServeRequest.from_snapshot`
+        needs to round-trip into a *fresh* engine (prompt, emitted
+        tokens, budget, eos, deadline) — host-side copies, decoupled
+        from the live request objects. The default is NON-destructive:
+        the engine keeps its slots/queue, so a watchdog-degraded caller
+        may simply keep stepping. ``release=True`` is the declared-dead
+        path (the router's drain): every slot's blocks — including
+        prefix-cache pins — go back to the pool and the queue empties,
+        so the snapshot is the only remaining owner of the work."""
         snap = []
         for slot, r in enumerate(self.slots):
             if r is not None:
-                snap.append({"rid": r.rid, "state": r.state, "slot": slot,
-                             "generated": len(r.out),
-                             "evictions": r.evictions})
+                snap.append(snapshot_entry(r, slot=slot))
         for pos, r in enumerate(self.queue):
-            snap.append({"rid": r.rid, "state": r.state, "queue_pos": pos,
-                         "generated": len(r.out), "evictions": r.evictions})
+            snap.append(snapshot_entry(r, queue_pos=pos))
+        if release:
+            for slot, r in enumerate(self.slots):
+                if r is not None:
+                    self.cache.free(slot)
+                    self.slots[slot] = None
+            self.queue.clear()
+            self._update_backpressure()
         return snap
 
     # -- phases ----------------------------------------------------------
@@ -683,12 +737,12 @@ class ServingEngine:
                     "serving.prefill", self.engine.prefill_into_slot,
                     self.cache.k, self.cache.v, self.cache.tables[slot],
                     chunk, done, n, self.cache.k_scale,
-                    self.cache.v_scale)
+                    self.cache.v_scale, now=now)
             else:
                 logits, self.cache.k, self.cache.v = self._device_call(
                     "serving.prefill", self.engine.prefill_into_slot,
                     self.cache.k, self.cache.v, self.cache.tables[slot],
-                    chunk, done, n)
+                    chunk, done, n, now=now)
             self.cache.advance(slot, n)
             self._progress[slot] = done + n
             self._stat["prefill_chunks"].inc()
@@ -777,12 +831,13 @@ class ServingEngine:
                 "serving.decode", self.engine.decode_slots,
                 self.cache.k, self.cache.v, self.cache.tables,
                 self.cache.lengths, tokens, active, self.decode_impl,
-                self.cache.k_scale, self.cache.v_scale)
+                self.cache.k_scale, self.cache.v_scale, now=now)
         else:
             logits, self.cache.k, self.cache.v = self._device_call(
                 "serving.decode", self.engine.decode_slots,
                 self.cache.k, self.cache.v, self.cache.tables,
-                self.cache.lengths, tokens, active, self.decode_impl)
+                self.cache.lengths, tokens, active, self.decode_impl,
+                now=now)
         if budget is not None:
             self._watchdog_note(time.perf_counter() - t0)
         self._stat["decode_steps"].inc()
@@ -929,12 +984,32 @@ class ServingEngine:
                     f"consecutive times — degraded")
         else:
             self._over_budget = 0
-    def _device_call(self, site: str, fn, *args):
+    def _deadline_slack(self, now: Optional[float]) -> Optional[float]:
+        """Tightest remaining deadline margin among active slots (the
+        requests a retry sleep would stall), or None when no slot
+        carries a deadline. Clamped at 0 — an already-expired request
+        must not turn the cap negative."""
+        if now is None:
+            return None
+        slack = None
+        for req in self.slots:
+            if req is None or req.deadline is None:
+                continue
+            remain = max(0.0, req.deadline - now)
+            slack = remain if slack is None else min(slack, remain)
+        return slack
+
+    def _device_call(self, site: str, fn, *args, now: Optional[float] = None):
         """Run a slot program with fault injection + transient-error
         retry. Faults (and any real pre-dispatch failure) fire BEFORE
         ``fn`` touches the donated pools, so a retry re-dispatches
         against intact buffers; backoff doubles per attempt with
-        deterministic jitter from the injector's seeded rng."""
+        deterministic jitter from the injector's seeded rng. Each sleep
+        is capped at the tightest remaining deadline among active slots
+        (``now`` is the scheduler-clock step stamp): a backoff can
+        never sleep a live request past its deadline — with no margin
+        left, retries spin immediately and expiry decides at the next
+        step."""
         delay = self.retry_backoff_s
         attempt = 0
         while True:
@@ -947,6 +1022,9 @@ class ServingEngine:
                 attempt += 1
                 self._stat["retries"].inc()
                 pause = min(delay + self.faults.jitter(delay * 0.5), 0.5)
+                slack = self._deadline_slack(now)
+                if slack is not None:
+                    pause = min(pause, slack)
                 logger.warning(
                     f"serving: transient device error at {site} "
                     f"(attempt {attempt}/{self.max_retries}); retrying "

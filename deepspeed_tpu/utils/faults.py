@@ -39,6 +39,14 @@ Sites currently instrumented:
                        speculative step; same degrade-to-plain contract
 ``checkpoint.pre_commit``  after state write, BEFORE the tag dir commit
 ``checkpoint.commit``  after the tag dir commit, BEFORE ``latest`` update
+``router.dispatch``    after the router picks a target replica, BEFORE
+                       the request is submitted to it — a retry re-picks
+                       against untouched replicas
+``router.step``        before each per-replica step in the router's
+                       round-robin loop; ``crash`` kills that replica
+                       (its in-flight work drains onto survivors)
+``router.drain``       at the start of a dead replica's drain, BEFORE
+                       any snapshot/redistribution state moves
 ====================== =====================================================
 
 Fault kinds and what firing does:
@@ -69,6 +77,7 @@ fire ``kind`` at ``site`` on visits ``[step, step+count)`` with float
 
 import os
 import time
+import warnings
 from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -78,6 +87,12 @@ import numpy as np
 
 class FaultError(Exception):
     """Base class for every injected failure."""
+
+
+class UnknownFaultSiteWarning(UserWarning):
+    """A fault spec names a site no code path ever fires — almost
+    always a typo (``serving.prefil``): the chaos config would silently
+    inject nothing. Tests running with warnings-as-errors fail loudly."""
 
 
 class TransientDeviceError(FaultError):
@@ -108,9 +123,31 @@ class Fault:
 
 KINDS = ("device_error", "crash", "slow", "cache_exhausted")
 
+# every site some shipped code path fires (the module-docstring table);
+# subsystems adding sites register them so parse_spec can flag typos
+KNOWN_SITES = {
+    "serving.decode", "serving.prefill", "serving.spec_draft",
+    "engine.prefill", "engine.decode", "engine.verify",
+    "cache.allocate", "cache.ensure", "cache.match", "cache.cow",
+    "cache.quantize",
+    "checkpoint.pre_commit", "checkpoint.commit",
+    "router.dispatch", "router.step", "router.drain",
+}
+
+_warned_sites: set = set()
+
+
+def register_site(site: str) -> None:
+    """Declare ``site`` as a real fire point (plugins/tests adding
+    their own sites keep :func:`parse_spec` quiet about them)."""
+    KNOWN_SITES.add(site)
+
 
 def parse_spec(spec: str) -> List[Fault]:
-    """Parse the ``DS_FAULTS`` grammar (see module docstring)."""
+    """Parse the ``DS_FAULTS`` grammar (see module docstring). A spec
+    naming a site nothing ever fires warns ONCE per site
+    (:class:`UnknownFaultSiteWarning`) — a typo'd chaos config should
+    fail loudly in tests, not silently inject nothing."""
     faults: List[Fault] = []
     for entry in spec.replace(",", ";").split(";"):
         entry = entry.strip()
@@ -135,7 +172,16 @@ def parse_spec(spec: str) -> List[Fault]:
         if kind not in KINDS:
             raise ValueError(f"unknown fault kind {kind!r} in {entry!r} "
                              f"(known: {', '.join(KINDS)})")
-        faults.append(Fault(site=site.strip(), kind=kind.strip(),
+        site = site.strip()
+        if site not in KNOWN_SITES and site not in _warned_sites:
+            _warned_sites.add(site)
+            warnings.warn(
+                f"fault spec names unknown site {site!r} — no "
+                f"instrumented code path fires it, so this entry "
+                f"injects nothing (known sites: "
+                f"{', '.join(sorted(KNOWN_SITES))})",
+                UnknownFaultSiteWarning, stacklevel=2)
+        faults.append(Fault(site=site, kind=kind.strip(),
                             step=step, count=count, param=param))
     return faults
 

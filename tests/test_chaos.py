@@ -21,6 +21,9 @@ Crash-mid-checkpoint scenarios live with the other checkpoint tests in
 tests/test_checkpointing.py (same injector, ``checkpoint.*`` sites).
 """
 
+import time
+import warnings
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -32,7 +35,8 @@ from deepspeed_tpu.inference.serving import (DegradedError, ServeRequest,
 from deepspeed_tpu.models import gpt
 from deepspeed_tpu.utils import faults as faults_lib
 from deepspeed_tpu.utils.faults import (Fault, FaultInjector, InjectedCrash,
-                                        TransientDeviceError, parse_spec)
+                                        TransientDeviceError,
+                                        UnknownFaultSiteWarning, parse_spec)
 
 
 # ---------------------------------------------------------------------------
@@ -377,3 +381,73 @@ def test_chaos_prefix_cache_sites_parity(eng):
     assert all(r.state == "done" for r in srv.finished)
     assert srv.cache.held_blocks == 0
     assert (srv.cache._refcount == 0).all()          # no leaked claims
+
+
+def test_parse_spec_warns_once_on_unknown_site():
+    """A typo'd site warns loudly (once per site) instead of silently
+    injecting nothing; known sites parse quietly."""
+    faults_lib._warned_sites.discard("serving.prefil")
+    with pytest.warns(UnknownFaultSiteWarning, match="serving.prefil"):
+        parse_spec("serving.prefil:crash@0")
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        parse_spec("serving.prefil:crash@0")     # already warned: silent
+        parse_spec("serving.decode:crash@0")     # known site: silent
+
+
+def test_retry_backoff_capped_by_slot_deadline(eng):
+    """Backoff sleeps never outlive the tightest active-slot deadline:
+    with retry_backoff_s=5.0 an uncapped burst of 3 retries would
+    sleep >= 1.5 s (each pause floors at the 0.5 s clamp); the slack
+    cap bounds the whole wall-clock run by the request's deadline and
+    retires it as a timeout with its partial tokens."""
+    pw, p = prompts_of((6, 7), seed=41)
+    with faults_lib.injected(
+            Fault("serving.decode", "device_error", step=8, count=3),
+            seed=0) as inj:
+        srv = ServingEngine(eng, num_slots=1, block_size=4, num_blocks=16,
+                            prefill_chunk=8, max_retries=3,
+                            retry_backoff_s=5.0, spec_decode=False)
+        # warmup run (decode visits 0-3): compiles this pool shape so
+        # the timed request's deadline measures backoff, not XLA
+        srv.run([ServeRequest(rid="w", prompt=pw, max_new_tokens=4)],
+                wall_clock=True)
+        t0 = time.perf_counter()
+        req = ServeRequest(rid="d", prompt=p, max_new_tokens=32,
+                           deadline=t0 + 0.3)
+        srv.run([req], wall_clock=True)
+        elapsed = time.perf_counter() - t0
+    assert inj.fired and srv.stats["retries"] >= 1
+    assert elapsed < 1.0, f"backoff ignored the slot deadline: {elapsed:.2f}s"
+    assert req.state == "timeout" and len(req.out) >= 1
+
+
+def test_pending_snapshot_cold_resumes_into_fresh_engine(eng):
+    """The degrade snapshot is cold-resume complete: feeding its
+    entries (via ServeRequest.from_snapshot) to a FRESH engine finishes
+    every request token-identical to an undisturbed run, and
+    pending_snapshot(release=True) frees the dead engine's cache
+    claims so its pool is reclaimable."""
+    prompts = prompts_of((6, 9, 12), seed=43)
+    refs = _solo_refs(eng, prompts, 8)
+    with faults_lib.injected(
+            Fault("serving.decode", "slow", step=3, param=0.05), seed=0):
+        srv = ServingEngine(eng, num_slots=2, block_size=4, num_blocks=24,
+                            prefill_chunk=8, step_time_budget_s=0.01,
+                            watchdog_grace=1, spec_decode=False)
+        with pytest.raises(DegradedError) as ei:
+            srv.run([ServeRequest(rid=i, prompt=p, max_new_tokens=8)
+                     for i, p in enumerate(prompts)])
+    e = ei.value
+    snap = srv.pending_snapshot(release=True)
+    assert {s["rid"] for s in snap} == {s["rid"] for s in e.pending}
+    assert srv.cache.held_blocks == 0 and not srv.queue
+    assert (srv.cache._refcount == 0).all()
+    fresh = ServingEngine(eng, num_slots=2, block_size=4, num_blocks=24,
+                          prefill_chunk=8, spec_decode=False)
+    out = fresh.run([ServeRequest.from_snapshot(s) for s in snap])
+    out.update(e.results)
+    assert set(out) == set(range(len(prompts)))
+    for i, ref in enumerate(refs):
+        np.testing.assert_array_equal(out[i], ref)
+    assert all(r.state == "done" for r in fresh.finished)

@@ -24,11 +24,12 @@ cd "$(dirname "$0")/.."
 
 if [[ "${1:-}" == "chaos" ]]; then
     # deterministic chaos smoke: every injected failure path (transient
-    # device errors, cache exhaustion, slow steps, crash-mid-checkpoint)
-    # under a pinned seed, so a red run is reproducible bit-for-bit
+    # device errors, cache exhaustion, slow steps, crash-mid-checkpoint,
+    # replica kills drained across a 3-replica router fleet) under a
+    # pinned seed, so a red run is reproducible bit-for-bit
     echo "gate(chaos): fault-injection smoke (DS_FAULT_SEED=0)"
     DS_FAULT_SEED=0 python -m pytest tests/test_chaos.py \
-        tests/test_checkpointing.py -q
+        tests/test_checkpointing.py tests/test_router.py -q
 elif [[ "${1:-}" == "quick" ]]; then
     # lint only the .py files this change touches (full-tree scan is the
     # full gate's job); baseline + inline suppressions apply as usual

@@ -495,6 +495,116 @@ def bench_serving_kvquant_compare(name, **kw):
     }), flush=True)
 
 
+def bench_serving_router_compare(name, preset=None, num_requests=12,
+                                 mean_gap_steps=2.0, prompt_lens=(8, 40),
+                                 new_tokens=16, num_slots=2, block_size=8,
+                                 num_blocks=None, prefill_chunk=16,
+                                 n_replicas=3, kill_step=12, seed=0):
+    """Same request set driven through ONE undisturbed ServingEngine and
+    through an n_replicas ReplicaRouter fleet with one replica killed
+    mid-run (injected ``router.step`` crash at a pinned visit): the row
+    is the availability story — drained_requests recovered onto
+    survivors, greedy-stream parity with the undisturbed run (the drain
+    re-prefills prompt+partial, so tokens must be IDENTICAL), and the
+    p99 TTFT delta the kill + drain costs."""
+    from deepspeed_tpu.models import gpt
+    import deepspeed_tpu
+    from deepspeed_tpu.inference.router import ReplicaRouter
+    from deepspeed_tpu.inference.serving import ServeRequest, ServingEngine
+    from deepspeed_tpu.telemetry import Telemetry
+    from deepspeed_tpu.utils.faults import Fault, FaultInjector
+
+    on_tpu = "tpu" in (jax.devices()[0].platform +
+                       jax.devices()[0].device_kind).lower()
+    max_seq = prompt_lens[1] + new_tokens + 8
+    if preset:
+        cfg = gpt.preset(preset, max_seq_len=max_seq, dtype=jnp.bfloat16,
+                         use_flash_attention=on_tpu)
+    else:
+        cfg = gpt.GPTConfig(vocab_size=512, n_layers=4, n_heads=8,
+                            d_model=256, max_seq_len=max_seq,
+                            use_flash_attention=False, remat=False,
+                            dtype=jnp.float32)
+    eng = deepspeed_tpu.init_inference(
+        model=(cfg, gpt.init_params(jax.random.PRNGKey(0), cfg)),
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+
+    rng = np.random.default_rng(seed)
+    arrive = np.floor(np.cumsum(
+        rng.exponential(mean_gap_steps, num_requests))).astype(int)
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            rng.integers(*prompt_lens)).astype(np.int32)
+               for _ in range(num_requests)]
+
+    def mk_reqs():
+        return [ServeRequest(rid=i, prompt=prompts[i].copy(),
+                             max_new_tokens=new_tokens)
+                for i in range(num_requests)]
+
+    def mk_srv(tel=None, faults=None):
+        return ServingEngine(eng, num_slots=num_slots,
+                             block_size=block_size, num_blocks=num_blocks,
+                             prefill_chunk=prefill_chunk, spec_decode=False,
+                             telemetry=tel, faults=faults)
+
+    # warmup: compile the slot programs outside both timed drives
+    mk_srv().run([ServeRequest(rid="w", prompt=prompts[0].copy(),
+                               max_new_tokens=2)])
+
+    def drive(submit, step, busy):
+        t0 = time.perf_counter()
+        s = nxt = 0
+        reqs = mk_reqs()
+        while nxt < num_requests or busy():
+            while nxt < num_requests and arrive[nxt] <= s:
+                submit(reqs[nxt], now=time.perf_counter())
+                nxt += 1
+            step(now=time.perf_counter())
+            s += 1
+        return time.perf_counter() - t0
+
+    # undisturbed 1-replica baseline
+    tel1 = Telemetry()
+    solo = mk_srv(tel=tel1)
+    wall1 = drive(solo.submit, solo.step, lambda: solo.busy)
+    out1 = {r.rid: r.tokens.tolist() for r in solo.finished}
+    ttft1 = solo.metrics.histogram("serving_ttft")
+
+    # n-replica fleet, one replica crash-killed mid-run; the shared
+    # Telemetry aggregates serving_ttft across replicas (get-or-create
+    # registry), so the fleet percentile includes drained re-prefills
+    inj = FaultInjector([Fault("router.step", "crash", step=kill_step)],
+                        seed=seed)
+    teln = Telemetry()
+    fleet = [mk_srv(tel=teln, faults=inj) for _ in range(n_replicas)]
+    router = ReplicaRouter(fleet, faults=inj, telemetry=teln)
+    walln = drive(router.submit, router.step, lambda: router.busy)
+    outn = {rid: np.asarray(t).tolist()
+            for rid, t in router.results().items()}
+    ttftn = fleet[0].metrics.histogram("serving_ttft")
+
+    gen1 = sum(len(r.out) for r in solo.finished)
+    genn = sum(len(outn[i]) - len(prompts[i]) for i in outn)
+    print(json.dumps({
+        "config": name, "preset": preset or "cpu-smoke",
+        "router": f"1-vs-{n_replicas}(kill 1)",
+        "num_requests": num_requests, "n_replicas": n_replicas,
+        "replica_killed": bool(inj.fired),
+        "drained_requests": router.stats["drained_requests"],
+        "breaker_trips": router.stats["breaker_trips"],
+        "redispatches": router.stats["redispatches"],
+        "replica_health": router.health(),
+        "output_identical": all(
+            outn.get(i) == out1[i] for i in out1),
+        "ttft_p99_ms_solo": round(ttft1.percentile(99) * 1e3, 3),
+        "ttft_p99_ms_fleet": round(ttftn.percentile(99) * 1e3, 3),
+        "ttft_p99_delta_ms": round(
+            (ttftn.percentile(99) - ttft1.percentile(99)) * 1e3, 3),
+        "tokens_per_s_solo": round(gen1 / wall1, 1),
+        "tokens_per_s_fleet": round(genn / walln, 1),
+    }), flush=True)
+
+
 SERVE_CONFIGS = [
     # CPU-verifiable smoke: staggered Poisson arrivals must batch
     # (mean_occupancy > 1) and the paged footprint must undercut the
@@ -565,6 +675,18 @@ SERVE_COMPARE_CONFIGS = [
         mode="kvquant", preset="gpt2-medium", num_requests=32,
         mean_gap_steps=1.5, prompt_lens=(64, 384), new_tokens=64,
         num_slots=8, block_size=16, prefill_chunk=128)),
+    # replica-fleet router availability: the same requests through one
+    # undisturbed engine vs a 3-replica fleet with one replica crash-
+    # killed mid-run — drained work must land on survivors with
+    # identical greedy streams; ttft_p99_delta_ms is the drain's cost
+    ("serve-router-smoke", dict(mode="router", num_requests=10,
+                                mean_gap_steps=2.0, prompt_lens=(8, 24),
+                                new_tokens=12, num_slots=2, block_size=8,
+                                prefill_chunk=16, kill_step=12)),
+    ("serve-router-gpt2-medium", dict(
+        mode="router", preset="gpt2-medium", num_requests=24,
+        mean_gap_steps=1.5, prompt_lens=(64, 256), new_tokens=48,
+        num_slots=4, block_size=16, prefill_chunk=128, kill_step=40)),
 ]
 
 
@@ -603,6 +725,7 @@ def main():
         compare = {"prefix": bench_serving_prefix_compare,
                    "spec": bench_serving_spec_compare,
                    "kvquant": bench_serving_kvquant_compare,
+                   "router": bench_serving_router_compare,
                    }.get(mode, bench_serving_impl_compare)
         try:
             compare(name, **kw)
