@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deepspeed_tpu.inference import sampling
 from deepspeed_tpu.models import gpt as gpt_lib
 from deepspeed_tpu.ops import quantizer
 from deepspeed_tpu.models.gpt import (GPTConfig, _dense,
@@ -832,7 +833,8 @@ class InferenceEngine:
         return logits, out
 
     def _prefill_slot_fn(self, params, k_pool, v_pool, table_row, tokens,
-                         start, n_valid):
+                         start, n_valid, key, gen_count, temp, top_k,
+                         top_p, rep_pen, seen_row):
         """Prefill ONE prompt chunk into one serving slot's paged cache.
 
         tokens: [C] fixed-width chunk (padded; n_valid real tokens);
@@ -840,9 +842,12 @@ class InferenceEngine:
         first chunk, the resume point for later chunks / requeued
         requests, the MATCHED BOUNDARY for a prefix-cache hit whose
         shared blocks are already resident); table_row: [NB] the slot's
-        block table. Returns the
-        logits of the LAST VALID position (meaningful once the final
-        chunk lands) and the updated (donated) pools."""
+        block table. The trailing args are the slot's sampling lane
+        (inference/sampling.py — all DATA, so the compile contract is
+        untouched); the fused sampler runs on the last valid position,
+        meaningful once the final chunk lands. Returns the last-valid-
+        position logits, the sampled/greedy token [1], its logprob [1],
+        and the updated (donated) pools."""
         cfg = self.cfg
         C = tokens.shape[0]
         positions = start + jnp.arange(C, dtype=jnp.int32)
@@ -862,10 +867,16 @@ class InferenceEngine:
                                    (params["block"], k_pool, v_pool))
         last = jnp.clip(n_valid - 1, 0, C - 1)
         x_last = jax.lax.dynamic_slice_in_dim(x, last, 1, axis=1)
-        return self._logits(params, x_last), ks, vs
+        logits = self._logits(params, x_last)
+        tok, lp = sampling.sample_tokens(
+            logits[:, -1], key.reshape(1, 2), gen_count.reshape(1),
+            temp.reshape(1), top_k.reshape(1), top_p.reshape(1),
+            rep_pen.reshape(1), seen_row.reshape(1, -1))
+        return logits, tok, lp, ks, vs
 
     def _decode_slots_fn(self, params, k_pool, v_pool, tables, lengths,
-                         tokens, active, impl="gather"):
+                         tokens, active, impl, keys, gen_counts, temps,
+                         top_ks, top_ps, rep_pens, seen):
         """One decode step for EVERY serving slot at once. tokens: [B]
         (each slot's pending token); lengths: [B] per-slot cache
         positions; active: [B] (inactive slots run but write to the
@@ -873,7 +884,11 @@ class InferenceEngine:
         shape is static, so any mix of requests reuses this one
         compiled program. impl is a STATIC jit argument ("gather" |
         "pallas") selecting the attention path per compiled program —
-        see _block_decode_paged."""
+        see _block_decode_paged. The trailing args are the slot-indexed
+        sampling arrays (inference/sampling.py) — DATA, never statics,
+        so arbitrarily mixed greedy/sampled batches reuse this one
+        program; the fused sampler emits each slot's next token (and
+        its logprob) in the same dispatch as the forward step."""
         cfg = self.cfg
         x = params["wte"]["embedding"][tokens[:, None]]
         if cfg.use_wpe:
@@ -889,7 +904,11 @@ class InferenceEngine:
 
         x, (ks, vs) = jax.lax.scan(body, x,
                                    (params["block"], k_pool, v_pool))
-        return self._logits(params, x), ks, vs
+        logits = self._logits(params, x)
+        toks, lps = sampling.sample_tokens(logits[:, -1], keys, gen_counts,
+                                           temps, top_ks, top_ps, rep_pens,
+                                           seen)
+        return logits, toks, lps, ks, vs
 
     def _verify_slots_fn(self, params, k_pool, v_pool, tables, lengths,
                          tokens, active, impl="gather"):
@@ -958,11 +977,14 @@ class InferenceEngine:
                                 jnp.asarray(dst, jnp.int32))
 
     def _prefill_slot_q_fn(self, params, k_pool, v_pool, k_scale, v_scale,
-                           table_row, tokens, start, n_valid):
+                           table_row, tokens, start, n_valid, key,
+                           gen_count, temp, top_k, top_p, rep_pen,
+                           seen_row):
         """int8-pool twin of _prefill_slot_fn: the per-layer scale pools
         ([L, N, Hkv] fp32) thread through the scan alongside the pools
         and the block write is the read-modify-requantize path of
-        _block_prefill_paged."""
+        _block_prefill_paged. Carries the same fused sampling lane as
+        the fp program."""
         cfg = self.cfg
         C = tokens.shape[0]
         positions = start + jnp.arange(C, dtype=jnp.int32)
@@ -982,12 +1004,20 @@ class InferenceEngine:
             body, x, (params["block"], k_pool, v_pool, k_scale, v_scale))
         last = jnp.clip(n_valid - 1, 0, C - 1)
         x_last = jax.lax.dynamic_slice_in_dim(x, last, 1, axis=1)
-        return self._logits(params, x_last), ks, vs, kss, vss
+        logits = self._logits(params, x_last)
+        tok, lp = sampling.sample_tokens(
+            logits[:, -1], key.reshape(1, 2), gen_count.reshape(1),
+            temp.reshape(1), top_k.reshape(1), top_p.reshape(1),
+            rep_pen.reshape(1), seen_row.reshape(1, -1))
+        return logits, tok, lp, ks, vs, kss, vss
 
     def _decode_slots_q_fn(self, params, k_pool, v_pool, k_scale, v_scale,
-                           tables, lengths, tokens, active, impl="gather"):
+                           tables, lengths, tokens, active, impl, keys,
+                           gen_counts, temps, top_ks, top_ps, rep_pens,
+                           seen):
         """int8-pool twin of _decode_slots_fn (see _block_decode_paged's
-        quantized write path)."""
+        quantized write path). Carries the same fused sampling lanes as
+        the fp program."""
         cfg = self.cfg
         x = params["wte"]["embedding"][tokens[:, None]]
         if cfg.use_wpe:
@@ -1003,7 +1033,11 @@ class InferenceEngine:
 
         x, (ks, vs, kss, vss) = jax.lax.scan(
             body, x, (params["block"], k_pool, v_pool, k_scale, v_scale))
-        return self._logits(params, x), ks, vs, kss, vss
+        logits = self._logits(params, x)
+        toks, lps = sampling.sample_tokens(logits[:, -1], keys, gen_counts,
+                                           temps, top_ks, top_ps, rep_pens,
+                                           seen)
+        return logits, toks, lps, ks, vs, kss, vss
 
     def _verify_slots_q_fn(self, params, k_pool, v_pool, k_scale, v_scale,
                            tables, lengths, tokens, active, impl="gather"):
@@ -1052,45 +1086,76 @@ class InferenceEngine:
     # The fault-injection sites fire BEFORE any dispatch touches the
     # donated pools, so a TransientDeviceError here is retryable by the
     # serving engine against intact buffers (utils/faults).
+    @staticmethod
+    def _samp_lanes(sample_state, batch, vocab, scalar=False):
+        """Coerce a host ``sample_state`` tuple (sampling.SlotSamplerState
+        ``lanes()``/``lane()``) to traced arrays; None synthesizes the
+        all-greedy lanes so legacy callers keep their behavior (and the
+        one compiled program — greedy lanes are values, not a different
+        signature). ``scalar`` selects the single-slot (prefill) lane
+        shape."""
+        if sample_state is None:
+            st = sampling.greedy_state(batch, vocab)
+            sample_state = tuple(a[0] for a in st) if scalar else st
+        keys, gens, temps, top_ks, top_ps, pens, seen = sample_state
+        return (jnp.asarray(keys, jnp.uint32), jnp.asarray(gens, jnp.int32),
+                jnp.asarray(temps, jnp.float32),
+                jnp.asarray(top_ks, jnp.int32),
+                jnp.asarray(top_ps, jnp.float32),
+                jnp.asarray(pens, jnp.float32), jnp.asarray(seen, bool))
+
     def prefill_into_slot(self, k_pool, v_pool, table_row, tokens, start,
-                          n_valid, k_scale=None, v_scale=None):
+                          n_valid, k_scale=None, v_scale=None,
+                          sample_state=None):
         from deepspeed_tpu.utils.faults import maybe_fire
         maybe_fire("engine.prefill")
+        legacy = sample_state is None
+        lanes = self._samp_lanes(sample_state, 1, self.cfg.vocab_size,
+                                 scalar=True)
         if k_scale is None:
-            return self._prefill_slot(
+            out = self._prefill_slot(
                 self.params, k_pool, v_pool,
                 jnp.asarray(table_row, jnp.int32),
                 jnp.asarray(tokens, jnp.int32),
                 jnp.asarray(start, jnp.int32),
-                jnp.asarray(n_valid, jnp.int32))
+                jnp.asarray(n_valid, jnp.int32), *lanes)
+            return (out[0],) + out[3:] if legacy else out
         # ``cache.quantize`` fires before the dispatch touches the
         # donated pools OR scale pools: a TransientDeviceError here is
         # retryable against intact buffers
         maybe_fire("cache.quantize")
-        return self._prefill_slot_q(
+        out = self._prefill_slot_q(
             self.params, k_pool, v_pool, k_scale, v_scale,  # dslint: disable=DS003 — exclusive branch: the fp dispatch above already returned
             jnp.asarray(table_row, jnp.int32),
             jnp.asarray(tokens, jnp.int32),
-            jnp.asarray(start, jnp.int32), jnp.asarray(n_valid, jnp.int32))
+            jnp.asarray(start, jnp.int32), jnp.asarray(n_valid, jnp.int32),
+            *lanes)
+        return (out[0],) + out[3:] if legacy else out
 
     def decode_slots(self, k_pool, v_pool, tables, lengths, tokens, active,
-                     impl=None, k_scale=None, v_scale=None):
+                     impl=None, k_scale=None, v_scale=None,
+                     sample_state=None):
         from deepspeed_tpu.utils.faults import maybe_fire
         maybe_fire("engine.decode")
+        legacy = sample_state is None
+        lanes = self._samp_lanes(sample_state, len(np.asarray(tokens)),
+                                 self.cfg.vocab_size)
         if k_scale is None:
-            return self._decode_slots(
+            out = self._decode_slots(
                 self.params, k_pool, v_pool,
                 jnp.asarray(tables, jnp.int32),
                 jnp.asarray(lengths, jnp.int32),
                 jnp.asarray(tokens, jnp.int32), jnp.asarray(active, bool),
-                self.decode_impl if impl is None else impl)
+                self.decode_impl if impl is None else impl, *lanes)
+            return (out[0],) + out[3:] if legacy else out
         maybe_fire("cache.quantize")
-        return self._decode_slots_q(
+        out = self._decode_slots_q(
             self.params, k_pool, v_pool, k_scale, v_scale,  # dslint: disable=DS003 — exclusive branch: the fp dispatch above already returned
             jnp.asarray(tables, jnp.int32),
             jnp.asarray(lengths, jnp.int32),
             jnp.asarray(tokens, jnp.int32), jnp.asarray(active, bool),
-            self.decode_impl if impl is None else impl)
+            self.decode_impl if impl is None else impl, *lanes)
+        return (out[0],) + out[3:] if legacy else out
 
     def verify_slots(self, k_pool, v_pool, tables, lengths, tokens, active,
                      impl=None, k_scale=None, v_scale=None):
@@ -1224,7 +1289,10 @@ class InferenceEngine:
             return jnp.argmax(logits, axis=-1)
         logits = logits / temperature
         if top_k > 0:
-            kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+            # k-th largest via lax.top_k (O(V log k)) — same threshold
+            # the full jnp.sort produced, cheaper (gshard sampler idiom)
+            k_eff = min(top_k, logits.shape[-1])
+            kth = jax.lax.top_k(logits, k_eff)[0][:, -1][:, None]
             logits = jnp.where(logits < kth, -1e30, logits)
         return jax.random.categorical(rng, logits, axis=-1)
 

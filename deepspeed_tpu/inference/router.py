@@ -47,9 +47,12 @@ the router merges its finished ``results``, takes its
 including prefix-cache pins), dedups entries already terminal
 fleet-wide, and resubmits the remainder onto survivors. A resumed
 request re-prefills prompt + already-emitted tokens — the same
-recompute-on-resume path eviction uses — so greedy drained output is
-TOKEN-IDENTICAL to an undisturbed run (tests/test_router.py pins this
-against solo references). When no dispatchable replica remains the
+recompute-on-resume path eviction uses — so drained output is
+TOKEN-IDENTICAL to an undisturbed run: greedy trivially, and sampled
+requests too, because the per-token sampling key is a pure function
+of (seed, tokens emitted so far), so seed + ``out`` in the snapshot
+IS the key-chain state (docs/SAMPLING.md; tests/test_router.py and
+tests/test_sampling.py pin both against solo references). When no dispatchable replica remains the
 router raises a fleet-level :class:`DegradedError` carrying merged
 results and the orphaned pending entries: total degrade still loses
 nothing.

@@ -76,11 +76,14 @@ free token). The first reject rolls the slot's cache back
 (``cache.rollback``): lengths shrink past the rejected suffix and tail
 blocks only that suffix touched return to the pool; stale K/V inside
 kept blocks is overwritten by the next chunk before any query attends
-it. Greedy-target-equality acceptance makes spec-on output BIT-
+it. A temperature=0 slot accepts its longest prefix agreeing with the
+target's own greedy argmax, which makes its spec-on output BIT-
 IDENTICAL to spec-off greedy serving (tests/test_spec_serving.py pins
-this across eviction/requeue and prefix-cache hits); speculation only
-changes how many steps the same tokens take. An injected draft/verify
-fault degrades that step to the plain one-token path
+this across eviction/requeue and prefix-cache hits); a sampled slot
+runs per-position rejection-sampling verify (Leviathan/Chen), which is
+DISTRIBUTION-lossless against plain sampled decode (docs/SAMPLING.md).
+Speculation only changes how many steps the tokens take. An injected
+draft/verify fault degrades that step to the plain one-token path
 (``stats["spec_fallbacks"]``) — chaos turns speculation off, never
 output wrong.
 
@@ -107,21 +110,37 @@ the scheduler deadline clock is a private field, so mutating a metric
 can never move a deadline. Default off: the off path swaps in no-op
 twins and is token-bit-identical to on (tests/test_telemetry.py).
 
+Per-request sampling (docs/SAMPLING.md): every ``ServeRequest`` may
+carry its own temperature/top_k/top_p/seed/repetition_penalty plus
+``stop`` sequences, ``logprobs``, and ``n`` candidates. The knobs ride
+as slot-indexed DEVICE ARRAYS into the fused sampler that is traced
+inside the prefill/decode slot programs (inference/sampling.py) —
+data, not jit statics — so arbitrarily mixed greedy/sampled batches
+keep the two-program compile contract, and greedy slots in a mixed
+batch stay bit-identical to an all-greedy run. The per-token key is
+``fold_in(PRNGKey(seed), tokens_generated)``, a pure function of
+request state, so eviction/requeue and router drain resume a sampled
+stream bit-exactly (spec-decode sampled verify is the one documented
+exception: distribution-lossless, deterministic per run history, not
+bit-stable across a mid-stream resume).
+
 Greedy parity contract (tested): for any arrival pattern, every
-request's output is token-for-token identical to a solo
+temperature=0 request's output is token-for-token identical to a solo
 ``InferenceEngine.generate`` run of its prompt.
 """
 
+import math
 import time
 from collections import deque
 from collections.abc import Mapping
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepspeed_tpu.inference import sampling
 from deepspeed_tpu.inference.paged_cache import (CacheExhausted,
                                                  PagedKVCache,
                                                  resolve_prefix_cache)
@@ -130,7 +149,7 @@ from deepspeed_tpu.inference.spec_decode import (make_draft,
                                                  resolve_spec_k)
 from deepspeed_tpu.ops.quantizer import resolve_kv_quant
 from deepspeed_tpu.telemetry import (NOOP, MetricsRegistry, NoopTelemetry,
-                                     RATE_BUCKETS, Telemetry,
+                                     RATE_BUCKETS, TEMP_BUCKETS, Telemetry,
                                      resolve_telemetry)
 from deepspeed_tpu.utils import faults as faults_lib
 from deepspeed_tpu.utils.faults import TransientDeviceError
@@ -164,6 +183,10 @@ _STAT_FIELDS = (
     ("spec_accepted", "c", "draft tokens accepted by the target"),
     ("spec_emitted", "c", "tokens emitted by speculative steps"),
     ("spec_fallbacks", "c", "spec steps degraded to plain decode"),
+    ("sampled_tokens", "c", "tokens emitted by sampled (temperature>0) lanes"),
+    ("stop_hits", "c", "requests finished by a stop sequence"),
+    ("spec_k_capped", "c", "verify participations depth-capped by low "
+                           "acceptance"),
 )
 
 
@@ -196,13 +219,35 @@ class ServeRequest:
     bench derives per-token latency percentiles from these).
     ``deadline`` is an absolute scheduler-clock instant (same clock as
     ``submit``/``step``'s ``now``): once reached the request retires
-    with ``state="timeout"``, keeping whatever it generated."""
+    with ``state="timeout"``, keeping whatever it generated.
+
+    Per-request sampling knobs (docs/SAMPLING.md): ``temperature`` /
+    ``top_k`` / ``top_p`` / ``seed`` / ``repetition_penalty`` default to
+    None = "use the engine-wide ctor default" — an explicit value wins.
+    ``stop`` is a list of token-id sequences: generation finishes as
+    soon as ``out`` ends with any of them (the matched stop tokens are
+    KEPT in ``out``, so the resume/drain contract sees the true emitted
+    stream). ``logprobs=True`` records each emitted token's
+    log-probability under its sampling distribution in
+    ``out_logprobs``. ``n>1`` expands at submit into ``n`` independent
+    candidates (rids ``rid#1``..``rid#n-1`` plus the original) whose
+    seeds derive from this request's seed via
+    :func:`sampling.candidate_seed`."""
     rid: Any
     prompt: np.ndarray
     max_new_tokens: int = 32
     eos_id: Optional[int] = None
     deadline: Optional[float] = None
+    temperature: Optional[float] = None
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    seed: Optional[int] = None
+    repetition_penalty: Optional[float] = None
+    stop: Optional[List[Sequence[int]]] = None
+    logprobs: bool = False
+    n: int = 1
     out: List[int] = field(default_factory=list)
+    out_logprobs: List[float] = field(default_factory=list)
     state: str = "queued"      # queued | prefill | decode | done | timeout | shed
     token_times: List[float] = field(default_factory=list)
     submitted_at: Optional[float] = None
@@ -223,16 +268,31 @@ class ServeRequest:
         """Rebuild a resumable request from a ``pending_snapshot()``
         entry — the cold-resume half of the drain contract: submitting
         the rebuilt request to a FRESH engine re-prefills prompt +
-        already-emitted tokens, and greedy decode continues from the
-        exact pre-failure position, so the drained output is token-
-        identical to an undisturbed run."""
+        already-emitted tokens, and decode continues from the exact
+        pre-failure position. Greedy output is token-identical to an
+        undisturbed run; a sampled request resumes its key chain exactly
+        (the per-token key is a pure function of (seed, tokens emitted
+        so far), so carrying seed + out IS the chain state —
+        docs/SAMPLING.md). ``n`` is pinned to 1: candidate expansion
+        already happened at the original submit."""
         return cls(
             rid=entry["rid"],
             prompt=np.asarray(entry["prompt"], np.int32),
             max_new_tokens=int(entry["max_new_tokens"]),
             eos_id=entry.get("eos_id"),
             deadline=entry.get("deadline"),
+            temperature=entry.get("temperature"),
+            top_k=entry.get("top_k"),
+            top_p=entry.get("top_p"),
+            seed=entry.get("seed"),
+            repetition_penalty=entry.get("repetition_penalty"),
+            stop=[list(s) for s in entry["stop"]]
+            if entry.get("stop") else None,
+            logprobs=bool(entry.get("logprobs", False)),
+            n=1,
             out=[int(t) for t in entry.get("out", ())],
+            out_logprobs=[float(x)
+                          for x in entry.get("out_logprobs", ())],
             evictions=int(entry.get("evictions", 0)))
 
 
@@ -269,7 +329,19 @@ def snapshot_entry(req: ServeRequest, **extra) -> Dict:
              "out": [int(t) for t in req.out],
              "max_new_tokens": req.max_new_tokens,
              "eos_id": req.eos_id,
-             "deadline": req.deadline}
+             "deadline": req.deadline,
+             # sampling state: the per-token key is a pure function of
+             # (seed, len(out)), so these fields ARE the key-chain state
+             # a drain/resume needs (docs/SAMPLING.md)
+             "temperature": req.temperature,
+             "top_k": req.top_k,
+             "top_p": req.top_p,
+             "seed": req.seed,
+             "repetition_penalty": req.repetition_penalty,
+             "stop": [[int(t) for t in s] for s in req.stop]
+             if req.stop else None,
+             "logprobs": req.logprobs,
+             "out_logprobs": [float(x) for x in req.out_logprobs]}
     entry.update(extra)
     return entry
 
@@ -308,15 +380,25 @@ class ServingEngine:
     - ``spec_decode`` / ``spec_k`` / ``spec_draft``: speculative decode
       inside the batch (docs/SPECULATIVE.md) — each step a drafter
       proposes ``spec_k`` tokens per slot and ONE verify program scores
-      all ``spec_k + 1`` positions; the accepted prefix (greedy-target
-      agreement) advances the slot, the first reject rolls the cache
-      back, so output is bit-identical to spec-off greedy serving.
+      all ``spec_k + 1`` positions; the accepted prefix advances the
+      slot, the first reject rolls the cache back. temperature=0 slots
+      accept by greedy-target agreement (bit-identical to spec-off
+      greedy serving); sampled slots accept by rejection sampling
+      (distribution-lossless, docs/SAMPLING.md).
       ``spec_decode`` None defers to ``DS_SPEC_DECODE`` (default off —
       plain one-token decode stays the bit-reference); ``spec_k`` None
       to ``DS_SPEC_K`` (default 4); ``spec_draft`` takes ``"ngram"``
       (prompt-lookup, default), a draft ``InferenceEngine``, or any
-      ``propose(context, k)`` object. Greedy-only: spec with
-      ``temperature > 0`` raises (acceptance needs the target argmax).
+      ``propose(context, k)`` object.
+    - ``spec_accept_floor`` / ``spec_adapt_warmup``: adaptive
+      speculation depth — after ``spec_adapt_warmup`` verify
+      participations, a slot whose acceptance EWMA is under the floor
+      verifies only ONE draft token per step until its rate recovers
+      (the verify program's static width never changes; floor<=0
+      disables the cap).
+    - ``temperature`` / ``top_k`` / ``seed``: engine-wide DEFAULTS for
+      requests that leave their own sampling fields at None; a
+      request's explicit knobs always win (docs/SAMPLING.md).
     - ``kv_quant``: int8 paged KV-cache blocks with per-block scales
       (docs/KV_QUANT.md) — ~2x decode slots at the same cache HBM.
       ``"int8"``/``"off"``; None defers to ``DS_KV_QUANT`` (default
@@ -341,6 +423,8 @@ class ServingEngine:
                  spec_decode: Optional[bool] = None,
                  spec_k: Optional[int] = None,
                  spec_draft=None,
+                 spec_accept_floor: float = 0.125,
+                 spec_adapt_warmup: int = 4,
                  kv_quant: Optional[str] = None):
         if engine.is_encoder:
             raise ValueError("serving needs a causal decoder engine")
@@ -416,12 +500,24 @@ class ServingEngine:
         self.spec_decode = resolve_spec_decode(spec_decode)
         self.spec_k = resolve_spec_k(spec_k)
         self.draft = make_draft(spec_draft) if self.spec_decode else None
-        if self.spec_decode and self.temperature > 0:
-            raise ValueError(
-                "spec_decode is greedy-only (acceptance compares drafts "
-                "against the target argmax); got temperature="
-                f"{self.temperature}")
-        self._rng = jax.random.PRNGKey(seed)
+        # adaptive speculation depth: a slot whose acceptance EWMA sinks
+        # under ``spec_accept_floor`` (after ``spec_adapt_warmup``
+        # verify participations) caps its accepted prefix at 1 draft
+        # token, so adversarial low-accept traffic stops paying verify
+        # rollbacks for depth it never uses (floor<=0 disables)
+        self.spec_accept_floor = float(spec_accept_floor)
+        self.spec_adapt_warmup = int(spec_adapt_warmup)
+        self._accept_ewma = np.ones(num_slots, np.float64)
+        self._spec_obs = np.zeros(num_slots, np.int64)
+        # per-request sampling: engine-wide ctor knobs are DEFAULTS a
+        # request's own fields override (sampling.resolve_params); the
+        # resolved knobs live as slot-indexed arrays the fused sampler
+        # reads as data, so greedy/sampled mixes share one program
+        self.seed = int(seed)
+        self.sampler = sampling.SlotSamplerState(num_slots,
+                                                 engine.cfg.vocab_size)
+        self._slot_params: List[Optional[sampling.SamplingParams]] = \
+            [None] * num_slots
         self.queue: deque = deque()
         self.slots: List[Optional[ServeRequest]] = [None] * num_slots
         self.finished: List[ServeRequest] = []
@@ -477,6 +573,11 @@ class ServingEngine:
                 "tokens emitted per live slot per verify step",
                 buckets=tuple(float(i)
                               for i in range(1, self.spec_k + 2)))
+            self._h_temp = reg.histogram(
+                "serving_request_temperature",
+                "resolved per-request sampling temperature at admission "
+                "(0 = greedy)",
+                buckets=TEMP_BUCKETS)
             # KV-pool shape of THIS run (static per run, gauges so the
             # Prometheus text path exports them next to the block
             # gauges): bytes/token includes the amortized per-block
@@ -512,7 +613,7 @@ class ServingEngine:
             self.faults.add_listener(self._fault_listener)
         else:
             self._h_ttft = self._h_tpot = self._h_qwait = self._h_occ = None
-            self._h_accept = self._h_tps = None
+            self._h_accept = self._h_tps = self._h_temp = None
             self._h_kv_err = None
             self._fault_listener = None
 
@@ -531,6 +632,33 @@ class ServingEngine:
         if self.cache.blocks_for(total) > self.cache.num_blocks - 1:
             raise ValueError(
                 f"request {req.rid} needs more blocks than the whole pool")
+        # fail fast on malformed sampling knobs (resolve_params
+        # validates the resolved bundle) — and resolve once here so the
+        # n>1 expansion below derives candidate seeds from the SAME
+        # seed admission will use
+        params = sampling.resolve_params(req, self.temperature,
+                                         self.top_k, self.seed)
+        if req.n < 1:
+            raise ValueError(f"request {req.rid}: n must be >= 1, "
+                             f"got {req.n}")
+        if req.n > 1:
+            # expand into n independent candidates: the original keeps
+            # its rid as candidate 0, clones get rid#i and a
+            # SeedSequence-derived seed. n is pinned back to 1 on every
+            # piece so a drain/resume resubmit never re-expands.
+            n, req.n = req.n, 1
+            ok = self.submit(req, now=now)
+            for i in range(1, n):
+                clone = ServeRequest(
+                    rid=f"{req.rid}#{i}", prompt=req.prompt,
+                    max_new_tokens=req.max_new_tokens, eos_id=req.eos_id,
+                    deadline=req.deadline, temperature=req.temperature,
+                    top_k=req.top_k, top_p=req.top_p,
+                    seed=sampling.candidate_seed(params.seed, i),
+                    repetition_penalty=req.repetition_penalty,
+                    stop=req.stop, logprobs=req.logprobs, n=1)
+                ok = self.submit(clone, now=now) and ok
+            return ok
         req.submitted_at = now
         # resume-aware working prompt: a request rebuilt from a
         # pending snapshot (out non-empty) re-prefills prompt+partial —
@@ -646,6 +774,8 @@ class ServingEngine:
                 if r is not None:
                     self.cache.free(slot)
                     self.slots[slot] = None
+                    self.sampler.release(slot)
+                    self._slot_params[slot] = None
             self.queue.clear()
             self._update_backpressure()
         return snap
@@ -716,6 +846,19 @@ class ServingEngine:
             req.state = "prefill"
             req._admit_seq = self._admit_counter
             self._admit_counter += 1
+            # sampling lanes for this slot: resolved knobs become the
+            # slot-indexed arrays the fused sampler reads; the seen mask
+            # seeds from prompt+generated (req._work), so a
+            # repetition-penalized request resumes with the identical
+            # penalty state after eviction or drain
+            params = sampling.resolve_params(req, self.temperature,
+                                             self.top_k, self.seed)
+            self._slot_params[slot] = params
+            self.sampler.admit(slot, params, req._work)
+            self._accept_ewma[slot] = 1.0
+            self._spec_obs[slot] = 0
+            if self._h_temp is not None:
+                self._h_temp.observe(params.temperature)
             self._stat["admitted"].inc()
             if self._h_qwait is not None and req.submitted_at is not None:
                 self._h_qwait.observe(max(0.0, now - req.submitted_at))
@@ -731,16 +874,24 @@ class ServingEngine:
             n = min(self.prefill_chunk, len(req._work) - done)
             chunk = np.zeros((self.prefill_chunk,), np.int32)
             chunk[:n] = req._work[done:done + n]
+            # the slot's sampling lane rides every chunk (data, not a
+            # signature change); only the FINAL chunk's sample is kept
+            lane = self.sampler.lane(slot, len(req.out))
             if self._quant:
-                (logits, self.cache.k, self.cache.v, self.cache.k_scale,
-                 self.cache.v_scale) = self._device_call(
-                    "serving.prefill", self.engine.prefill_into_slot,
+                (logits, tok, lp, self.cache.k, self.cache.v,
+                 self.cache.k_scale, self.cache.v_scale) = self._device_call(
+                    "serving.prefill",
+                    lambda *a: self.engine.prefill_into_slot(
+                        *a, sample_state=lane),
                     self.cache.k, self.cache.v, self.cache.tables[slot],
                     chunk, done, n, self.cache.k_scale,
                     self.cache.v_scale, now=now)
             else:
-                logits, self.cache.k, self.cache.v = self._device_call(
-                    "serving.prefill", self.engine.prefill_into_slot,
+                (logits, tok, lp, self.cache.k,
+                 self.cache.v) = self._device_call(
+                    "serving.prefill",
+                    lambda *a: self.engine.prefill_into_slot(
+                        *a, sample_state=lane),
                     self.cache.k, self.cache.v, self.cache.tables[slot],
                     chunk, done, n, now=now)
             self.cache.advance(slot, n)
@@ -757,10 +908,17 @@ class ServingEngine:
                 self.telemetry.tracer.event(
                     "prefill_done", rid=req.rid, step=self._step_clock,
                     slot=slot)
-                # final chunk: its last-position logits yield the next
-                # token (== generate()'s prefill sample; on resume, the
-                # recomputed position is exactly the pre-eviction one)
-                self._emit(slot, req, logits, now)
+                # final chunk: its last-position logits yielded the next
+                # token inside the program (== generate()'s prefill
+                # sample on the greedy lane; on resume, the recomputed
+                # position is exactly the pre-eviction one, and the
+                # sampled lane's key fold_in(key, len(out)) replays the
+                # identical draw)
+                self._emit_sampled(
+                    slot, req,
+                    int(np.asarray(tok)[0]),  # dslint: disable=DS001 — final chunk only: ONE pull per prefill completion (the prefill-emitted token), not per-chunk work
+                    float(np.asarray(lp)[0]),  # dslint: disable=DS001 — same single completion-time pull
+                    now)
                 if req.state not in TERMINAL_STATES:
                     req.state = "decode"
 
@@ -820,47 +978,70 @@ class ServingEngine:
             # unchanged — no slot was advanced or emitted into)
         tokens = np.zeros((self.num_slots,), np.int32)
         active = np.zeros((self.num_slots,), bool)
+        gen_counts = np.zeros((self.num_slots,), np.int32)
         for i in live:
             tokens[i] = self.slots[i].out[-1]
             active[i] = True
+            gen_counts[i] = len(self.slots[i].out)
+        lanes = self.sampler.lanes(gen_counts)
         budget = self.step_time_budget_s
         t0 = time.perf_counter() if budget is not None else 0.0
         if self._quant:
-            (logits, self.cache.k, self.cache.v, self.cache.k_scale,
-             self.cache.v_scale) = self._device_call(
-                "serving.decode", self.engine.decode_slots,
+            (logits, toks, lps, self.cache.k, self.cache.v,
+             self.cache.k_scale, self.cache.v_scale) = self._device_call(
+                "serving.decode",
+                lambda *a: self.engine.decode_slots(*a, sample_state=lanes),
                 self.cache.k, self.cache.v, self.cache.tables,
                 self.cache.lengths, tokens, active, self.decode_impl,
                 self.cache.k_scale, self.cache.v_scale, now=now)
         else:
-            logits, self.cache.k, self.cache.v = self._device_call(
-                "serving.decode", self.engine.decode_slots,
+            (logits, toks, lps, self.cache.k,
+             self.cache.v) = self._device_call(
+                "serving.decode",
+                lambda *a: self.engine.decode_slots(*a, sample_state=lanes),
                 self.cache.k, self.cache.v, self.cache.tables,
                 self.cache.lengths, tokens, active, self.decode_impl,
                 now=now)
         if budget is not None:
             self._watchdog_note(time.perf_counter() - t0)
         self._stat["decode_steps"].inc()
+        # one host transfer covers every slot's token + logprob (the
+        # sampler already ran inside the compiled decode program)
+        toks = np.asarray(toks)
+        lps = np.asarray(lps)
         for i in live:
             self.cache.advance(i, 1)
-            self._emit(i, self.slots[i], logits[i:i + 1], now)
+            self._emit_sampled(
+                i, self.slots[i], int(toks[i]),
+                float(lps[i]), now)  # dslint: disable=DS001 — lps is host numpy already (the single batched pull above)
         return len(live)
 
     def _spec_decode_step(self, live: List[int], now: float) -> Optional[int]:
         """One speculative iteration over the decoding slots: draft
         ``spec_k`` tokens per slot, verify all ``spec_k + 1`` positions
-        in ONE program, accept each slot's longest draft prefix that
-        matches the target's own greedy choices, emit accepted tokens
-        plus the target's correction, roll the cache back past the first
-        reject. Returns the occupancy, or None to degrade this step to
-        the plain one-token path (an injected draft/verify fault — both
-        fire BEFORE dispatch, so no slot state has moved).
+        in ONE program, accept each slot's draft prefix, emit accepted
+        tokens plus the target's correction, roll the cache back past
+        the first reject. A temperature=0 slot accepts by greedy-target
+        agreement (bit-identical to spec-off greedy serving); a sampled
+        slot runs per-position rejection sampling (Leviathan/Chen:
+        accept the draft token x with prob min(1, p(x)/q(x)) — q is a
+        point mass for the deterministic drafters, so that is p(x) —
+        and resamples a rejection from the residual norm(max(0, p-q))),
+        which is distribution-lossless against plain sampled decode
+        (docs/SAMPLING.md). Returns the occupancy, or None to degrade
+        this step to the plain one-token path (an injected draft/verify
+        fault — both fire BEFORE dispatch, so no slot state has moved).
 
         Capacity is opportunistic: the chunk wants ``spec_k + 1`` tokens
         of room, but a slot that cannot grow (pool pressure, per-slot
         budget) just speculates shallower this step — eviction is never
         triggered FOR draft tokens, only for the one committed token the
-        plain preamble already guaranteed."""
+        plain preamble already guaranteed. Adaptive depth rides the same
+        cap: a slot whose acceptance EWMA fell under
+        ``spec_accept_floor`` verifies only 1 draft token until its rate
+        recovers (the chunk stays ``spec_k + 1`` wide — the static
+        verify program never changes — the unverified suffix is simply
+        rolled back like any rejection)."""
         G = self.spec_k + 1
         try:
             self.faults.fire("serving.spec_draft")
@@ -919,22 +1100,61 @@ class ServingEngine:
         self._stat["decode_steps"].inc()
         self._stat["spec_steps"].inc()
         # the target's greedy choice at every chunk position — the SAME
-        # fp32-cast device argmax _sample takes, so accepted tokens are
-        # bit-identical to what plain decode would have emitted
+        # fp32-cast device argmax the fused sampler's greedy lane takes,
+        # so accepted tokens are bit-identical to what plain decode
+        # would have emitted
         greedy = np.asarray(jax.device_get(  # dslint: disable=DS001 — accept/reject is host control flow; one transfer per verify step replaces spec_k+1 plain-decode transfers
             jnp.argmax(logits.astype(jnp.float32), axis=-1)))
+        # sampled slots (and greedy slots that want logprobs) need the
+        # full verify logits host-side for the fp64 Leviathan math
+        logits_host = None
+        if any(self._slot_params[i] is not None
+               and (self._slot_params[i].sampled or self.slots[i].logprobs)
+               for i in live):
+            logits_host = np.asarray(jax.device_get(  # dslint: disable=DS001 — fp64 accept/resample is host math by design; one transfer per verify step
+                logits.astype(jnp.float32)))
         proposed = accepted = emitted = 0
         accept_by_slot = {}
         for i in live:
             req = self.slots[i]
+            params = self._slot_params[i]
             # leading agreement, capped so lengths never outgrow the
             # blocks actually allocated (caps >= 1: the plain preamble
             # guaranteed room for the committed token)
             k_live = max(0, min(self.spec_k, caps[i] - 1))
+            if (self.spec_accept_floor > 0.0 and k_live > 1
+                    and self._spec_obs[i] >= self.spec_adapt_warmup
+                    and self._accept_ewma[i] < self.spec_accept_floor):
+                self._stat["spec_k_capped"].inc()
+                k_live = 1
             prop = proposals[i]
-            acc = 0
-            while acc < k_live and greedy[i, acc] == prop[acc]:
-                acc += 1
+            if params is not None and params.sampled:
+                # rejection-sampling verify against the target's fp64
+                # sampling distributions at each chunk position;
+                # position j decides generation index len(out) + j, and
+                # the uniforms are Philox(seed, index) — counter-based,
+                # so a chunk boundary is invisible to the draw stream
+                rows = sampling.fp64_dist(
+                    logits_host[i, :k_live + 1], params.temperature,
+                    top_k=params.top_k, top_p=params.top_p)
+                toks, lps, acc = sampling.spec_verify_tokens(
+                    rows, prop[:k_live], params.seed, len(req.out))
+            else:
+                acc = 0
+                while acc < k_live and greedy[i, acc] == prop[acc]:
+                    acc += 1
+                toks = [int(t) for t in prop[:acc]] + [int(greedy[i, acc])]
+                lps = [None] * len(toks)
+                if req.logprobs:
+                    # log p under plain softmax of the verify logits —
+                    # the greedy lane's logprob source in sample_tokens
+                    lps = [math.log(max(float(  # dslint: disable=DS001 — fp64 host math over logits_host (already pulled once above), no device sync
+                        sampling.fp64_dist(logits_host[i, j], 1.0)[t]),
+                        1e-300)) for j, t in enumerate(toks)]
+            if k_live > 0:
+                self._accept_ewma[i] = (0.8 * self._accept_ewma[i]
+                                        + 0.2 * (acc / k_live))
+                self._spec_obs[i] += 1
             proposed += k_live
             accepted += acc
             accept_by_slot[i] = acc
@@ -945,9 +1165,9 @@ class ServingEngine:
             self.cache.advance(i, acc + 1)
             self.cache.rollback(i, new_len)
             self._stat["spec_slot_steps"].inc()
-            for tok in [int(t) for t in prop[:acc]] + [int(greedy[i, acc])]:
+            for tok, lp in zip(toks, lps):
                 emitted += 1
-                self._emit_token(i, req, tok, now)
+                self._emit_sampled(i, req, int(tok), lp, now)
                 if req.state in TERMINAL_STATES:
                     break      # max_new/eos truncation, same order as off
         self._stat["spec_proposed"].inc(proposed)
@@ -1086,6 +1306,8 @@ class ServingEngine:
         req.finished_at = now
         self.cache.free(slot)
         self.slots[slot] = None
+        self.sampler.release(slot)
+        self._slot_params[slot] = None
         self.finished.append(req)
         if state == "timeout":
             self._stat["timeouts"].inc()
@@ -1095,19 +1317,25 @@ class ServingEngine:
             "finish", rid=req.rid, step=self._step_clock, slot=slot,
             state=state, generated=len(req.out))
 
-    def _emit(self, slot: int, req: ServeRequest, logits, now: float) -> None:
-        """Sample one token from last-position ``logits`` and emit it."""
-        self._rng, r = jax.random.split(self._rng)
-        tok = int(np.asarray(self.engine._sample(
-            logits, r, self.temperature, self.top_k))[0])
+    def _emit_sampled(self, slot: int, req: ServeRequest, tok: int,
+                      lp: Optional[float], now: float) -> None:
+        """Emit one token the fused sampler (or the spec verify)
+        already chose: record its logprob, feed the repetition-penalty
+        seen mask, count sampled lanes, then run the shared terminal-
+        state bookkeeping."""
+        self.sampler.observe(slot, tok)
+        if req.logprobs and lp is not None:
+            req.out_logprobs.append(float(lp))
+        if self.sampler.temps[slot] > 0.0:
+            self._stat["sampled_tokens"].inc()
         self._emit_token(slot, req, tok, now)
 
     def _emit_token(self, slot: int, req: ServeRequest, tok: int,
                     now: float) -> None:
         """Record one emitted token: output list, latency stamps,
-        TTFT/TPOT histograms, terminal-state check (max_new/eos). The
-        speculative path calls this directly — its tokens are already
-        the target's greedy choices, so there is nothing to sample."""
+        TTFT/TPOT histograms, terminal-state check (stop sequence,
+        max_new, eos). Tokens arrive already chosen — by the fused
+        in-program sampler or by the speculative verify."""
         prev = req.token_times[-1] if req.token_times else None
         req.out.append(tok)
         req.token_times.append(now)
@@ -1119,6 +1347,16 @@ class ServingEngine:
                 "first_token", rid=req.rid, step=self._step_clock, slot=slot)
         elif self._h_tpot is not None and prev is not None:
             self._h_tpot.observe(max(0.0, now - prev))
+        if req.stop:
+            for s in req.stop:
+                ls = len(s)
+                if ls and len(req.out) >= ls \
+                        and req.out[-ls:] == [int(t) for t in s]:
+                    # matched stop tokens stay IN out: the resume/drain
+                    # contract replays the true emitted stream
+                    self._stat["stop_hits"].inc()
+                    self._finish(slot, req, now)
+                    return
         if (len(req.out) >= req.max_new_tokens
                 or (req.eos_id is not None and tok == req.eos_id)):
             self._finish(slot, req, now)
@@ -1162,4 +1400,6 @@ class ServingEngine:
             generated=len(req.out))
         self.cache.free(slot)
         self.slots[slot] = None
+        self.sampler.release(slot)
+        self._slot_params[slot] = None
         self.queue.appendleft(req)

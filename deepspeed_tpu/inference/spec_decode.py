@@ -4,8 +4,10 @@ The serving scheduler (inference/serving.py, ``spec_decode=True`` /
 ``DS_SPEC_DECODE=on``) asks a DRAFTER for ``k`` candidate tokens per
 active slot each step, then verifies all ``k+1`` positions in one
 engine program (``InferenceEngine.verify_slots``) and accepts the
-longest prefix agreeing with the target's own greedy choice — so the
-drafter affects LATENCY only, never output (docs/SPECULATIVE.md).
+longest surviving prefix — greedy-target agreement for temperature=0
+slots, per-position rejection sampling for sampled slots
+(docs/SAMPLING.md) — so the drafter affects LATENCY only, never the
+output distribution (docs/SPECULATIVE.md).
 
 The drafting interface is one duck-typed method::
 

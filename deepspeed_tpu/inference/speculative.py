@@ -13,7 +13,10 @@ output. Sampled contract (temperature>0): Leviathan et al. rejection
 sampling — accept draft token x with min(1, p(x)/q(x)), resample
 rejections from norm(max(0, p-q)) — whose OUTPUT DISTRIBUTION equals
 sampling the target alone (verified against the exact two-step
-marginal in tests/test_speculative.py).
+marginal in tests/test_speculative.py). The fp64 accept/resample
+primitives live in inference/sampling.py and are SHARED with the
+continuous-batching serving verify, so static and slot speculation run
+one Leviathan implementation.
 
 The chunk-verify step is the engine's ``_extend`` program
 (inference/engine.py ``_extend_fn`` / ``_block_extend``): the decode
@@ -30,6 +33,9 @@ attention in the same step).
 
 import jax.numpy as jnp
 import numpy as np
+
+from deepspeed_tpu.inference.sampling import (accept_prob, fp64_dist,
+                                              inverse_cdf, residual_dist)
 
 
 def generate_speculative(target, draft, tokens, max_new_tokens: int = 32,
@@ -69,28 +75,19 @@ def generate_speculative(target, draft, tokens, max_new_tokens: int = 32,
 
     def dist(logits):
         """[.., V] logits -> fp64 probabilities at `temperature`
-        (optionally top_k-truncated, matching generate()'s sampler)."""
-        z = np.asarray(logits, np.float64) / temperature
-        if top_k > 0:
-            k_eff = min(top_k, z.shape[-1])   # match generate()'s clamp
-            kth = np.sort(z, axis=-1)[..., -k_eff, None]
-            z = np.where(z < kth, -np.inf, z)
-        z = z - z.max(-1, keepdims=True)
-        e = np.exp(z)
-        return e / e.sum(-1, keepdims=True)
+        (optionally top_k-truncated, matching generate()'s sampler) —
+        the shared Leviathan primitive (inference/sampling.py)."""
+        return fp64_dist(logits, temperature, top_k=top_k)
 
     V = target.cfg.vocab_size
 
     def draw(p):
-        """Sample one token per row from [B, V] probabilities (clamped:
-        fp rounding can leave cumsum[-1] < 1 and u above it)."""
-        c = np.cumsum(p, axis=-1)
-        u = rng.random((p.shape[0], 1))
-        return np.minimum((u > c).sum(-1), V - 1).astype(np.int32)
+        """Sample one token per row from [B, V] probabilities."""
+        return inverse_cdf(p, rng.random((p.shape[0], 1))).astype(np.int32)
 
     def draw1(p):
         """One sample from a [V] probability vector."""
-        return int(min((rng.random() > np.cumsum(p)).sum(), V - 1))
+        return int(inverse_cdf(p, rng.random()))
 
     t_logits, t_cache = target._prefill(target.params, jnp.asarray(tokens))
     d_logits, d_cache = draft._prefill(draft.params, jnp.asarray(tokens))
@@ -143,8 +140,7 @@ def generate_speculative(target, draft, tokens, max_new_tokens: int = 32,
             for i in range(g):
                 px = p_dists[rows, i, proposal[:, i]]
                 qx = q_dists[i][rows, proposal[:, i]]
-                accept[:, i] = rng.random(B) < np.minimum(
-                    1.0, px / np.maximum(qx, 1e-300))
+                accept[:, i] = rng.random(B) < accept_prob(px, qx)
             first_bad = np.argmin(
                 np.concatenate([accept, np.zeros((B, 1), bool)], axis=1),
                 axis=1)
@@ -161,12 +157,8 @@ def generate_speculative(target, draft, tokens, max_new_tokens: int = 32,
                 elif first_bad[b] == n_acc:
                     # a genuine rejection at this position: resample
                     # from the residual norm(max(0, p - q))
-                    res = np.maximum(
-                        0.0, p_dists[b, n_acc] - q_dists[n_acc][b])
-                    tot = res.sum()
-                    p_b = (res / tot if tot > 0
-                           else p_dists[b, n_acc])
-                    nxt[b] = draw1(p_b)
+                    nxt[b] = draw1(residual_dist(p_dists[b, n_acc],
+                                                 q_dists[n_acc][b]))
                 else:
                     # this row ACCEPTED the draft token at the lockstep
                     # cut — it must be emitted as-is (a fresh sample

@@ -38,13 +38,14 @@ from deepspeed_tpu.telemetry.breakdown import (NoopBreakdown, PHASES,
 from deepspeed_tpu.telemetry.metrics import (Counter, DEFAULT_BUCKETS,
                                              Gauge, Histogram,
                                              MetricsRegistry,
-                                             RATE_BUCKETS)
+                                             RATE_BUCKETS, TEMP_BUCKETS)
 from deepspeed_tpu.telemetry.tracer import NoopTracer, RequestTracer
 
 __all__ = ["Telemetry", "NoopTelemetry", "NOOP", "resolve_telemetry",
            "MetricsRegistry", "Counter", "Gauge", "Histogram",
            "RequestTracer", "NoopTracer", "StepBreakdown",
-           "NoopBreakdown", "PHASES", "DEFAULT_BUCKETS", "RATE_BUCKETS"]
+           "NoopBreakdown", "PHASES", "DEFAULT_BUCKETS", "RATE_BUCKETS",
+           "TEMP_BUCKETS"]
 
 
 def resolve_telemetry(flag: Optional[bool] = None) -> bool:

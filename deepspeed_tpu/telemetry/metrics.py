@@ -40,6 +40,12 @@ DEFAULT_BUCKETS: Tuple[float, ...] = (
 RATE_BUCKETS: Tuple[float, ...] = tuple(
     round(0.05 * i, 2) for i in range(1, 21))
 
+# ladder for sampling-temperature histograms: a 0.0 bucket isolates
+# greedy traffic, then 0.1-wide steps over the practical (0, 2] range
+# (anything hotter lands in +Inf — it is noise-temperature anyway)
+TEMP_BUCKETS: Tuple[float, ...] = (0.0,) + tuple(
+    round(0.1 * i, 1) for i in range(1, 21))
+
 
 def _fmt(v) -> str:
     """Prometheus sample formatting: integral values render without the

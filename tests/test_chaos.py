@@ -236,8 +236,11 @@ def test_watchdog_degraded_error_keeps_everything(eng):
     ref2 = _solo_refs(eng, [p2], 3)[0]
     with faults_lib.injected(
             Fault("serving.decode", "slow", step=4, count=2, param=0.05)):
+        # 10ms budget: well above a normal decode dispatch (which now
+        # includes the fused in-program sampler), well below the 50ms
+        # injected slow fault — same calibration as the drain tests
         srv = ServingEngine(eng, num_slots=2, block_size=4, num_blocks=24,
-                            step_time_budget_s=0.005, watchdog_grace=2,
+                            step_time_budget_s=0.01, watchdog_grace=2,
                             spec_decode=False)
         with pytest.raises(DegradedError, match="over budget") as ei:
             srv.run([ServeRequest(rid="a", prompt=p1, max_new_tokens=12),

@@ -2,7 +2,7 @@
 deepspeed_tpu/utils/compile_guard.py).
 
 Three layers:
-  1. per-rule fixtures — for every rule DS001–DS009 one true-positive
+  1. per-rule fixtures — for every rule DS001–DS010 one true-positive
      snippet that MUST flag and one clean snippet that MUST NOT (the
      clean twin pins the rule's precision, not just its recall);
   2. machinery — inline suppressions, file-level waivers, the baseline
@@ -298,6 +298,48 @@ def test_ds009_scoped_to_checkpoint_paths_and_pointer_files():
         read, path="deepspeed_tpu/runtime/checkpointing.py")
 
 
+def test_ds010_unseeded_randomness_in_inference():
+    bad = (
+        "import numpy as np\n"
+        "def pick(logits):\n"
+        "    return int(np.random.randint(0, logits.shape[-1]))\n")
+    assert "DS010" in rules_of(bad, path="deepspeed_tpu/inference/x.py")
+    bad_key = (
+        "import time, jax\n"
+        "def fresh_key():\n"
+        "    return jax.random.PRNGKey(int(time.time()))\n")
+    assert "DS010" in rules_of(bad_key, path="deepspeed_tpu/inference/x.py")
+    bad_rs = (
+        "import numpy as np\n"
+        "def rng():\n"
+        "    return np.random.RandomState()\n")
+    assert "DS010" in rules_of(bad_rs, path="deepspeed_tpu/inference/x.py")
+    # the sanctioned shapes: explicit-seed Generator constructions and
+    # counter-based bit generators (the sampling key-chain idiom)
+    good = (
+        "import numpy as np\n"
+        "import jax\n"
+        "def draws(seed, pos):\n"
+        "    g = np.random.Generator(np.random.Philox(\n"
+        "        key=[np.uint64(seed), np.uint64(pos)]))\n"
+        "    s = np.random.SeedSequence([seed, 1]).generate_state(1)[0]\n"
+        "    r = np.random.default_rng(seed)\n"
+        "    k = jax.random.PRNGKey(int(s))\n"
+        "    return g.random(2), r.integers(0, 4), k\n")
+    assert "DS010" not in rules_of(good, path="deepspeed_tpu/inference/x.py")
+
+
+def test_ds010_scoped_to_inference_layer():
+    # training/data code may want ambient seeding — not this rule's beat
+    src = (
+        "import numpy as np\n"
+        "def shuffle(xs):\n"
+        "    np.random.shuffle(xs)\n"
+        "    return xs\n")
+    assert "DS010" not in rules_of(src, path="deepspeed_tpu/runtime/data.py")
+    assert "DS010" in rules_of(src, path="deepspeed_tpu/inference/data.py")
+
+
 def test_ds000_syntax_error_is_a_finding_not_a_crash():
     findings = analyze_source("def f(:\n", path="m.py")
     assert [f.rule for f in findings] == ["DS000"]
@@ -403,8 +445,8 @@ def test_every_rule_has_id_and_rationale():
     cat = rule_catalog()
     ids = [r["id"] for r in cat]
     assert ids == sorted(ids) and len(set(ids)) == len(ids)
-    assert {"DS001", "DS002", "DS003", "DS004", "DS005",
-            "DS006", "DS007", "DS008", "DS009"} <= set(ids)
+    assert {"DS001", "DS002", "DS003", "DS004", "DS005", "DS006",
+            "DS007", "DS008", "DS009", "DS010"} <= set(ids)
     assert all(r["rationale"] for r in cat)
     assert len(default_rules()) == len(cat)
 

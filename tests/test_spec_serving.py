@@ -97,11 +97,23 @@ def test_spec_knob_resolution(monkeypatch):
         make_draft(object())
 
 
-def test_spec_requires_greedy(devices):
+def test_spec_accepts_sampled_requests(devices):
+    """The historical greedy-only guard is gone: spec decode with
+    temperature>0 constructs and drains via rejection-sampling verify,
+    and the same config at the same seed is deterministic (the verify
+    uniforms are counter-based Philox(seed, position) — no sequential
+    state to drift)."""
     cfg, params = tiny()
     eng = InferenceEngine(config=cfg, params=params, dtype=jnp.float32)
-    with pytest.raises(ValueError, match="greedy-only"):
-        ServingEngine(eng, spec_decode=True, temperature=0.7)
+    runs = []
+    for _ in range(2):
+        srv = ServingEngine(eng, num_slots=2, block_size=4, num_blocks=24,
+                            spec_decode=True, spec_k=3, temperature=0.7)
+        runs.append(srv.run([
+            ServeRequest(rid=0, prompt=p, max_new_tokens=6, seed=11)
+            for i, p in enumerate(prompts_of((9,)))]))
+        assert srv.stats["spec_steps"] > 0
+    assert np.array_equal(runs[0][0], runs[1][0])
 
 
 # ---------------------------------------------------------------------------

@@ -87,6 +87,30 @@ def test_speculative_llama_dialect(devices):
     np.testing.assert_array_equal(got, ref)
 
 
+def test_sampled_path_tokens_pinned_across_refactor(devices):
+    """Parity pin for the accept/resample dedup: moving the fp64
+    Leviathan math into inference/sampling.py left the static sampled
+    path bit-for-bit unchanged. The golden token ids below were
+    captured from the pre-refactor implementation; any drift in the
+    dist/accept/residual arithmetic shows up here as a token change."""
+    target, draft = _engines()
+    toks = np.random.default_rng(0).integers(0, 128, (2, 7)).astype(np.int32)
+    goldens = {
+        (0.9, 0, 7, 3): [[79, 67, 69, 100, 126, 117, 66, 31, 24, 111],
+                         [114, 29, 127, 79, 27, 80, 63, 1, 87, 66]],
+        (0.7, 8, 11, 4): [[9, 107, 107, 20, 92, 20, 20, 20, 97, 97],
+                          [61, 57, 20, 4, 20, 81, 50, 74, 6, 85]],
+    }
+    for (temp, top_k, seed, gamma), want in goldens.items():
+        got = generate_speculative(target, draft, toks, max_new_tokens=10,
+                                   gamma=gamma, temperature=temp,
+                                   top_k=top_k, seed=seed)
+        np.testing.assert_array_equal(
+            got[:, 7:], np.asarray(want, np.int32),
+            err_msg=f"sampled static path drifted at temp={temp} "
+                    f"top_k={top_k} seed={seed} gamma={gamma}")
+
+
 def test_sampled_identical_engines_always_accept(devices):
     """p == q makes the acceptance probability exactly 1: sampled
     speculation with draft == target accepts every proposal."""

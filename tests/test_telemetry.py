@@ -268,7 +268,10 @@ def test_stats_view_read_only_and_registry_backed(eng):
         "steps", "occupancy_sum", "peak_occupancy", "evictions",
         "admitted", "completed", "prefill_chunks", "decode_steps",
         "timeouts", "shed", "retries", "evict_capped", "watchdog_trips",
-        "backpressure", "prefix_hits", "prefix_tokens_saved"}
+        "backpressure", "prefix_hits", "prefix_tokens_saved",
+        "spec_steps", "spec_slot_steps", "spec_proposed",
+        "spec_accepted", "spec_emitted", "spec_fallbacks",
+        "sampled_tokens", "stop_hits", "spec_k_capped"}
     with pytest.raises(TypeError):
         srv.stats["steps"] = 99          # read-only view
     # the registry is the writable surface

@@ -1,4 +1,4 @@
-"""dslint rules: the JAX/TPU-specific checks (DS001–DS009).
+"""dslint rules: the JAX/TPU-specific checks (DS001–DS010).
 
 Each rule encodes an invariant the runtime actually depends on (see
 docs/LINT.md for rationale and before/after examples):
@@ -15,6 +15,8 @@ DS007  mutable default argument
 DS008  jnp./device work executed at module import scope
 DS009  pointer/marker file in a checkpoint path replaced with a plain
        in-place write instead of tmp + fsync + os.replace
+DS010  unseeded randomness in the inference layer (process-global
+       np.random draws, jax PRNGKeys derived from time/os entropy)
 
 All heuristics are deliberately lexical (pure ``ast``): they can't see
 through aliases or cross-module calls, so each rule favors precision on
@@ -827,12 +829,78 @@ class NonAtomicPointerWrite(Rule):
 
 
 # --------------------------------------------------------------------------
+class UnseededRandomness(Rule):
+    id = "DS010"
+    name = "unseeded-randomness"
+    autofixable = False
+    rationale = ("the inference layer's reproducibility contracts "
+                 "(per-request key chains, evict/requeue and router-drain "
+                 "bit-parity, spec-verify replay) all assume every random "
+                 "draw is a pure function of an explicit seed; a "
+                 "process-global np.random draw or a PRNGKey minted from "
+                 "wall-clock/os entropy silently breaks replay the first "
+                 "time a request resumes on a different engine")
+
+    # only the inference layer carries the replay contracts; training
+    # scripts legitimately want ambient-seeded data order
+    _PATHS = re.compile(r"(^|/)deepspeed_tpu/inference/")
+    # explicitly-seeded numpy constructs (the sanctioned shapes)
+    _SEEDED = {"default_rng", "Generator", "SeedSequence", "Philox",
+               "PCG64", "MT19937"}
+    _ENTROPY = (["time", "time"], ["time", "time_ns"],
+                ["time", "perf_counter"], ["time", "monotonic"],
+                ["os", "urandom"], ["os", "getrandom"],
+                ["uuid", "uuid4"], ["random", "random"],
+                ["random", "randint"], ["random", "getrandbits"],
+                ["secrets", "randbits"], ["secrets", "token_bytes"])
+
+    def check(self, tree, lines, path):
+        if not self._PATHS.search(path.replace("\\", "/")):
+            return []
+        out = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _dotted(node.func)
+            if len(chain) == 3 and chain[0] in ("np", "numpy") \
+                    and chain[1] == "random":
+                tail = chain[2]
+                if tail == "RandomState":
+                    if not (node.args or node.keywords):
+                        out.append(self._f(
+                            path, node,
+                            "`np.random.RandomState()` with no seed draws "
+                            "from os entropy — pass an explicit seed (or "
+                            "use np.random.default_rng(seed))"))
+                elif tail not in self._SEEDED:
+                    out.append(self._f(
+                        path, node,
+                        f"`{'.'.join(chain)}` uses the process-global "
+                        f"numpy RNG — inference replay (evict/requeue, "
+                        f"router drain) needs an explicit "
+                        f"np.random.default_rng(seed)/Generator"))
+            elif chain[-2:] in (["random", "PRNGKey"], ["random", "key"]) \
+                    and chain[0] in ("jax", "jr"):
+                if any(isinstance(n, ast.Call)
+                       and _dotted(n.func) in self._ENTROPY
+                       for a in node.args + [kw.value
+                                             for kw in node.keywords]
+                       for n in ast.walk(a)):
+                    out.append(self._f(
+                        path, node,
+                        "`jax.random.PRNGKey` seeded from ambient entropy "
+                        "(time/os/random) — thread an explicit request or "
+                        "config seed so the key chain replays"))
+        return out
+
+
+# --------------------------------------------------------------------------
 
 def default_rules() -> List[Rule]:
     return [BlockingHostSync(), JitCacheFragmentation(), DonationHazard(),
             TracedPythonBranch(), EnvReadOutsideConfig(), OverbroadExcept(),
             MutableDefaultArg(), ImportScopeDeviceWork(),
-            NonAtomicPointerWrite()]
+            NonAtomicPointerWrite(), UnseededRandomness()]
 
 
 def rule_catalog() -> List[Dict[str, str]]:

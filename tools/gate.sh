@@ -95,6 +95,15 @@ else
     DS_KV_QUANT=int8 python -m pytest tests/test_serving.py \
         tests/test_prefix_cache.py tests/test_spec_serving.py \
         tests/test_kv_quant.py tests/test_kv_quant_serving.py -q
+    # sampled-mode smoke: the suites above exercise temperature=0
+    # requests by default, so rerun the sampling + spec suites once
+    # with speculation forced ON — this is the path where sampled
+    # requests (temperature>0) flow through the rejection-sampling
+    # verify instead of the greedy agreement rule, including the slow
+    # end-to-end distribution-losslessness check (docs/SAMPLING.md)
+    echo "gate: serving smoke (sampled, DS_SPEC_DECODE=on)"
+    DS_SPEC_DECODE=on python -m pytest tests/test_sampling.py \
+        tests/test_spec_serving.py -q
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 fi
 echo "gate: green"

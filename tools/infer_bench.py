@@ -184,7 +184,8 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
                   block_size=16, num_blocks=None, prefill_chunk=32,
                   int8=False, int8_fused=False, seed=0, decode_impl=None,
                   prefix_cache=None, shared_prefix_len=0,
-                  spec_decode=None, spec_k=None, kv_quant=None, emit=True):
+                  spec_decode=None, spec_k=None, kv_quant=None,
+                  temperature=0.0, top_p=1.0, sample_seed=0, emit=True):
     """Continuous-batching serving row: synthetic Poisson arrivals driven
     through ServingEngine.step, wall-clock tokens/s, TTFT/TPOT latency
     percentiles from the telemetry registry's histograms, decode-slot
@@ -219,6 +220,13 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
     ``slots_admittable`` reports how many decode slots the unquantized
     pool's HBM budget admits at the row's pool layout — the capacity-
     per-chip headline (~2x for int8 over bf16).
+
+    ``temperature``/``top_p`` > defaults turn the drive into a SAMPLED
+    workload (every request seeded ``sample_seed + rid``, so a row is
+    reproducible run-to-run); rows report ``sampled``/``temperature``/
+    ``top_p`` plus the ``sampled_tokens`` counter, and the fused
+    in-program sampler keeps the compile/latency profile of the greedy
+    drive (docs/SAMPLING.md).
     """
     from deepspeed_tpu.models import gpt
     import deepspeed_tpu
@@ -269,7 +277,9 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
             else np.concatenate([sys_prompt, tail])
 
     reqs = [ServeRequest(rid=i, prompt=mk_prompt(),
-                         max_new_tokens=new_tokens)
+                         max_new_tokens=new_tokens,
+                         temperature=temperature, top_p=top_p,
+                         seed=sample_seed + i)
             for i in range(num_requests)]
 
     # warmup: compile both slot programs before the timed drive
@@ -365,6 +375,12 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
         # (the speedup factor — 1.0 exactly when speculation is off);
         # ms_per_token is the TPOT histogram mean, the wall-clock the
         # acceptance actually buys down
+        # sampling columns: whether the drive sampled (temperature>0),
+        # the knobs, and how many emitted tokens came off sampled lanes
+        "sampled": temperature > 0.0,
+        "temperature": temperature,
+        "top_p": top_p,
+        "sampled_tokens": st["sampled_tokens"],
         "spec_decode": bool(srv.spec_decode),
         "spec_k": srv.spec_k if srv.spec_decode else 0,
         "decode_steps": st["decode_steps"],
@@ -455,6 +471,41 @@ def bench_serving_spec_compare(name, **kw):
         "ms_per_token_on": on["ms_per_token"],
         "tokens_per_s_off": off["tokens_per_s"],
         "tokens_per_s_on": on["tokens_per_s"],
+    }), flush=True)
+
+
+def bench_serving_sampling_compare(name, temperature=0.9, top_p=0.95,
+                                   **kw):
+    """The same serving drive greedy, sampled, and sampled with
+    speculative decoding on. Three contracts in one row: the sampled
+    drive replays bit-identically under the same per-request seeds
+    (the key chain is pure data), the sampled-spec drive routes
+    drafted slots through the rejection-sampling verify (accept_rate
+    reports how often the target agreed — 0 when the prompt-lookup
+    drafter finds nothing to propose in the workload), and the greedy
+    row pins the latency baseline the fused in-program sampler must
+    not regress."""
+    greedy = bench_serving(f"{name}[greedy]", spec_decode=False, **kw)
+    sampled = bench_serving(f"{name}[sampled]", temperature=temperature,
+                            top_p=top_p, spec_decode=False, **kw)
+    replay = bench_serving(f"{name}[sampled-replay]", emit=False,
+                           temperature=temperature, top_p=top_p,
+                           spec_decode=False, **kw)
+    spec = bench_serving(f"{name}[sampled+spec]", temperature=temperature,
+                         top_p=top_p, spec_decode=True, **kw)
+    print(json.dumps({
+        "config": name, "preset": greedy["preset"],
+        "sampling": "greedy-vs-sampled-vs-sampled+spec",
+        "temperature": temperature, "top_p": top_p,
+        "sampled_replay_identical": sampled["_results"] == replay["_results"],
+        "sampled_tokens": sampled["sampled_tokens"],
+        "spec_accept_rate": spec["accept_rate"],
+        "spec_tokens_per_step": spec["tokens_per_step"],
+        "tokens_per_s_greedy": greedy["tokens_per_s"],
+        "tokens_per_s_sampled": sampled["tokens_per_s"],
+        "tokens_per_s_sampled_spec": spec["tokens_per_s"],
+        "ms_per_token_greedy": greedy["ms_per_token"],
+        "ms_per_token_sampled": sampled["ms_per_token"],
     }), flush=True)
 
 
@@ -675,6 +726,18 @@ SERVE_COMPARE_CONFIGS = [
         mode="kvquant", preset="gpt2-medium", num_requests=32,
         mean_gap_steps=1.5, prompt_lens=(64, 384), new_tokens=64,
         num_slots=8, block_size=16, prefill_chunk=128)),
+    # per-request sampling: greedy vs sampled vs sampled+spec over one
+    # drive — the sampled row must replay bit-identically under its
+    # fixed per-request seeds, and the sampled-spec row must keep a
+    # nonzero accept_rate through the rejection-sampling verify
+    ("serve-sampling-smoke", dict(mode="sampling", num_requests=8,
+                                  mean_gap_steps=2.0, prompt_lens=(6, 20),
+                                  new_tokens=12, num_slots=2, block_size=8,
+                                  prefill_chunk=16)),
+    ("serve-sampling-gpt2-medium", dict(
+        mode="sampling", preset="gpt2-medium", num_requests=32,
+        mean_gap_steps=1.5, prompt_lens=(64, 384), new_tokens=64,
+        num_slots=8, block_size=16, prefill_chunk=128)),
     # replica-fleet router availability: the same requests through one
     # undisturbed engine vs a 3-replica fleet with one replica crash-
     # killed mid-run — drained work must land on survivors with
@@ -726,6 +789,7 @@ def main():
                    "spec": bench_serving_spec_compare,
                    "kvquant": bench_serving_kvquant_compare,
                    "router": bench_serving_router_compare,
+                   "sampling": bench_serving_sampling_compare,
                    }.get(mode, bench_serving_impl_compare)
         try:
             compare(name, **kw)
