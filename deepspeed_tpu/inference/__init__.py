@@ -1,7 +1,9 @@
+from deepspeed_tpu.inference.autoscale import SLOController
 from deepspeed_tpu.inference.paged_cache import CacheExhausted, PagedKVCache
 from deepspeed_tpu.inference.router import ReplicaRouter
 from deepspeed_tpu.inference.serving import (DegradedError, ServeRequest,
                                              ServingEngine)
 
 __all__ = ["CacheExhausted", "DegradedError", "PagedKVCache",
-           "ReplicaRouter", "ServeRequest", "ServingEngine"]
+           "ReplicaRouter", "SLOController", "ServeRequest",
+           "ServingEngine"]

@@ -68,10 +68,26 @@ the fleet holds the serving compile contract (2 programs + 1 spec
 
 **Telemetry** (docs/OBSERVABILITY.md): ``router_*`` metrics — per-
 replica health gauges (``router_replica_health_r<i>``: 0 healthy /
-1 suspect / 2 broken / 3 recovering), ``router_drained_requests``,
+1 suspect / 2 broken / 3 recovering / 4 retired), replicas-by-state
+gauges (``router_replicas_<state>``), ``router_drained_requests``,
 ``router_breaker_trips``, a ``router_dispatch_queue_wait`` histogram —
-plus ``dispatch`` / ``drain`` / ``breaker`` / ``restart`` tracer
-events in the same timeline as the replicas' request lifecycles.
+plus ``dispatch`` / ``drain`` / ``breaker`` / ``restart`` / ``scale``
+tracer events in the same timeline as the replicas' request
+lifecycles. :meth:`ReplicaRouter.fleet_snapshot` and the router's
+:meth:`~ReplicaRouter.to_prometheus` merge every distinct registry in
+the fleet (``telemetry.metrics.merge_registries``) into one view.
+
+**Elasticity**: the fleet is no longer fixed-size. :meth:`add_replica`
+grows it (via an explicit engine or ``replica_factory`` warm-started
+from the newest valid checkpoint tag); :meth:`retire_replica` drains a
+replica's in-flight work onto survivors through the SAME snapshot path
+a breaker drain uses and parks it ``retired`` (terminal: never stepped,
+never dispatched to). An optional ``autoscale`` controller
+(:class:`~deepspeed_tpu.inference.autoscale.SLOController`) is ticked
+once per :meth:`step` and drives both actuators plus the
+``shed_batch`` admission gate from windowed fleet metrics — default
+``None``, in which case router behavior is bit-identical to the
+fixed-fleet shape (docs/OBSERVABILITY.md).
 """
 
 import time
@@ -85,15 +101,19 @@ from deepspeed_tpu.inference.serving import (DegradedError, ServeRequest,
 from deepspeed_tpu.runtime.checkpointing import (get_latest_tag, list_tags,
                                                  validate_tag)
 from deepspeed_tpu.telemetry import (NOOP, MetricsRegistry, NoopTelemetry,
-                                     Telemetry, resolve_telemetry)
+                                     Telemetry, merge_registries,
+                                     resolve_telemetry)
 from deepspeed_tpu.utils import faults as faults_lib
 from deepspeed_tpu.utils.faults import InjectedCrash, TransientDeviceError
 from deepspeed_tpu.utils.logging import logger
 
-# health states, in escalation order; gauge codes are the indices
-HEALTHY, SUSPECT, BROKEN, RECOVERING = ("healthy", "suspect", "broken",
-                                        "recovering")
-HEALTH_CODES = {HEALTHY: 0, SUSPECT: 1, BROKEN: 2, RECOVERING: 3}
+# health states, in escalation order; gauge codes are the indices.
+# RETIRED is terminal and reachable only through retire_replica (scale-
+# down) — unlike BROKEN it is deliberate, drained, and never restarted.
+HEALTHY, SUSPECT, BROKEN, RECOVERING, RETIRED = (
+    "healthy", "suspect", "broken", "recovering", "retired")
+HEALTH_CODES = {HEALTHY: 0, SUSPECT: 1, BROKEN: 2, RECOVERING: 3,
+                RETIRED: 4}
 
 _ROUTER_STAT_FIELDS = (
     ("steps", "c", "router scheduler iterations"),
@@ -106,6 +126,10 @@ _ROUTER_STAT_FIELDS = (
     ("restarts", "c", "replica warm restarts"),
     ("fleet_degraded", "c",
      "total-degrade events (no dispatchable replica left)"),
+    ("scale_ups", "c", "replicas added to the fleet (add_replica)"),
+    ("retires", "c", "replicas retired from the fleet (retire_replica)"),
+    ("shed", "c",
+     "requests shed router-side by the tightened-admission gate"),
 )
 
 
@@ -144,6 +168,10 @@ class ReplicaRouter:
     - ``faults`` / ``telemetry``: as on ``ServingEngine`` (pass one
       shared :class:`~deepspeed_tpu.telemetry.Telemetry` to aggregate
       fleet metrics into one registry).
+    - ``autoscale``: optional SLO controller with an
+      ``on_step(router, now)`` hook, ticked once per :meth:`step`
+      (see :mod:`deepspeed_tpu.inference.autoscale`). Default None —
+      the fixed-fleet bit-reference.
     """
 
     def __init__(self, replicas: Sequence[ServingEngine], *,
@@ -154,7 +182,8 @@ class ReplicaRouter:
                  affinity_tokens: int = 16,
                  affinity_max_imbalance: int = 4,
                  faults: Optional[faults_lib.FaultInjector] = None,
-                 telemetry=None):
+                 telemetry=None,
+                 autoscale=None):
         if not replicas:
             raise ValueError("router needs at least one replica")
         self.replicas = [_Replica(i, srv) for i, srv in enumerate(replicas)]
@@ -180,14 +209,19 @@ class ReplicaRouter:
             self._stat[key] = make(f"router_{key}", help_)
         self.stats = _StatsView(self._stat)
         # per-replica health gauges: the registry has no label support,
-        # so each replica gets its own name (scrape-stable: replica
-        # count is fixed for the router's lifetime)
-        self._g_health = [
-            self.metrics.gauge(
-                f"router_replica_health_r{i}",
-                "replica health (0 healthy / 1 suspect / 2 broken / "
-                "3 recovering)")
-            for i in range(len(self.replicas))]
+        # so each replica gets its own name (indices only grow —
+        # add_replica appends, retire parks the gauge at 4 — so the
+        # scrape series stay stable)
+        self._g_health = [self._mk_health_gauge(i)
+                          for i in range(len(self.replicas))]
+        # fleet-shape gauges: replicas currently in each health state,
+        # the controller's (and any scraper's) one-look fleet view
+        self._g_state = {
+            state: self.metrics.gauge(
+                f"router_replicas_{state}",
+                f"replicas currently {state}")
+            for state in HEALTH_CODES}
+        self._update_state_gauges()
         self._h_qwait = (self.metrics.histogram(
             "router_dispatch_queue_wait",
             "submit-to-(re)dispatch wait (scheduler clock units; >0 "
@@ -202,13 +236,44 @@ class ReplicaRouter:
         self._affinity: Dict[bytes, int] = {}
         self._rr = 0                             # round-robin step cursor
         self._clock = 0
+        # SLO controller hook: ticked once per step() when set; the
+        # shed_batch gate is its admission actuator (submit() sheds
+        # priority="batch" requests while tightened). Default None =
+        # the fixed-fleet bit-reference.
+        self.autoscale = autoscale
+        self.shed_batch = False
+
+    def _mk_health_gauge(self, i: int):
+        return self.metrics.gauge(
+            f"router_replica_health_r{i}",
+            "replica health (0 healthy / 1 suspect / 2 broken / "
+            "3 recovering / 4 retired)")
+
+    def _update_state_gauges(self) -> None:
+        for state, g in self._g_state.items():
+            g.set(sum(1 for rep in self.replicas if rep.health == state))
 
     # -- API -----------------------------------------------------------
     def submit(self, req: ServeRequest, now: float = 0.0) -> bool:
         """Dispatch ``req`` to the best dispatchable replica. Returns
         the target's ``submit`` result (False = shed by its bounded
-        queue). Raises a fleet-level :class:`DegradedError` when no
-        replica can take traffic."""
+        queue, or here by the tightened-admission gate). Raises a
+        fleet-level :class:`DegradedError` when no replica can take
+        traffic."""
+        if self.shed_batch and req.priority == "batch":
+            # admission tightened by the SLO controller: batch-class
+            # traffic sheds at the front door (same terminal shape as
+            # an engine-side queue-bound shed) so interactive traffic
+            # keeps the fleet's headroom
+            req.state = "shed"
+            req.finished_at = now
+            self._results.setdefault(req.rid, req.tokens)
+            self._finished.append(req)
+            self._stat["shed"].inc()
+            self.telemetry.tracer.event(
+                "shed", rid=req.rid, step=self._clock,
+                reason="admission tightened", priority=req.priority)
+            return False
         ok = self._dispatch(req, now)
         if self._orphans:
             raise self._fleet_degraded(
@@ -217,7 +282,7 @@ class ReplicaRouter:
 
     @property
     def busy(self) -> bool:
-        return any(rep.health != BROKEN and rep.srv.busy
+        return any(rep.health not in (BROKEN, RETIRED) and rep.srv.busy
                    for rep in self.replicas)
 
     def step(self, now: Optional[float] = None) -> int:
@@ -232,7 +297,7 @@ class ReplicaRouter:
         n = len(self.replicas)
         for k in range(n):
             rep = self.replicas[(self._rr + k) % n]
-            if rep.health == BROKEN or not rep.srv.busy:
+            if rep.health in (BROKEN, RETIRED) or not rep.srv.busy:
                 continue
             try:
                 self.faults.fire("router.step")
@@ -258,6 +323,11 @@ class ReplicaRouter:
             # fleet-level raise carrying every orphaned request
             raise self._fleet_degraded(
                 "no dispatchable replica left for drained work")
+        if self.autoscale is not None:
+            # controller tick AFTER the fleet stepped (so the windowed
+            # metrics include this iteration's tokens) and AFTER the
+            # orphan check (a degraded fleet raises, it doesn't scale)
+            self.autoscale.on_step(self, now)
         return occ
 
     def run(self, requests=None, max_steps: int = 1_000_000,
@@ -317,6 +387,97 @@ class ReplicaRouter:
                     f"checkpoint tag {tag!r}; recovering")
         return tag
 
+    # -- elasticity ----------------------------------------------------
+    def add_replica(self, srv: Optional[ServingEngine] = None,
+                    now: float = 0.0, reason: str = "") -> int:
+        """Grow the fleet by one replica and return its index. With no
+        explicit engine the replica comes from ``replica_factory``,
+        warm-started from the newest valid checkpoint tag (the same
+        walk-back :meth:`restart_replica` uses). The newcomer joins
+        ``healthy`` and is immediately dispatchable; sharing the
+        fleet's ``InferenceEngine`` means it shares the already-
+        compiled programs, so scale-up compiles nothing."""
+        idx = len(self.replicas)
+        if srv is None:
+            if self.replica_factory is None:
+                raise RuntimeError(
+                    "add_replica needs an engine or a replica_factory")
+            srv = self.replica_factory(idx, self._restart_tag())
+        self.replicas.append(_Replica(idx, srv))
+        self._g_health.append(self._mk_health_gauge(idx))
+        self._g_health[idx].set(HEALTH_CODES[HEALTHY])
+        self._update_state_gauges()
+        self._stat["scale_ups"].inc()
+        self.telemetry.tracer.event(
+            "scale", step=self._clock, action="add", replica=idx,
+            reason=reason)
+        logger.info(f"router: replica {idx} added ({reason or 'manual'})")
+        return idx
+
+    def retire_replica(self, idx: int, now: float = 0.0,
+                       reason: str = "") -> int:
+        """Scale-down: permanently remove replica ``idx`` from
+        rotation. Its in-flight work drains onto survivors through the
+        SAME snapshot/release path a breaker drain uses (so retiring a
+        busy replica is token-lossless), then the replica parks
+        ``retired`` — never stepped, never dispatched to, never
+        restarted. Refuses to retire the last replica able to take
+        traffic. Returns the number of requests drained across."""
+        rep = self.replicas[idx]
+        if rep.health == RETIRED:
+            raise ValueError(f"replica {idx} is already retired")
+        survivors = [r for r in self.replicas
+                     if r.idx != idx and r.health not in (BROKEN, RETIRED)]
+        if not survivors:
+            raise ValueError(
+                "cannot retire the last dispatchable replica")
+        self._set_health(rep, RETIRED, now, reason=reason or "scale-down")
+        placed = self._drain(rep, now)
+        self._stat["retires"].inc()
+        self.telemetry.tracer.event(
+            "scale", step=self._clock, action="retire", replica=idx,
+            reason=reason, resumed=placed)
+        logger.info(f"router: replica {idx} retired "
+                    f"({reason or 'manual'}; {placed} drained)")
+        if self._orphans:
+            raise self._fleet_degraded(
+                f"no dispatchable replica for work drained off "
+                f"retired replica {idx}")
+        return placed
+
+    # -- fleet observability -------------------------------------------
+    def fleet_registries(self) -> List[MetricsRegistry]:
+        """Every distinct metrics registry in the fleet (router +
+        replicas), deduped by identity — replicas sharing one
+        ``Telemetry`` contribute their registry once."""
+        regs: List[MetricsRegistry] = []
+        seen: Set[int] = set()
+        for reg in [self.metrics] + [rep.srv.metrics
+                                     for rep in self.replicas]:
+            if id(reg) not in seen:
+                seen.add(id(reg))
+                regs.append(reg)
+        return regs
+
+    def fleet_snapshot(self) -> Dict[str, Dict]:
+        """Fleet-merged registry snapshot (counters/gauges summed,
+        histograms bucket-merged across replicas) plus the fleet shape:
+        per-replica health and replicas-by-state counts."""
+        snap = merge_registries(self.fleet_registries()).snapshot()
+        health = self.health()
+        snap["fleet"] = {
+            "replicas": len(health),
+            "health": health,
+            "by_state": {state: health.count(state)
+                         for state in HEALTH_CODES},
+        }
+        return snap
+
+    def to_prometheus(self) -> str:
+        """Merged Prometheus text exposition across every registry in
+        the fleet — one scrape body for the whole deployment."""
+        return merge_registries(self.fleet_registries()).to_prometheus()
+
     # -- dispatch ------------------------------------------------------
     def _affinity_key(self, prompt) -> Optional[bytes]:
         if len(prompt) == 0:
@@ -329,7 +490,7 @@ class ReplicaRouter:
         return len(srv.queue) + sum(1 for s in srv.slots if s is not None)
 
     def _dispatchable(self, rep: _Replica) -> bool:
-        if rep.health == BROKEN:
+        if rep.health in (BROKEN, RETIRED):
             return False
         if rep.health == RECOVERING:
             # half-open: a recovering replica holds at most
@@ -385,7 +546,8 @@ class ReplicaRouter:
                 self._drain(rep, now)
                 continue
             if self._h_qwait is not None and req.submitted_at is not None:
-                self._h_qwait.observe(max(0.0, now - req.submitted_at))
+                self._h_qwait.observe(max(0.0, now - req.submitted_at),
+                                      at=now)
             ok = rep.srv.submit(req, now=now)
             key = self._affinity_key(req.prompt)
             if ok and key is not None:
@@ -406,6 +568,7 @@ class ReplicaRouter:
             return
         prev, rep.health = rep.health, state
         self._g_health[rep.idx].set(HEALTH_CODES[state])
+        self._update_state_gauges()
         self.telemetry.tracer.event(
             "breaker", step=self._clock, replica=rep.idx,
             state=state, prev=prev, reason=reason)
@@ -515,7 +678,7 @@ class ReplicaRouter:
         merged = self.results()
         pending = [snapshot_entry(r) for r in orphans]
         for rep in self.replicas:
-            if rep.health != BROKEN:
+            if rep.health not in (BROKEN, RETIRED):
                 pending.extend(
                     s for s in rep.srv.pending_snapshot()
                     if s["rid"] not in merged)

@@ -232,12 +232,18 @@ class ServeRequest:
     ``out_logprobs``. ``n>1`` expands at submit into ``n`` independent
     candidates (rids ``rid#1``..``rid#n-1`` plus the original) whose
     seeds derive from this request's seed via
-    :func:`sampling.candidate_seed`."""
+    :func:`sampling.candidate_seed`.
+
+    ``priority`` is an advisory class tag (``"interactive"`` /
+    ``"batch"``; None = untagged) the engine itself ignores — the
+    router's SLO controller sheds ``batch`` traffic first when
+    admission tightens (docs/OBSERVABILITY.md)."""
     rid: Any
     prompt: np.ndarray
     max_new_tokens: int = 32
     eos_id: Optional[int] = None
     deadline: Optional[float] = None
+    priority: Optional[str] = None
     temperature: Optional[float] = None
     top_k: Optional[int] = None
     top_p: Optional[float] = None
@@ -281,6 +287,7 @@ class ServeRequest:
             max_new_tokens=int(entry["max_new_tokens"]),
             eos_id=entry.get("eos_id"),
             deadline=entry.get("deadline"),
+            priority=entry.get("priority"),
             temperature=entry.get("temperature"),
             top_k=entry.get("top_k"),
             top_p=entry.get("top_p"),
@@ -330,6 +337,7 @@ def snapshot_entry(req: ServeRequest, **extra) -> Dict:
              "max_new_tokens": req.max_new_tokens,
              "eos_id": req.eos_id,
              "deadline": req.deadline,
+             "priority": req.priority,
              # sampling state: the per-token key is a pure function of
              # (seed, len(out)), so these fields ARE the key-chain state
              # a drain/resume needs (docs/SAMPLING.md)
@@ -861,7 +869,8 @@ class ServingEngine:
                 self._h_temp.observe(params.temperature)
             self._stat["admitted"].inc()
             if self._h_qwait is not None and req.submitted_at is not None:
-                self._h_qwait.observe(max(0.0, now - req.submitted_at))
+                self._h_qwait.observe(max(0.0, now - req.submitted_at),
+                                      at=now)
             self.telemetry.tracer.event(
                 "admit", rid=req.rid, step=self._step_clock, slot=slot,
                 matched=int(matched), evictions=req.evictions)
@@ -1342,11 +1351,12 @@ class ServingEngine:
         if req.first_token_at is None:
             req.first_token_at = now
             if self._h_ttft is not None and req.submitted_at is not None:
-                self._h_ttft.observe(max(0.0, now - req.submitted_at))
+                self._h_ttft.observe(max(0.0, now - req.submitted_at),
+                                     at=now)
             self.telemetry.tracer.event(
                 "first_token", rid=req.rid, step=self._step_clock, slot=slot)
         elif self._h_tpot is not None and prev is not None:
-            self._h_tpot.observe(max(0.0, now - prev))
+            self._h_tpot.observe(max(0.0, now - prev), at=now)
         if req.stop:
             for s in req.stop:
                 ls = len(s)

@@ -38,14 +38,15 @@ from deepspeed_tpu.telemetry.breakdown import (NoopBreakdown, PHASES,
 from deepspeed_tpu.telemetry.metrics import (Counter, DEFAULT_BUCKETS,
                                              Gauge, Histogram,
                                              MetricsRegistry,
-                                             RATE_BUCKETS, TEMP_BUCKETS)
+                                             RATE_BUCKETS, TEMP_BUCKETS,
+                                             merge_registries)
 from deepspeed_tpu.telemetry.tracer import NoopTracer, RequestTracer
 
 __all__ = ["Telemetry", "NoopTelemetry", "NOOP", "resolve_telemetry",
            "MetricsRegistry", "Counter", "Gauge", "Histogram",
            "RequestTracer", "NoopTracer", "StepBreakdown",
            "NoopBreakdown", "PHASES", "DEFAULT_BUCKETS", "RATE_BUCKETS",
-           "TEMP_BUCKETS"]
+           "TEMP_BUCKETS", "merge_registries"]
 
 
 def resolve_telemetry(flag: Optional[bool] = None) -> bool:

@@ -25,6 +25,7 @@ Design constraints (docs/OBSERVABILITY.md):
 """
 
 from bisect import bisect_left
+from collections import deque
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 # log-ish ladder covering sub-millisecond wall clocks AND integer step
@@ -86,12 +87,24 @@ class Histogram:
     bound) semantics; the last bucket is the implicit ``+Inf`` overflow.
     ``percentile`` linearly interpolates inside the owning bucket and
     clamps the overflow bucket to the largest observed value, so an
-    estimate never exceeds reality."""
+    estimate never exceeds reality.
+
+    Alongside the cumulative buckets the histogram keeps a bounded ring
+    of the most recent ``(at, value)`` observations so controllers can
+    ask for "p99 over the last N clock units" (``window_summary``)
+    instead of the lifetime digest. The ring is host-side and O(1) per
+    observe; it never feeds the Prometheus exposition, which stays
+    cumulative-only."""
     __slots__ = ("name", "help", "uppers", "counts", "sum", "count",
-                 "_vmax")
+                 "_vmax", "_ring", "_seq")
+
+    #: default ring depth — enough for a few windows of serving traffic
+    #: without unbounded growth (SLO windows are tens of observations)
+    WINDOW_CAPACITY = 1024
 
     def __init__(self, name: str, help: str = "",
-                 buckets: Optional[Sequence[float]] = None):
+                 buckets: Optional[Sequence[float]] = None,
+                 window_capacity: Optional[int] = None):
         self.name = name
         self.help = help
         ups = tuple(sorted(float(b) for b in
@@ -103,14 +116,57 @@ class Histogram:
         self.sum = 0.0
         self.count = 0
         self._vmax = 0.0
+        cap = self.WINDOW_CAPACITY if window_capacity is None \
+            else int(window_capacity)
+        self._ring: deque = deque(maxlen=max(cap, 1))
+        self._seq = 0
 
-    def observe(self, v) -> None:
+    def observe(self, v, at: Optional[float] = None) -> None:
+        """Record one observation. ``at`` is the caller's clock (step
+        index or seconds); when omitted it defaults to the observation
+        sequence number so windows degrade to "last N observations"."""
         v = float(v)
         self.counts[bisect_left(self.uppers, v)] += 1
         self.sum += v
         self.count += 1
         if v > self._vmax:
             self._vmax = v
+        self._ring.append((self._seq if at is None else float(at), v))
+        self._seq += 1
+
+    def window_values(self, window: Optional[float] = None,
+                      now: Optional[float] = None) -> List[float]:
+        """Raw values from the ring with ``at >= now - window``; the
+        whole ring when ``window`` is None. ``now`` defaults to the
+        newest observation's clock, so a quiet histogram still reports
+        its latest window instead of an empty one."""
+        if not self._ring:
+            return []
+        if window is None:
+            return [v for _, v in self._ring]
+        if now is None:
+            now = self._ring[-1][0]
+        lo = now - float(window)
+        return [v for at, v in self._ring if at >= lo]
+
+    def window_summary(self, window: Optional[float] = None,
+                       now: Optional[float] = None) -> Dict[str, float]:
+        """Exact p50/p95/p99/mean over the recent-observation ring —
+        same keys as ``summary`` but computed from raw windowed values
+        (numpy-style linear interpolation) rather than bucket counts."""
+        vals = sorted(self.window_values(window, now))
+        if not vals:
+            return {"p50": 0.0, "p95": 0.0, "p99": 0.0,
+                    "mean": 0.0, "count": 0.0}
+
+        def pct(q: float) -> float:
+            rank = (q / 100.0) * (len(vals) - 1)
+            lo = int(rank)
+            hi = min(lo + 1, len(vals) - 1)
+            return vals[lo] + (vals[hi] - vals[lo]) * (rank - lo)
+
+        return {"p50": pct(50), "p95": pct(95), "p99": pct(99),
+                "mean": sum(vals) / len(vals), "count": float(len(vals))}
 
     def percentile(self, q: float) -> float:
         """Estimate the q-th percentile (q in [0, 100]) from the bucket
@@ -221,3 +277,41 @@ class MetricsRegistry:
         for n, h in self._histograms.items():
             out.append((n, h.summary(), step))
         return out
+
+
+def merge_registries(regs: Iterable["MetricsRegistry"]) -> "MetricsRegistry":
+    """Fold several per-replica registries into one fleet view.
+
+    Counters and gauges sum (the serving gauges — occupancy, queue
+    depth, blocks in use — are extensive quantities, so the fleet total
+    is the meaningful aggregate); histograms require an identical
+    bucket ladder and merge bucket-wise, with the recent-observation
+    rings interleaved by clock so ``window_summary`` on the merged
+    histogram sees the fleet's latest traffic. The inputs are left
+    untouched — this is a snapshot-style fold, safe to call every
+    controller tick."""
+    out = MetricsRegistry()
+    for reg in regs:
+        for n, c in reg._counters.items():
+            out.counter(n, c.help).inc(c.value)
+        for n, g in reg._gauges.items():
+            mg = out.gauge(n, g.help)
+            mg.set(mg.value + g.value)
+        for n, h in reg._histograms.items():
+            mh = out.histogram(n, h.help, h.uppers)
+            if mh.uppers != h.uppers:
+                raise ValueError(
+                    f"histogram {n}: bucket ladders differ across "
+                    f"replicas — fleet merge needs identical ladders")
+            for i, c in enumerate(h.counts):
+                mh.counts[i] += c
+            mh.sum += h.sum
+            mh.count += h.count
+            if h._vmax > mh._vmax:
+                mh._vmax = h._vmax
+            merged = sorted(list(mh._ring) + list(h._ring),
+                            key=lambda p: p[0])
+            mh._ring.clear()
+            mh._ring.extend(merged[-mh._ring.maxlen:])
+            mh._seq = max(mh._seq, h._seq)
+    return out

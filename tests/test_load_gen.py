@@ -1,0 +1,177 @@
+"""Load-harness tests (tentpole: tools/load_gen.py — the seeded
+request generator + drive loop behind the autoscale bench).
+
+Layers:
+  1. generation units (pure host) — determinism in the explicit seed,
+     Poisson phase structure, per-mix shape contracts (shared prefixes,
+     alphabet restriction, length bounds, priority classes);
+  2. trace replay — save/load round-trips the population byte-for-byte
+     and refuses foreign versions; the CLI writes the same artifact;
+  3. drive loop against a real engine — open mode records the full
+     per-request timestamp chain (arrival <= submitted <= first_token
+     <= finished) and recomputes SLO attainment from it; closed mode
+     never exceeds the concurrency bound.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.engine import InferenceEngine
+from deepspeed_tpu.inference.serving import ServingEngine
+from deepspeed_tpu.models import gpt
+from tools.load_gen import (MIXES, drive, load_trace, main, make_requests,
+                            poisson_arrivals, save_trace)
+
+pytestmark = pytest.mark.usefixtures("devices")
+
+
+@pytest.fixture(scope="module")
+def eng():
+    cfg = gpt.GPTConfig(vocab_size=128, n_layers=2, n_heads=4, d_model=32,
+                        max_seq_len=64, use_flash_attention=False,
+                        remat=False, dtype=jnp.float32)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    return InferenceEngine(config=cfg, params=params, dtype=jnp.float32)
+
+
+def mk_srv(eng, **kw):
+    defaults = dict(num_slots=2, block_size=4, num_blocks=24,
+                    prefill_chunk=8, spec_decode=False)
+    defaults.update(kw)
+    return ServingEngine(eng, **defaults)
+
+
+# ---------------------------------------------------------------------------
+# generation units
+# ---------------------------------------------------------------------------
+
+def test_make_requests_deterministic_in_seed():
+    """Same seed => byte-identical population (the DS010 contract
+    extended to the harness); a different seed actually differs."""
+    kw = dict(mix="chat", phases=[(10, 0.8), (5, 2.0)], vocab_size=128)
+    a = make_requests(seed=7, **kw)
+    b = make_requests(seed=7, **kw)
+    assert json.dumps(a) == json.dumps(b)
+    c = make_requests(seed=8, **kw)
+    assert json.dumps(a) != json.dumps(c)
+    # arrival order + ids are stable
+    assert [r["rid"] for r in a] == [f"chat-{i}" for i in range(len(a))]
+    assert [r["at"] for r in a] == sorted(r["at"] for r in a)
+
+
+def test_poisson_arrivals_phase_structure():
+    """Rate-0 phases are silent, a high-rate phase is denser than a
+    low-rate one, and every instant stays inside the total span."""
+    ats = poisson_arrivals([(20, 0.0), (20, 2.0), (20, 0.2)], seed=0)
+    assert ats == sorted(ats)
+    assert all(20.0 <= t < 60.0 for t in ats)
+    spike = sum(1 for t in ats if t < 40.0)
+    tail = len(ats) - spike
+    assert spike > tail              # 2.0/step vs 0.2/step over 20 steps
+    assert poisson_arrivals([(50, 0.0)], seed=0) == []
+    assert poisson_arrivals([(20, 1.0)], seed=3) \
+        == poisson_arrivals([(20, 1.0)], seed=3)
+
+
+def test_mix_shape_contracts():
+    """Each named mix honours its shape: shared prefixes are common to
+    the whole population, the repetitive mix stays inside its tiny
+    alphabet, lengths respect their (clipped) bounds, and priorities
+    are exactly the two admission classes."""
+    for mix, params in MIXES.items():
+        reqs = make_requests(seed=0, mix=mix, n=64, vocab_size=128,
+                             max_prompt_len=48)
+        assert len(reqs) == 64
+        for r in reqs:
+            assert 1 <= len(r["prompt"]) <= 48
+            assert r["max_new_tokens"] >= 1
+            assert r["priority"] in ("interactive", "batch")
+            assert r["kind"] == mix
+            assert all(1 <= t < 128 for t in r["prompt"])
+        if params["shared_prefix"]:
+            lead = reqs[0]["prompt"][:params["shared_prefix"]]
+            assert all(r["prompt"][:len(lead)] == lead for r in reqs)
+        if params["alphabet"]:
+            hi = 1 + params["alphabet"]
+            assert all(t < hi for r in reqs for t in r["prompt"])
+        batch = sum(r["priority"] == "batch" for r in reqs) / 64
+        assert abs(batch - params["batch_frac"]) < 0.25
+    with pytest.raises(ValueError):
+        make_requests(seed=0, mix="nope", n=4)
+    with pytest.raises(ValueError):
+        make_requests(seed=0, mix="chat")        # neither n nor phases
+
+
+def test_trace_round_trip(tmp_path):
+    reqs = make_requests(seed=1, mix="rag", phases=[(30, 0.5)])
+    path = save_trace(str(tmp_path / "t.json"), reqs, seed=1, mix="rag")
+    assert load_trace(path) == reqs
+    # a foreign version is refused, not silently replayed
+    body = json.load(open(path))
+    body["version"] = 99
+    json.dump(body, open(path, "w"))
+    with pytest.raises(ValueError, match="version"):
+        load_trace(path)
+
+
+def test_cli_writes_replayable_trace(tmp_path, capsys):
+    out = tmp_path / "cli.json"
+    assert main(["--seed", "3", "--mix", "chat",
+                 "--phases", "10:0.5,5:2", "--out", str(out),
+                 "--summary"]) == 0
+    digest = json.loads(capsys.readouterr().out.splitlines()[-1])
+    reqs = load_trace(str(out))
+    assert digest["requests"] == len(reqs) > 0
+    assert reqs == make_requests(seed=3, mix="chat",
+                                 phases=[(10, 0.5), (5, 2.0)])
+
+
+# ---------------------------------------------------------------------------
+# drive loop against a real engine
+# ---------------------------------------------------------------------------
+
+def test_drive_open_records_timestamp_chain(eng):
+    """Open-loop drive: every request's record carries the full
+    arrival <= submitted <= first_token <= finished chain in scheduler
+    clock units, and slo_attainment is exactly recomputable from it."""
+    entries = make_requests(seed=0, mix="chat", phases=[(12, 0.6)],
+                            vocab_size=128, max_prompt_len=20)
+    assert entries
+    res = drive(mk_srv(eng), entries, mode="open", slo_ttft=8.0)
+    assert res["requests"] == len(entries)
+    assert len(res["per_request"]) == len(entries)
+    for r in res["per_request"]:
+        assert r["state"] == "done"
+        assert r["arrival"] <= r["submitted_at"] <= r["first_token_at"] \
+            <= r["finished_at"]
+        assert r["ttft"] == r["first_token_at"] - r["submitted_at"]
+        assert r["generated"] > 0
+    ttfts = [r["ttft"] for r in res["per_request"]]
+    assert res["slo_attainment"] == pytest.approx(
+        sum(t <= 8.0 for t in ttfts) / len(entries))
+    assert res["ttft_p99"] == pytest.approx(
+        float(np.percentile(np.asarray(ttfts), 99)))
+    # the drive is deterministic: same seed + same fleet => same record
+    res2 = drive(mk_srv(eng), entries, mode="open", slo_ttft=8.0)
+    assert res2["per_request"] == res["per_request"]
+
+
+def test_drive_closed_loop_bounds_inflight(eng):
+    """Closed mode ignores arrival times and keeps at most
+    ``concurrency`` requests outstanding — provable post-hoc from the
+    recorded [submitted, finished) intervals."""
+    entries = make_requests(seed=2, mix="chat", n=10, vocab_size=128,
+                            max_prompt_len=16)
+    res = drive(mk_srv(eng), entries, mode="closed", concurrency=2)
+    recs = res["per_request"]
+    assert all(r["state"] == "done" for r in recs)
+    for t in sorted({r["submitted_at"] for r in recs}):
+        inflight = sum(1 for o in recs
+                       if o["submitted_at"] <= t < o["finished_at"])
+        assert inflight <= 2, t
+    with pytest.raises(ValueError, match="open|closed"):
+        drive(mk_srv(eng), entries, mode="sideways")

@@ -31,7 +31,7 @@ from deepspeed_tpu.models import gpt
 from deepspeed_tpu.telemetry import (Histogram, MetricsRegistry,
                                      NoopTelemetry, RequestTracer,
                                      StepBreakdown, Telemetry,
-                                     resolve_telemetry)
+                                     merge_registries, resolve_telemetry)
 from deepspeed_tpu.utils import faults as faults_lib
 from deepspeed_tpu.utils.faults import Fault
 from deepspeed_tpu.utils.monitor import Monitor
@@ -88,6 +88,83 @@ def test_histogram_bucket_math_vs_numpy():
     h2.observe(7.0)
     assert h2.counts[-1] == 2 and h2.percentile(99) == 7.0
     assert Histogram("e", buckets=(1.0,)).percentile(50) == 0.0
+
+
+def test_histogram_window_summary_vs_numpy():
+    """The windowed view (observability tentpole): ``window_summary``
+    over the recent-observation ring is EXACT against numpy's linear
+    percentile on the same sample — no bucket quantization — and the
+    time filter keeps only observations inside ``[now - window, now]``."""
+    rng = np.random.default_rng(7)
+    data = rng.uniform(0.0, 20.0, 500)
+    h = Histogram("lat")
+    for i, v in enumerate(data):
+        h.observe(v, at=float(i))
+    # whole-ring summary (window=None) == numpy on the raw sample
+    s = h.window_summary()
+    for q, key in ((50, "p50"), (95, "p95"), (99, "p99")):
+        assert s[key] == pytest.approx(np.percentile(data, q), abs=1e-12)
+    assert s["mean"] == pytest.approx(data.mean())
+    assert s["count"] == 500
+    # time-filtered: only the last 100 clock units (at >= 399)
+    tail = data[399:]
+    sw = h.window_summary(window=100.0, now=499.0)
+    assert sw["count"] == len(tail)
+    for q, key in ((50, "p50"), (95, "p95"), (99, "p99")):
+        assert sw[key] == pytest.approx(np.percentile(tail, q), abs=1e-12)
+    # ``now`` defaults to the newest observation's clock
+    assert h.window_summary(window=100.0) == sw
+    # an empty window is all-zeros, not an error
+    assert h.window_summary(window=1.0, now=1e9)["count"] == 0
+    # cumulative view is untouched by the ring
+    assert h.count == 500 and abs(h.sum - data.sum()) < 1e-6
+
+
+def test_histogram_window_ring_bounded():
+    """The ring is memory-bounded: only the most recent
+    ``window_capacity`` observations survive; without explicit ``at``
+    the observation sequence number is the clock."""
+    h = Histogram("b", window_capacity=16)
+    for i in range(100):
+        h.observe(float(i))
+    vals = h.window_values()
+    assert vals == [float(i) for i in range(84, 100)]
+    assert h.count == 100                       # cumulative still exact
+    # sequence clock: a window of 4 keeps the last 5 observations
+    # (at >= now - window, inclusive)
+    assert h.window_values(window=4) == [95.0, 96.0, 97.0, 98.0, 99.0]
+
+
+def test_merge_registries_fleet_fold():
+    """``merge_registries`` is the fleet aggregation: counters and
+    gauges sum, histograms with identical ladders merge bucket-wise and
+    interleave their rings by clock; mismatched ladders refuse."""
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.counter("serving_completed", "done").inc(3)
+    b.counter("serving_completed").inc(4)
+    b.counter("only_b").inc(1)
+    a.gauge("queue_depth").set(2)
+    b.gauge("queue_depth").set(5)
+    ha = a.histogram("serving_ttft", buckets=(1.0, 4.0))
+    hb = b.histogram("serving_ttft", buckets=(1.0, 4.0))
+    ha.observe(0.5, at=1.0)
+    ha.observe(6.0, at=3.0)
+    hb.observe(2.0, at=2.0)
+    m = merge_registries([a, b])
+    assert m.counter("serving_completed").value == 7
+    assert m.counter("only_b").value == 1
+    assert m.gauge("queue_depth").value == 7
+    hm = m.histogram("serving_ttft")
+    assert hm.count == 3 and hm.sum == pytest.approx(8.5)
+    assert list(hm.counts) == [1, 1, 1]          # (<=1, <=4, +Inf)
+    assert hm.window_values() == [0.5, 2.0, 6.0]   # clock-ordered
+    # exposition of the merged registry is ordinary cumulative text
+    assert 'serving_ttft_bucket{le="+Inf"} 3' in m.to_prometheus()
+    # ladder mismatch is a hard error, not silent garbage
+    c = MetricsRegistry()
+    c.histogram("serving_ttft", buckets=(2.0,)).observe(1.0)
+    with pytest.raises(ValueError):
+        merge_registries([a, c])
 
 
 def test_prometheus_exposition_golden():

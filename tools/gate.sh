@@ -44,7 +44,7 @@ elif [[ "${1:-}" == "quick" ]]; then
     # files by name heuristic; plus the always-on smoke set
     # (engine/config/gpt cover the load-bearing core; telemetry guards
     # the serving observability plane and its no-op contract)
-    tests="tests/test_engine.py tests/test_config.py tests/test_gpt.py tests/test_telemetry.py tests/test_spec_serving.py"
+    tests="tests/test_engine.py tests/test_config.py tests/test_gpt.py tests/test_telemetry.py tests/test_spec_serving.py tests/test_load_gen.py tests/test_autoscale.py"
     tests="$tests $(git diff --name-only --diff-filter=d HEAD -- 'tests/test_*.py' | tr '\n' ' ')"
     changed=$(git diff --name-only --diff-filter=d HEAD -- 'deepspeed_tpu/**.py' \
               | xargs -rn1 basename | sed 's/\.py$//')
@@ -104,6 +104,20 @@ else
     echo "gate: serving smoke (sampled, DS_SPEC_DECODE=on)"
     DS_SPEC_DECODE=on python -m pytest tests/test_sampling.py \
         tests/test_spec_serving.py -q
+    # closed-loop smoke: the serve-autoscale CPU row must show the SLO
+    # contrast (fixed fleet violates, policy fleet holds by scaling up)
+    # and the chaos suite must stay green with the controller ACTIVE —
+    # breaker drains and controller scale decisions compose
+    # (docs/OBSERVABILITY.md)
+    echo "gate: autoscale smoke (serve-autoscale-smoke + chaos with controller)"
+    python - <<'PYEOF'
+import json
+from tools.infer_bench import bench_serving_autoscale_compare
+res_f, res_p, policy = bench_serving_autoscale_compare("serve-autoscale-smoke")
+assert res_f["ttft_p99"] > res_p["ttft_p99"], "no SLO contrast"
+PYEOF
+    DS_FAULT_SEED=0 python -m pytest tests/test_autoscale.py \
+        tests/test_load_gen.py tests/test_router.py -q
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 fi
 echo "gate: green"

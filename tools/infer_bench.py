@@ -393,6 +393,20 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
         "ms_per_token": round(tpot_h.sum / tpot_h.count * 1e3, 3)
         if tpot_h.count else 0.0,
         "cache_stats": cache.stats(),
+        # per-request lifecycle timestamps (seconds relative to drive
+        # start): submit/first-token/finish per rid, so SLO attainment
+        # under any TTFT budget is recomputable OFFLINE from the row —
+        # the aggregate percentiles above are a digest, not the record
+        "requests_detail": [
+            {"rid": r.rid,
+             "submitted_at": round(r.submitted_at - t0, 6)
+             if r.submitted_at is not None else None,
+             "first_token_at": round(r.first_token_at - t0, 6)
+             if r.first_token_at is not None else None,
+             "finished_at": round(r.finished_at - t0, 6)
+             if r.finished_at is not None else None,
+             "state": r.state, "generated": len(r.out)}
+            for r in srv.finished],
     }
     if emit:
         print(json.dumps(row), flush=True)
@@ -656,6 +670,104 @@ def bench_serving_router_compare(name, preset=None, num_requests=12,
     }), flush=True)
 
 
+def bench_serving_autoscale_compare(name, preset=None, num_slots=2,
+                                    block_size=8, num_blocks=None,
+                                    prefill_chunk=16, max_replicas=3,
+                                    ttft_slo=12.0, queue_high=2.0,
+                                    mix="chat",
+                                    phases=((6, 0.2), (60, 0.5), (30, 0.05)),
+                                    seed=0):
+    """The closed-loop SLO story (docs/OBSERVABILITY.md): ONE seeded
+    load-gen population with a rate spike in the middle, driven in
+    scheduler-STEP clock units through (a) a FIXED 1-replica fleet and
+    (b) a policy fleet that starts at 1 replica with the
+    :class:`SLOController` active. The fixed fleet queues through the
+    spike and violates the stated p99-TTFT SLO; the controller sees the
+    windowed p99 cross the budget, scales up via ``replica_factory``
+    (sharing the one ``InferenceEngine`` — zero new compiled programs)
+    and holds it. ``slo_attainment`` is recomputed from the per-request
+    first-token timestamps; ``replicas_high_water`` and
+    ``autoscale_decisions`` come from the fleet registry. The whole
+    drive is deterministic under ``seed`` (step-unit clock, seeded
+    arrivals, host-side controller), so the row regresses bit-for-bit."""
+    from tools.load_gen import drive, make_requests
+    from deepspeed_tpu.models import gpt
+    import deepspeed_tpu
+    from deepspeed_tpu.inference.autoscale import SLOController
+    from deepspeed_tpu.inference.router import ReplicaRouter
+    from deepspeed_tpu.inference.serving import ServeRequest, ServingEngine
+    from deepspeed_tpu.telemetry import Telemetry
+
+    on_tpu = "tpu" in (jax.devices()[0].platform +
+                       jax.devices()[0].device_kind).lower()
+    max_prompt = 40
+    max_seq = max_prompt + 24 + 8
+    if preset:
+        cfg = gpt.preset(preset, max_seq_len=max_seq, dtype=jnp.bfloat16,
+                         use_flash_attention=on_tpu)
+    else:
+        cfg = gpt.GPTConfig(vocab_size=512, n_layers=4, n_heads=8,
+                            d_model=256, max_seq_len=max_seq,
+                            use_flash_attention=False, remat=False,
+                            dtype=jnp.float32)
+    eng = deepspeed_tpu.init_inference(
+        model=(cfg, gpt.init_params(jax.random.PRNGKey(0), cfg)),
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+
+    entries = make_requests(seed=seed, mix=mix, phases=list(phases),
+                            vocab_size=cfg.vocab_size,
+                            max_prompt_len=max_prompt)
+
+    def mk_srv(tel):
+        return ServingEngine(eng, num_slots=num_slots,
+                             block_size=block_size, num_blocks=num_blocks,
+                             prefill_chunk=prefill_chunk, spec_decode=False,
+                             telemetry=tel)
+
+    # warmup: compile the slot programs outside both drives
+    mk_srv(None).run([ServeRequest(
+        rid="w", prompt=np.asarray(entries[0]["prompt"], np.int32),
+        max_new_tokens=2)])
+
+    # fixed fleet: one replica, no controller — the SLO-violation shape
+    tel_f = Telemetry()
+    fixed = ReplicaRouter([mk_srv(tel_f)], telemetry=tel_f)
+    res_f = drive(fixed, entries, mode="open", slo_ttft=ttft_slo)
+
+    # policy fleet: same population, controller active; replicas come
+    # from the factory SHARING eng, so scale-up compiles nothing
+    tel_p = Telemetry()
+    ctrl = SLOController(ttft_slo=ttft_slo, window=16.0, eval_every=2,
+                         max_replicas=max_replicas, cooldown=4.0,
+                         idle_to_retire=1e9, min_samples=3,
+                         queue_high=queue_high)
+    policy = ReplicaRouter([mk_srv(tel_p)],
+                           replica_factory=lambda i, tag: mk_srv(tel_p),
+                           telemetry=tel_p, autoscale=ctrl)
+    res_p = drive(policy, entries, mode="open", slo_ttft=ttft_slo)
+
+    snap = policy.fleet_snapshot()
+    print(json.dumps({
+        "config": name, "preset": preset or "cpu-smoke",
+        "autoscale": f"fixed-1-vs-policy-{max_replicas}",
+        "num_requests": len(entries), "mix": mix,
+        "ttft_slo_steps": ttft_slo,
+        "ttft_p99_fixed": round(res_f["ttft_p99"], 2),
+        "ttft_p99_policy": round(res_p["ttft_p99"], 2),
+        "slo_attainment_fixed": round(res_f["slo_attainment"], 3),
+        "slo_attainment": round(res_p["slo_attainment"], 3),
+        "slo_violated_fixed": res_f["ttft_p99"] > ttft_slo,
+        "slo_holds_policy": res_p["ttft_p99"] <= ttft_slo,
+        "replicas_high_water":
+            1 + snap["counters"]["router_scale_ups"],
+        "autoscale_decisions": snap["counters"]["autoscale_decisions"],
+        "autoscale_scale_ups": snap["counters"]["autoscale_scale_ups"],
+        "fleet_health": policy.health(),
+        "steps_fixed": res_f["steps"], "steps_policy": res_p["steps"],
+    }), flush=True)
+    return res_f, res_p, policy
+
+
 SERVE_CONFIGS = [
     # CPU-verifiable smoke: staggered Poisson arrivals must batch
     # (mean_occupancy > 1) and the paged footprint must undercut the
@@ -750,6 +862,20 @@ SERVE_COMPARE_CONFIGS = [
         mode="router", preset="gpt2-medium", num_requests=24,
         mean_gap_steps=1.5, prompt_lens=(64, 256), new_tokens=48,
         num_slots=4, block_size=16, prefill_chunk=128, kill_step=40)),
+    # SLO autoscaling: one seeded spiky load-gen population through a
+    # fixed 1-replica fleet vs a policy fleet with the SLOController
+    # active — the fixed fleet must violate the stated p99-TTFT SLO
+    # through the spike and the policy fleet must hold it by scaling
+    # up (replicas_high_water / autoscale_decisions registry-sourced)
+    ("serve-autoscale-smoke", dict(mode="autoscale", num_slots=2,
+                                   block_size=8, prefill_chunk=16,
+                                   max_replicas=3, ttft_slo=12.0,
+                                   phases=((6, 0.2), (60, 0.5),
+                                           (30, 0.05)))),
+    ("serve-autoscale-gpt2-medium", dict(
+        mode="autoscale", preset="gpt2-medium", num_slots=4,
+        block_size=16, prefill_chunk=64, max_replicas=3, ttft_slo=12.0,
+        phases=((6, 0.2), (60, 0.5), (30, 0.05)))),
 ]
 
 
@@ -790,6 +916,7 @@ def main():
                    "kvquant": bench_serving_kvquant_compare,
                    "router": bench_serving_router_compare,
                    "sampling": bench_serving_sampling_compare,
+                   "autoscale": bench_serving_autoscale_compare,
                    }.get(mode, bench_serving_impl_compare)
         try:
             compare(name, **kw)

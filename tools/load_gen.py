@@ -1,0 +1,307 @@
+"""Deterministic serving load generator: seeded request mixes +
+arrival processes + a drive loop that records per-request timestamps.
+
+The closed observability loop (docs/OBSERVABILITY.md) needs load that
+is (a) shaped like real traffic — bursty arrivals, heterogeneous
+prompt/output lengths, priority classes — and (b) exactly replayable,
+so an autoscale decision timeline can be compared run-over-run and a
+bench row regressed bit-for-bit. This module provides both halves:
+
+- **mixes** (``MIXES``): named request populations — ``chat`` (short
+  shared-system-prompt turns, interactive-heavy), ``rag`` (long-prefill
+  retrieval contexts, short answers), ``repetitive`` (tiny-alphabet
+  highly-predictable prompts, the spec-decode-friendly shape, batch-
+  heavy) and ``heavy_tail`` (adversarial Pareto-tailed lengths);
+- **arrivals**: an open-loop Poisson process over piecewise-constant
+  rate ``phases`` (``[(duration, rate), ...]`` — a spike is just a
+  high-rate middle phase), or a burst (every request at t=0) for
+  closed-loop driving;
+- **trace save/replay**: :func:`save_trace` / :func:`load_trace`
+  round-trip the generated request list through JSON, so a run can be
+  replayed against a different fleet shape with identical input;
+- **drive loop** (:func:`drive`): submits against anything with the
+  ``submit(req, now)`` / ``step(now)`` / ``busy`` surface (a
+  ``ServingEngine`` or a ``ReplicaRouter``), open- or closed-loop, and
+  returns per-request ``submitted/first_token/finished`` timestamps
+  plus SLO attainment — the offline-recomputable record the bench rows
+  embed.
+
+Everything is a pure function of the explicit ``seed`` (no ambient
+randomness — the dslint DS010 contract extended to the harness): same
+seed, same mix, same phases => byte-identical request list and, against
+a deterministic fleet, an identical decision timeline.
+
+CLI: ``python -m tools.load_gen --seed 0 --mix chat
+--phases 20:0.5,10:2,20:0.5 --out trace.json`` writes a replayable
+trace; add ``--summary`` to print the population digest.
+"""
+
+import json
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# terminal request states the drive loop treats as "finished"
+_TERMINAL = ("done", "timeout", "shed")
+
+# mix parameters: prompt/output length ranges are inclusive uniform
+# unless pareto=True (heavy tail: lo + Pareto(alpha) * scale, clipped);
+# shared_prefix tokens are common to every request in the population
+# (the prefix-cache / affinity-routing shape); alphabet restricts token
+# ids to a tiny range (highly predictable text, the speculative-decode
+# friendly regime); batch_frac is the probability a request carries
+# priority="batch" instead of "interactive"
+MIXES: Dict[str, Dict[str, Any]] = {
+    "chat": dict(plen=(4, 12), new=(4, 16), shared_prefix=4,
+                 alphabet=None, batch_frac=0.1, pareto=False),
+    "rag": dict(plen=(20, 40), new=(2, 8), shared_prefix=12,
+                alphabet=None, batch_frac=0.5, pareto=False),
+    "repetitive": dict(plen=(8, 24), new=(8, 24), shared_prefix=0,
+                       alphabet=8, batch_frac=0.7, pareto=False),
+    "heavy_tail": dict(plen=(3, 40), new=(2, 24), shared_prefix=0,
+                       alphabet=None, batch_frac=0.5, pareto=True),
+}
+
+TRACE_VERSION = 1
+
+
+def poisson_arrivals(phases: Sequence[Tuple[float, float]],
+                     seed: int) -> List[float]:
+    """Arrival instants of a Poisson process with piecewise-constant
+    rate: for each ``(duration, rate)`` phase, exponential inter-
+    arrival gaps at that rate until the phase's time is spent. Rate 0
+    phases contribute silence. Deterministic in ``seed``."""
+    rng = np.random.default_rng(seed)
+    out: List[float] = []
+    t0 = 0.0
+    for duration, rate in phases:
+        duration = float(duration)
+        if rate > 0:
+            t = t0 + float(rng.exponential(1.0 / rate))
+            while t < t0 + duration:
+                out.append(t)
+                t += float(rng.exponential(1.0 / rate))
+        t0 += duration
+    return out
+
+
+def make_requests(*, seed: int, mix: str = "chat", n: Optional[int] = None,
+                  phases: Optional[Sequence[Tuple[float, float]]] = None,
+                  vocab_size: int = 128,
+                  max_prompt_len: int = 48) -> List[Dict]:
+    """Generate a deterministic request population. With ``phases`` the
+    arrival instants come from the Poisson process (``n`` then caps the
+    count if given); without, ``n`` requests all arrive at t=0 (a burst
+    — the closed-loop shape). Each entry is JSON-plain:
+    ``{rid, at, kind, priority, prompt, max_new_tokens}``."""
+    if mix not in MIXES:
+        raise ValueError(f"unknown mix {mix!r}; have {sorted(MIXES)}")
+    if phases is None and n is None:
+        raise ValueError("need n= (burst) or phases= (poisson)")
+    params = MIXES[mix]
+    if phases is not None:
+        ats = poisson_arrivals(phases, seed)
+        if n is not None:
+            ats = ats[:n]
+    else:
+        ats = [0.0] * int(n)
+    rng = np.random.default_rng(seed + 1)     # independent of arrivals
+    lo_tok, hi_tok = 1, vocab_size            # 0 reserved (pad/eos)
+    if params["alphabet"]:
+        hi_tok = min(vocab_size, lo_tok + params["alphabet"])
+    shared = rng.integers(
+        lo_tok, hi_tok, params["shared_prefix"]).tolist() \
+        if params["shared_prefix"] else []
+
+    def length(lo: int, hi: int) -> int:
+        if params["pareto"]:
+            v = lo + rng.pareto(1.5) * (hi - lo) / 4.0
+            return int(min(max(v, lo), hi))
+        return int(rng.integers(lo, hi + 1))
+
+    out: List[Dict] = []
+    for i, at in enumerate(ats):
+        plen = min(length(*params["plen"]), max_prompt_len)
+        tail = max(1, plen - len(shared))
+        prompt = shared + rng.integers(lo_tok, hi_tok, tail).tolist()
+        out.append({
+            "rid": f"{mix}-{i}",
+            "at": float(at),
+            "kind": mix,
+            "priority": ("batch" if rng.random() < params["batch_frac"]
+                         else "interactive"),
+            "prompt": [int(t) for t in prompt],
+            "max_new_tokens": length(*params["new"]),
+        })
+    return out
+
+
+def save_trace(path: str, requests: List[Dict], *, seed: int,
+               mix: str = "", meta: Optional[Dict] = None) -> str:
+    """Persist a request population as a replayable JSON trace."""
+    body = {"version": TRACE_VERSION, "seed": seed, "mix": mix,
+            "meta": meta or {}, "requests": requests}
+    with open(path, "w") as f:
+        json.dump(body, f)
+    return path
+
+
+def load_trace(path: str) -> List[Dict]:
+    """Load a trace written by :func:`save_trace`; returns the request
+    list (arrival order preserved)."""
+    with open(path) as f:
+        body = json.load(f)
+    if body.get("version") != TRACE_VERSION:
+        raise ValueError(
+            f"{path}: trace version {body.get('version')!r}, "
+            f"expected {TRACE_VERSION}")
+    return body["requests"]
+
+
+def _mk_serve_requests(entries: List[Dict]) -> List:
+    from deepspeed_tpu.inference.serving import ServeRequest
+    return [ServeRequest(rid=e["rid"],
+                         prompt=np.asarray(e["prompt"], np.int32),
+                         max_new_tokens=int(e["max_new_tokens"]),
+                         priority=e.get("priority"))
+            for e in entries]
+
+
+def drive(target, entries: List[Dict], *, mode: str = "open",
+          concurrency: int = 8, slo_ttft: Optional[float] = None,
+          max_steps: int = 100_000) -> Dict:
+    """Run a generated population against ``target`` (ServingEngine or
+    ReplicaRouter — anything with ``submit(req, now)`` / ``step(now)``
+    / ``busy``), stepping the scheduler clock one unit per iteration.
+
+    - ``mode="open"``: requests are submitted when the clock reaches
+      their ``at`` — queueing delay under a spike is real (the
+      fixed-fleet SLO-violation shape the autoscale bench contrasts).
+    - ``mode="closed"``: arrival times are ignored; at most
+      ``concurrency`` requests are outstanding, the next one submitted
+      as soon as one finishes (throughput-probe shape).
+
+    Returns ``{"per_request": [...], "steps", "slo_attainment",
+    "ttft_p50/p95/p99"}`` where each per-request record carries
+    ``submitted_at`` / ``first_token_at`` / ``finished_at`` / ``state``
+    — the offline-recomputable SLO record. ``slo_attainment`` (when
+    ``slo_ttft`` is given) counts a request attained iff it got its
+    first token within the budget; requests that never produced one
+    (shed, still queued at exhaustion) count as misses."""
+    if mode not in ("open", "closed"):
+        raise ValueError(f"mode must be open|closed, got {mode!r}")
+    order = sorted(range(len(entries)), key=lambda i: entries[i]["at"]) \
+        if mode == "open" else list(range(len(entries)))
+    reqs = _mk_serve_requests(entries)
+    clock = 0.0
+    steps = 0
+    nxt = 0                                   # next request to submit
+    live: List = []                           # submitted, maybe running
+    while nxt < len(order) or target.busy:
+        if mode == "open":
+            while nxt < len(order) \
+                    and entries[order[nxt]]["at"] <= clock:
+                r = reqs[order[nxt]]
+                target.submit(r, now=clock)
+                live.append(r)
+                nxt += 1
+            if not target.busy and nxt < len(order):
+                # idle gap before the next arrival: fast-forward the
+                # clock instead of spinning empty steps
+                clock = max(clock, entries[order[nxt]]["at"])
+                continue
+        else:
+            inflight = sum(1 for r in live if r.state not in _TERMINAL)
+            while nxt < len(order) and inflight < concurrency:
+                r = reqs[order[nxt]]
+                target.submit(r, now=clock)
+                live.append(r)
+                nxt += 1
+                if r.state not in _TERMINAL:
+                    inflight += 1
+        target.step(clock)
+        clock += 1.0
+        steps += 1
+        if steps > max_steps:
+            raise RuntimeError(f"load did not drain in {max_steps} steps")
+
+    per_request: List[Dict] = []
+    ttfts: List[float] = []
+    attained = 0
+    for e, r in zip(entries, reqs):
+        ttft = (r.first_token_at - r.submitted_at
+                if r.first_token_at is not None
+                and r.submitted_at is not None else None)
+        if ttft is not None:
+            ttfts.append(ttft)
+            if slo_ttft is not None and ttft <= slo_ttft:
+                attained += 1
+        per_request.append({
+            "rid": e["rid"], "kind": e["kind"],
+            "priority": e.get("priority"), "arrival": e["at"],
+            "submitted_at": r.submitted_at,
+            "first_token_at": r.first_token_at,
+            "finished_at": r.finished_at,
+            "state": r.state, "ttft": ttft,
+            "generated": len(r.out),
+        })
+    arr = np.asarray(ttfts) if ttfts else np.asarray([0.0])
+    return {
+        "per_request": per_request,
+        "steps": steps,
+        "requests": len(entries),
+        "slo_attainment": (attained / len(entries)
+                           if slo_ttft is not None and entries else None),
+        "ttft_p50": float(np.percentile(arr, 50)),
+        "ttft_p95": float(np.percentile(arr, 95)),
+        "ttft_p99": float(np.percentile(arr, 99)),
+    }
+
+
+def _parse_phases(spec: str) -> List[Tuple[float, float]]:
+    """``"20:0.5,10:2,20:0.5"`` -> [(20, 0.5), (10, 2), (20, 0.5)]."""
+    out = []
+    for part in spec.split(","):
+        dur, rate = part.split(":")
+        out.append((float(dur), float(rate)))
+    return out
+
+
+def main(argv: List[str]) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="generate a replayable serving load trace")
+    ap.add_argument("--seed", type=int, required=True,
+                    help="explicit seed (no ambient randomness)")
+    ap.add_argument("--mix", default="chat", choices=sorted(MIXES))
+    ap.add_argument("--n", type=int, default=None)
+    ap.add_argument("--phases", default=None,
+                    help="piecewise Poisson rates, e.g. 20:0.5,10:2")
+    ap.add_argument("--vocab-size", type=int, default=128)
+    ap.add_argument("--max-prompt-len", type=int, default=48)
+    ap.add_argument("--out", default=None, help="trace JSON path")
+    ap.add_argument("--summary", action="store_true")
+    args = ap.parse_args(argv)
+    reqs = make_requests(
+        seed=args.seed, mix=args.mix, n=args.n,
+        phases=_parse_phases(args.phases) if args.phases else None,
+        vocab_size=args.vocab_size, max_prompt_len=args.max_prompt_len)
+    if args.out:
+        save_trace(args.out, reqs, seed=args.seed, mix=args.mix)
+        print(f"wrote {len(reqs)} requests to {args.out}")
+    if args.summary or not args.out:
+        lens = [len(r["prompt"]) for r in reqs]
+        print(json.dumps({
+            "mix": args.mix, "seed": args.seed, "requests": len(reqs),
+            "batch_frac": (sum(r["priority"] == "batch" for r in reqs)
+                           / len(reqs)) if reqs else 0.0,
+            "prompt_len_mean": float(np.mean(lens)) if lens else 0.0,
+            "span": reqs[-1]["at"] - reqs[0]["at"] if reqs else 0.0,
+        }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, ".")
+    raise SystemExit(main(sys.argv[1:]))

@@ -16,6 +16,11 @@ Usage:
       — analyze a serving-telemetry Perfetto export
         (deepspeed_tpu/telemetry, docs/OBSERVABILITY.md): per-request
         lifecycle spans, step-phase breakdown, injected-fault timeline.
+  python tools/trace_analyze.py fleet /tmp/router_trace.json
+      — analyze a ROUTER-level export: per-replica dispatch counts,
+        breaker/health timeline, drains/restarts/fleet-shape changes
+        and the autoscale decision timeline with each decision's
+        triggering window metrics.
 """
 
 import collections
@@ -158,6 +163,101 @@ def analyze_serving_trace(path: str, quiet: bool = False) -> dict:
     return summary
 
 
+def analyze_fleet_trace(path: str, quiet: bool = False) -> dict:
+    """Summarize a ROUTER-level Perfetto export: per-replica dispatch
+    occupancy, the breaker/health timeline, drains, warm restarts,
+    fleet-shape (``scale``) changes, router-side sheds and the full
+    autoscale decision timeline (each decision instant carries the
+    windowed metrics that triggered it — the reconstructability
+    contract of docs/OBSERVABILITY.md). Returns the summary dict
+    (tests assert on it); prints it unless ``quiet``."""
+    trace = _load_trace(path)
+    events = trace.get("traceEvents", [])
+    dispatch_per_replica = collections.Counter()
+    resumed = 0
+    breaker, drains, restarts, scale, decisions, degraded = \
+        [], [], [], [], [], []
+    sheds = 0
+    for e in events:
+        if e.get("ph") != "i" or e.get("cat") != "scheduler":
+            continue
+        name, a = e.get("name"), dict(e.get("args", {}))
+        a["ts"] = e.get("ts")
+        if name == "dispatch":
+            dispatch_per_replica[a.get("replica")] += 1
+            resumed += bool(a.get("resumed"))
+        elif name == "breaker":
+            breaker.append(a)
+        elif name == "drain":
+            drains.append(a)
+        elif name == "restart":
+            restarts.append(a)
+        elif name == "scale":
+            scale.append(a)
+        elif name == "autoscale":
+            decisions.append(a)
+        elif name == "shed":
+            sheds += 1
+        elif name == "degraded":
+            degraded.append(a)
+    by_action = collections.Counter(d.get("action") for d in decisions)
+    summary = {
+        "n_events": len(events),
+        "dispatch": {
+            "total": sum(dispatch_per_replica.values()),
+            "per_replica": {str(k): v for k, v
+                            in sorted(dispatch_per_replica.items())},
+            "resumed": resumed,
+        },
+        "breaker": breaker,
+        "drains": drains,
+        "restarts": restarts,
+        "scale": scale,
+        "autoscale": {"decisions": decisions,
+                      "by_action": dict(by_action)},
+        "sheds": sheds,
+        "degraded": degraded,
+    }
+    if not quiet:
+        print(json.dumps({
+            "trace": path, "n_events": len(events),
+            "dispatched": summary["dispatch"]["total"],
+            "breaker_transitions": len(breaker), "drains": len(drains),
+            "restarts": len(restarts), "scale_changes": len(scale),
+            "autoscale_decisions": len(decisions), "sheds": sheds}))
+        if dispatch_per_replica:
+            print("\n-- dispatches by replica --")
+            for idx, n in sorted(dispatch_per_replica.items()):
+                print(f"  replica {idx}: {n}"
+                      + (f"  ({resumed} resumed fleet-wide)"
+                         if idx == min(dispatch_per_replica) and resumed
+                         else ""))
+        if breaker:
+            print("\n-- health timeline --")
+            for b in breaker:
+                print(f"  step {b.get('step')}: replica {b.get('replica')}"
+                      f" {b.get('prev')} -> {b.get('state')}"
+                      f" ({b.get('reason', '')})")
+        if scale:
+            print("\n-- fleet shape --")
+            for s in scale:
+                print(f"  step {s.get('step')}: {s.get('action')}"
+                      f" replica {s.get('replica')}"
+                      f" ({s.get('reason', '')})")
+        acted = [d for d in decisions if d.get("action") != "noop"]
+        if decisions:
+            print(f"\n-- autoscale decisions "
+                  f"({len(decisions)} evals, {len(acted)} actions) --")
+            for d in acted:
+                print(f"  step {d.get('step')}: {d.get('action')}"
+                      f"  p99_ttft={d.get('p99_ttft'):.4g}"
+                      f" (slo {d.get('ttft_slo')},"
+                      f" {int(d.get('window_count', 0))} obs)"
+                      f" load={d.get('load')}"
+                      f" active={d.get('active_replicas')}")
+    return summary
+
+
 def run():
     import jax
     import numpy as np
@@ -201,5 +301,7 @@ if __name__ == "__main__":
         analyze(sys.argv[2])
     elif sys.argv[1:] and sys.argv[1] == "serve":
         analyze_serving_trace(sys.argv[2])
+    elif sys.argv[1:] and sys.argv[1] == "fleet":
+        analyze_fleet_trace(sys.argv[2])
     else:
         run()
