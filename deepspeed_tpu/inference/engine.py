@@ -740,6 +740,18 @@ class InferenceEngine:
                                            static_argnums=(9,))
             self._cow_blocks_q = jax.jit(self._cow_blocks_q_fn,
                                          donate_argnums=(0, 1, 2, 3))
+            # host-tier transfer programs (DS_KV_HOST_TIER=on): the
+            # spill gather keeps the pools live (the copy rides out
+            # while decode keeps serving), the restore scatter donates
+            # them like COW. ids/dst are traced, widths fixed per cache,
+            # so steady state adds ZERO programs beyond the two warmed
+            # at ServingEngine construction (paged_cache.warm_host_tier)
+            self._gather_blocks = jax.jit(self._gather_blocks_fn)
+            self._scatter_block = jax.jit(self._scatter_block_fn,
+                                          donate_argnums=(0, 1))
+            self._gather_blocks_q = jax.jit(self._gather_blocks_q_fn)
+            self._scatter_block_q = jax.jit(self._scatter_block_q_fn,
+                                            donate_argnums=(0, 1, 2, 3))
         log_dist(f"inference engine: {config.n_layers}L/{config.d_model}d "
                  f"mp={mp_size} dtype={jnp.dtype(dtype).name} "
                  f"{'encoder' if self.is_encoder else 'decoder'}",
@@ -1074,6 +1086,51 @@ class InferenceEngine:
         return self._cow_blocks_q(k_pool, v_pool, k_scale, v_scale,
                                   jnp.asarray(src, jnp.int32),
                                   jnp.asarray(dst, jnp.int32))
+
+    def _gather_blocks_fn(self, k_pool, v_pool, ids):
+        """Pull a fixed-width batch of pool blocks (device half of a
+        host-tier spill, paged_cache.spill_tick). Pools stay live —
+        the gathered copy is what travels to host."""
+        return k_pool[:, ids], v_pool[:, ids]
+
+    def gather_blocks(self, k_pool, v_pool, ids):
+        return self._gather_blocks(k_pool, v_pool,
+                                   jnp.asarray(ids, jnp.int32))
+
+    def _scatter_block_fn(self, k_pool, v_pool, k_blk, v_blk, dst):
+        """Write one restored block back into the donated pools (device
+        half of a host-tier restore, paged_cache._dispatch_restore)."""
+        return (k_pool.at[:, dst].set(k_blk),
+                v_pool.at[:, dst].set(v_blk))
+
+    def scatter_block(self, k_pool, v_pool, k_blk, v_blk, dst):
+        return self._scatter_block(k_pool, v_pool, k_blk, v_blk,
+                                   jnp.asarray(dst, jnp.int32))
+
+    def _gather_blocks_q_fn(self, k_pool, v_pool, k_scale, v_scale, ids):
+        """Quantized-pool spill gather: int8 payload plus fp32 scale
+        sidecars travel together (docs/KV_TIERING.md)."""
+        return (k_pool[:, ids], v_pool[:, ids],
+                k_scale[:, ids], v_scale[:, ids])
+
+    def gather_blocks_q(self, k_pool, v_pool, k_scale, v_scale, ids):
+        return self._gather_blocks_q(k_pool, v_pool, k_scale, v_scale,
+                                     jnp.asarray(ids, jnp.int32))
+
+    def _scatter_block_q_fn(self, k_pool, v_pool, k_scale, v_scale,
+                            k_blk, v_blk, ks_blk, vs_blk, dst):
+        """Quantized-pool restore scatter: payload and scales land
+        together."""
+        return (k_pool.at[:, dst].set(k_blk),
+                v_pool.at[:, dst].set(v_blk),
+                k_scale.at[:, dst].set(ks_blk),
+                v_scale.at[:, dst].set(vs_blk))
+
+    def scatter_block_q(self, k_pool, v_pool, k_scale, v_scale,
+                        k_blk, v_blk, ks_blk, vs_blk, dst):
+        return self._scatter_block_q(k_pool, v_pool, k_scale, v_scale,
+                                     k_blk, v_blk, ks_blk, vs_blk,
+                                     jnp.asarray(dst, jnp.int32))
 
     def sync(self, *values) -> None:
         """Barrier on device values (pools, logits): the telemetry

@@ -38,15 +38,37 @@ serving startup).
 Block id 0 is RESERVED as the trash block: the slot programs route
 writes for masked-out lanes (chunk padding, inactive slots) there, so
 the compiled scatter needs no branch.
+
+**Host-DRAM tier** (``host_tier=True`` / ``DS_KV_HOST_TIER=on``,
+docs/KV_TIERING.md): refcount-zero INDEXED blocks can spill to a
+:class:`~deepspeed_tpu.inference.host_tier.HostBlockPool` instead of
+dying at eviction — the reproduction of the reference's ZeRO-Infinity
+``swap_tensor`` offload re-aimed at inference. A low-watermark spill
+daemon (:meth:`PagedKVCache.spill_tick`, driven once per serving step,
+never on the admission critical path) gathers up to ``transfer_blocks``
+LRU spill candidates with ONE fixed-width compiled gather and harvests
+the bytes to host on the NEXT tick (double-buffered: the device→host
+copy overlaps a full decode step). A prefix match that lands on
+host-tier links restores them block-by-block through a fixed-width
+compiled scatter, drawing restore targets from the FREE LIST only.
+Both programs are warmed at :meth:`PagedKVCache.warm_host_tier`, so the
+steady state compiles ZERO new programs. Every failure rung degrades,
+never corrupts: a CRC-bad host block discards its whole chain
+(cold-miss re-prefill), a failed spill leaves the block device-resident
+behind exponential backoff, and an exhausted host budget falls back to
+plain eviction — exactly the tier-off behavior.
 """
 
 import os
+import time
 from typing import Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepspeed_tpu.inference.host_tier import (
+    HostBlockPool, HostCorruption, resolve_host_tier)
 from deepspeed_tpu.inference.prefix_index import PrefixIndex, PrefixMatch
 from deepspeed_tpu.models import gpt as gpt_lib
 from deepspeed_tpu.models.gpt import GPTConfig
@@ -101,6 +123,56 @@ def _cow_copy_fn_q(k_pool, v_pool, k_scale, v_scale, src, dst):
 _default_cow_q = jax.jit(_cow_copy_fn_q, donate_argnums=(0, 1, 2, 3))
 
 
+def _gather_blocks_fn(k_pool, v_pool, ids):
+    """Pull ``len(ids)`` blocks out of the pools (device side of a
+    spill). ``ids`` is a FIXED-width traced vector — every spill batch
+    reuses one compiled program, short batches pad with the trash block
+    (its lanes are gathered and then simply not stored). Pools are NOT
+    donated: the gathered copy rides out asynchronously while the pools
+    keep serving decode."""
+    return k_pool[:, ids], v_pool[:, ids]
+
+
+_default_gather = jax.jit(_gather_blocks_fn)
+
+
+def _gather_blocks_fn_q(k_pool, v_pool, k_scale, v_scale, ids):
+    """Quantized-pool spill gather: the int8 payload travels WITH its
+    fp32 per-(block, kv_head) scale sidecars, so a restored block
+    dequantizes to exactly what was spilled."""
+    return (k_pool[:, ids], v_pool[:, ids],
+            k_scale[:, ids], v_scale[:, ids])
+
+
+_default_gather_q = jax.jit(_gather_blocks_fn_q)
+
+
+def _scatter_block_fn(k_pool, v_pool, k_blk, v_blk, dst):
+    """Write ONE restored block back into the pools (device side of a
+    host→device restore). ``dst`` is a traced scalar — one compiled
+    program for every restore. Pools are donated: the write is in-place
+    in HBM, mirroring the COW copy."""
+    return (k_pool.at[:, dst].set(k_blk),
+            v_pool.at[:, dst].set(v_blk))
+
+
+_default_scatter = jax.jit(_scatter_block_fn, donate_argnums=(0, 1))
+
+
+def _scatter_block_fn_q(k_pool, v_pool, k_scale, v_scale,
+                        k_blk, v_blk, ks_blk, vs_blk, dst):
+    """Quantized-pool restore scatter: payload and scale sidecars land
+    together."""
+    return (k_pool.at[:, dst].set(k_blk),
+            v_pool.at[:, dst].set(v_blk),
+            k_scale.at[:, dst].set(ks_blk),
+            v_scale.at[:, dst].set(vs_blk))
+
+
+_default_scatter_q = jax.jit(_scatter_block_fn_q,
+                             donate_argnums=(0, 1, 2, 3))
+
+
 class PagedKVCache:
     """Pool + allocator + per-slot block tables (+ optional prefix index).
 
@@ -124,6 +196,18 @@ class PagedKVCache:
     returns the scale pools too (``(k, v, ks, vs, src, dst) -> 4-tuple``)
     so scales travel with blocks on COW. ``"off"`` (default) keeps the
     fp pools byte-identical to the unquantized cache — the bit-reference.
+
+    With ``host_tier=True`` (or ``DS_KV_HOST_TIER=on``) refcount-zero
+    indexed blocks spill to host DRAM under HBM pressure instead of
+    being evicted outright, and a prefix match on a spilled chain
+    restores the bytes instead of re-prefilling (module docstring;
+    docs/KV_TIERING.md). The tier requires the prefix cache — only
+    indexed blocks are worth keeping on ANY tier — so with
+    ``prefix_cache=False`` the flag is inert and the device-only
+    allocator stays the bit-reference. ``gather_fn`` / ``scatter_fn``
+    override the transfer programs (the serving engine wires the
+    engine's jitted, correctly-sharded ones in); standalone caches fall
+    back to module-level jitted defaults.
     """
 
     def __init__(self, cfg: GPTConfig, *, num_slots: int,
@@ -134,7 +218,13 @@ class PagedKVCache:
                  prefix_cache: bool = False,
                  copy_fn: Optional[Callable] = None,
                  tracer=None,
-                 kv_quant: Optional[str] = None):
+                 kv_quant: Optional[str] = None,
+                 host_tier: Optional[bool] = None,
+                 host_budget_bytes: Optional[int] = None,
+                 transfer_blocks: int = 4,
+                 spill_watermark: Optional[int] = None,
+                 gather_fn: Optional[Callable] = None,
+                 scatter_fn: Optional[Callable] = None):
         self.cfg = cfg
         # telemetry hook (telemetry/tracer.RequestTracer): COW copies
         # and index-block reclaims land in the serving timeline; None
@@ -204,6 +294,30 @@ class PagedKVCache:
         self.index: Optional[PrefixIndex] = \
             PrefixIndex(self.block_size) if self.prefix_cache else None
         self.copy_fn = copy_fn
+        # host-DRAM second tier (docs/KV_TIERING.md): gated on the
+        # prefix index because only INDEXED blocks spill — a block no
+        # future request can match is dead weight on any tier. With the
+        # index absent the knob is inert (bit-reference either way).
+        self.host_tier = resolve_host_tier(host_tier) and \
+            self.index is not None
+        self.host_pool: Optional[HostBlockPool] = \
+            HostBlockPool(host_budget_bytes) if self.host_tier else None
+        self.gather_fn = gather_fn
+        self.scatter_fn = scatter_fn
+        self.transfer_blocks = max(1, int(transfer_blocks))
+        # spill trigger: one transfer batch ABOVE the admission
+        # watermark by default, so spilling starts before admission
+        # control begins holding requests back
+        self.spill_watermark = (self.watermark + self.transfer_blocks) \
+            if spill_watermark is None else int(spill_watermark)
+        # blocks whose bytes are mid-flight (queued gather not yet
+        # harvested): excluded from EVERY reclaim/eviction predicate and
+        # from free-list returns until the harvest settles them
+        self._in_transfer: set = set()
+        self._pending_spill = None   # (ids, gathered device arrays)
+        self._spill_cooldown = 0     # ticks until the next spill attempt
+        self._spill_backoff = 1      # cooldown applied on the next failure
+        self._restore_ms: List[float] = []
         self.peak_used_blocks = 0
         self.peak_tokens_in_flight = 0
         # prefix-cache counters (mirrored into serving stats / bench rows)
@@ -212,6 +326,12 @@ class PagedKVCache:
         self.prefix_tokens_saved = 0
         self.cow_copies = 0
         self.cache_block_evictions = 0
+        # host-tier counters
+        self.host_spills = 0
+        self.host_restores = 0
+        self.host_restore_failures = 0
+        self.host_spill_aborts = 0
+        self.host_budget_refusals = 0
 
     # -- accounting ----------------------------------------------------
     @property
@@ -234,13 +354,30 @@ class PagedKVCache:
         """Blocks mapped by MORE than one slot — the sharing win."""
         return int((self._refcount > 1).sum())
 
+    def _reclaimable(self, bid: int) -> bool:
+        """The ONE reclaim-eligibility predicate: refcount zero AND not
+        mid-transfer. Every eviction/availability path must use it — a
+        block whose bytes are in flight to host must not be handed out
+        (the harvest would scatter stale truth over a live block)."""
+        return self._refcount[bid] == 0 and bid not in self._in_transfer
+
     @property
     def cached_blocks(self) -> int:
         """Indexed blocks no slot holds: resident, reclaimable (LRU)."""
         if self.index is None:
             return 0
-        return self.index.evictable_count(
-            lambda b: self._refcount[b] == 0)
+        return self.index.evictable_count(self._reclaimable)
+
+    @property
+    def host_blocks(self) -> int:
+        """Blocks resident on the host tier (spilled, restorable)."""
+        return len(self.host_pool) if self.host_pool is not None else 0
+
+    @property
+    def host_bytes(self) -> int:
+        """Host-DRAM bytes the spilled blocks occupy."""
+        return self.host_pool.bytes_used if self.host_pool is not None \
+            else 0
 
     @property
     def tokens_in_flight(self) -> int:
@@ -269,6 +406,13 @@ class PagedKVCache:
             "prefix_tokens_saved": self.prefix_tokens_saved,
             "cow_copies": self.cow_copies,
             "cache_block_evictions": self.cache_block_evictions,
+            "host_blocks": self.host_blocks,
+            "host_bytes": self.host_bytes,
+            "host_spills": self.host_spills,
+            "host_restores": self.host_restores,
+            "host_restore_failures": self.host_restore_failures,
+            "host_spill_aborts": self.host_spill_aborts,
+            "host_budget_refusals": self.host_budget_refusals,
         }
 
     def used_block_bytes(self) -> int:
@@ -306,22 +450,32 @@ class PagedKVCache:
 
     def blocks_needed(self, n_tokens: int, tokens=None) -> int:
         """Fresh blocks an allocation would draw from the pool after
-        prefix sharing (a COW divergence still needs its fresh copy)."""
-        return self.blocks_for(n_tokens) - \
-            len(self._peek_match(tokens).block_ids)
+        prefix sharing (a COW divergence still needs its fresh copy).
+        Only DEVICE-tier matched links are free; a host-tier hit costs
+        one fresh block too — its restore target."""
+        m = self._peek_match(tokens)
+        dev = m.tiers.count("device") if m.tiers else len(m.block_ids)
+        return self.blocks_for(n_tokens) - dev
 
     def available_blocks(self, tokens=None) -> int:
         """Free blocks plus LRU-reclaimable cached blocks, EXCLUDING any
         block a match on ``tokens`` would map (a chain block at refcount
-        0 cannot both be shared into the slot and reclaimed for it)."""
+        0 cannot both be shared into the slot and reclaimed for it).
+        Host-tier links never pin: their keys live in a separate
+        namespace and their restore targets are charged by
+        :meth:`blocks_needed`."""
         n = len(self._free)
         if self.index is not None:
             m = self._peek_match(tokens)
-            pinned = set(m.block_ids)
+            if m.tiers:
+                pinned = {b for b, t in zip(m.block_ids, m.tiers)
+                          if t == "device"}
+            else:
+                pinned = set(m.block_ids)
             if m.cow_src is not None:
                 pinned.add(m.cow_src)
             n += self.index.evictable_count(
-                lambda b: self._refcount[b] == 0 and b not in pinned)
+                lambda b: self._reclaimable(b) and b not in pinned)
         return n
 
     def can_admit(self, n_tokens: int, tokens=None,
@@ -369,8 +523,7 @@ class PagedKVCache:
         fresh_need = need_total - len(m.block_ids)
         avail = len(self._free)
         if self.index is not None:
-            avail += self.index.evictable_count(
-                lambda b: self._refcount[b] == 0)
+            avail += self.index.evictable_count(self._reclaimable)
         if fresh_need > avail:
             for bid in pinned:
                 self._refcount[bid] -= 1  # rollback the claim
@@ -413,6 +566,8 @@ class PagedKVCache:
         if f is not None and f.kind == "cache_exhausted":
             return PrefixMatch()          # degraded: serve as a cold miss
         m = self.index.match(tokens, max_tokens=len(tokens) - 1)
+        if "host" in m.tiers:
+            m = self._restore_match(m)
         if m.cow_src is not None:
             f = self._fire("cache.cow")
             if f is not None and f.kind == "cache_exhausted":
@@ -420,6 +575,84 @@ class PagedKVCache:
                     "injected copy-on-write failure at cache.cow "
                     f"({self.free_blocks} blocks actually free)")
         return m
+
+    def _restore_match(self, m: PrefixMatch) -> PrefixMatch:
+        """Bring a matched chain's host-tier links back on device, in
+        prefix order. Each restore costs one FREE-LIST block (restores
+        never reclaim — the admission path must stay cheap and must not
+        cannibalize the very cache it is hitting). The first link that
+        cannot restore — free list dry, injected ``cache.restore``
+        fault, CRC corruption — TRUNCATES the match there: the already-
+        restored prefix is kept, the tail degrades to a cold-miss
+        re-prefill. Always correct tokens, merely slower."""
+        for i, tier in enumerate(m.tiers):
+            if tier == "device":
+                continue
+            ok = False
+            if self._free:
+                f = self._fire("cache.restore")
+                if f is not None and f.kind == "cache_exhausted":
+                    # injected transfer failure: the host entry SURVIVES
+                    # (a later match retries it); this match degrades
+                    self.host_restore_failures += 1
+                else:
+                    f = self._fire("cache.host_corrupt")
+                    if f is not None and f.kind == "cache_exhausted":
+                        # flip a real byte so the REAL CRC machinery,
+                        # not a shortcut, drives the degrade path
+                        self.host_pool.corrupt(m.block_ids[i])
+                    ok = self._dispatch_restore(m.block_ids[i], i, m)
+            if not ok:
+                return self._truncate_match(m, i)
+        return m
+
+    def _dispatch_restore(self, key: int, i: int, m: PrefixMatch) -> bool:
+        """One host→device block restore: CRC-verified fetch, H2D copy,
+        fixed-shape scatter into a free block, index flip to device.
+        Returns False on corruption (after discarding the poisoned
+        subtree — every descendant's prefix runs through the bad
+        chunk). Mutates ``m`` in place on success."""
+        t0 = time.perf_counter()
+        try:
+            payload = self.host_pool.get(key)
+        except HostCorruption:
+            dev, hosts = self.index.remove_subtree(key)
+            for hk in hosts:
+                self.host_pool.discard(hk)
+            for bid in dev:
+                # device descendants at refcount 0 go straight back to
+                # the free list (they were index-resident, so they are
+                # not on it); held or mid-transfer blocks are settled by
+                # their release / harvest instead
+                if self._refcount[bid] == 0 and \
+                        bid not in self._in_transfer:
+                    self._free.append(bid)
+            self.host_restore_failures += 1
+            if self.tracer is not None:
+                self.tracer.event("cache_restore_corrupt", key=int(key),
+                                  dropped_host=len(hosts),
+                                  dropped_device=len(dev))
+            return False
+        bid = self._free.pop()
+        self._run_scatter(payload, bid)
+        self.index.to_device(key, bid)
+        self.host_pool.discard(key)
+        m.block_ids[i] = bid
+        m.tiers[i] = "device"
+        self.host_restores += 1
+        ms = (time.perf_counter() - t0) * 1000.0
+        self._restore_ms.append(ms)
+        if self.tracer is not None:
+            self.tracer.event("cache_restore", block=bid, key=int(key),
+                              ms=round(ms, 3))
+        return True
+
+    def _truncate_match(self, m: PrefixMatch, i: int) -> PrefixMatch:
+        """Degrade: keep the usable device prefix ``[0, i)``, drop the
+        rest. The COW candidate hangs off the FULL chain's tail, so a
+        truncated match cannot carry it."""
+        return PrefixMatch(block_ids=m.block_ids[:i], tiers=m.tiers[:i],
+                           matched=i * self.block_size)
 
     def ensure_capacity(self, slot: int, n_tokens: int) -> None:
         """Grow the slot's table until it covers ``n_tokens`` (append).
@@ -520,8 +753,13 @@ class PagedKVCache:
                 f"slot {slot} holds {int(self.lengths[slot])} tokens; "
                 f"cannot register {n_full} full blocks before they are "
                 f"written")
-        return self.index.insert(np.asarray(tokens, np.int32),
-                                 self._owned[slot][:n_full])
+        return self.index.insert(
+            np.asarray(tokens, np.int32), self._owned[slot][:n_full],
+            # a re-registered chunk that had spilled flips back to
+            # device on the slot's fresh copy; its host bytes are
+            # redundant the moment the flip lands
+            on_host_displaced=(self.host_pool.discard
+                               if self.host_pool is not None else None))
 
     def warm_cow(self) -> None:
         """Compile the COW copy program up front (trash-block self-copy)
@@ -529,6 +767,155 @@ class PagedKVCache:
         guarded steady state — hits a warm cache."""
         if self.prefix_cache:
             self._run_cow(np.int32(0), np.int32(0))
+
+    def warm_host_tier(self) -> None:
+        """Compile the spill gather and restore scatter up front on
+        trash-block lanes, so every steady-state transfer hits a warm
+        cache — the CompileWatch(0) contract (docs/KV_TIERING.md)."""
+        if not self.host_tier:
+            return
+        ids = np.zeros((self.transfer_blocks,), np.int32)
+        arrs = self._run_gather(ids)
+        payload = tuple(np.asarray(a[:, 0])
+                        for a in jax.device_get(arrs))
+        self._run_scatter(payload, 0)
+
+    # -- host-tier spill daemon ----------------------------------------
+    def spill_tick(self) -> int:
+        """One spill-daemon tick — the serving loop drives this once per
+        step, OFF the admission critical path. Harvests the previous
+        tick's in-flight gather (one batched D2H pull, overlapped with
+        the decode step that ran in between — the double buffer), then,
+        under free-list pressure, dispatches the next fixed-width gather
+        over the LRU spill candidates. Returns blocks landed on host
+        this tick."""
+        if not self.host_tier:
+            return 0
+        landed = self._harvest_spill()
+        if self._pending_spill is not None:
+            return landed
+        if self._spill_cooldown > 0:
+            self._spill_cooldown -= 1
+            return landed
+        if len(self._free) >= self.spill_watermark:
+            return landed
+        f = self._fire("cache.spill")
+        if f is not None and f.kind == "cache_exhausted":
+            # injected transfer failure: the candidates stay device-
+            # resident; exponential backoff before the retry
+            self._note_spill_failure()
+            return landed
+        ids = self.index.spill_candidates(self._reclaimable,
+                                          self.transfer_blocks)
+        if not ids:
+            return landed
+        padded = np.zeros((self.transfer_blocks,), np.int32)
+        padded[:len(ids)] = ids       # short batches pad with trash lanes
+        arrs = self._run_gather(padded)
+        self._in_transfer.update(ids)
+        self._pending_spill = (list(ids), arrs)
+        if self.tracer is not None:
+            self.tracer.event("cache_spill", blocks=[int(b) for b in ids])
+        return landed
+
+    def _harvest_spill(self) -> int:
+        """Settle the in-flight gather: ONE batched device→host pull for
+        the whole buffer, then per block either commit (store on host,
+        flip the index tag, free the device block) or abort (the block
+        was re-claimed or unindexed while its bytes flew — the device
+        copy stays authoritative)."""
+        if self._pending_spill is None:
+            return 0
+        ids, arrs = self._pending_spill
+        self._pending_spill = None
+        host = jax.device_get(arrs)
+        landed = 0
+        for i, bid in enumerate(ids):
+            self._in_transfer.discard(bid)
+            if self._refcount[bid] != 0 or bid not in self.index:
+                self.host_spill_aborts += 1
+                if self._refcount[bid] == 0 and bid not in self.index:
+                    # unindexed mid-flight (corruption cleanup / release
+                    # of a displaced chain): _release deferred to us, so
+                    # this is the block's single return to the free list
+                    self._free.append(bid)
+                continue
+            payload = tuple(np.asarray(a[:, i]) for a in host)
+            key = self.host_pool.put(payload)
+            if key is None:
+                # budget refusal is policy, not failure: the block stays
+                # device-resident and plain eviction remains its fate
+                self.host_budget_refusals += 1
+                self._note_spill_failure()
+                continue
+            self.index.to_host(bid, key)
+            self._free.append(bid)
+            self.host_spills += 1
+            landed += 1
+        if landed:
+            self._spill_backoff = 1
+        return landed
+
+    def _note_spill_failure(self) -> None:
+        """Exponential-backoff cooldown (in daemon ticks, capped): a
+        failing transfer path must not be hammered every step."""
+        self._spill_cooldown = self._spill_backoff
+        self._spill_backoff = min(self._spill_backoff * 2, 64)
+
+    def abort_transfers(self) -> int:
+        """Abort every in-flight spill synchronously — the drain/retire
+        contract: a replica must settle its transfer state BEFORE
+        ``pending_snapshot(release=True)`` hands its requests away. The
+        un-harvested gather is dropped (the candidates simply stay
+        device-resident; JAX discards the orphaned computation) and the
+        in-transfer set is settled so every block is releasable. Returns
+        how many spills were aborted."""
+        aborted = 0
+        if self._pending_spill is not None:
+            ids, _ = self._pending_spill
+            self._pending_spill = None
+            aborted = len(ids)
+            self.host_spill_aborts += aborted
+        for bid in sorted(self._in_transfer):
+            self._in_transfer.discard(bid)
+            if self._refcount[bid] == 0 and not (
+                    self.index is not None and bid in self.index):
+                self._free.append(bid)
+        return aborted
+
+    def drain_restore_ms(self) -> List[float]:
+        """Hand the per-restore wall-clock samples (ms) to the caller
+        (the serving engine feeds its ``kv_host_restore_ms`` histogram
+        on the sampled cadence) and reset the buffer."""
+        out = self._restore_ms
+        self._restore_ms = []
+        return out
+
+    def _run_gather(self, ids: np.ndarray):
+        """Dispatch the (quant-aware) fixed-width spill gather."""
+        if self.quantized:
+            fn = self.gather_fn if self.gather_fn is not None \
+                else _default_gather_q
+            return fn(self.k, self.v, self.k_scale, self.v_scale, ids)
+        fn = self.gather_fn if self.gather_fn is not None \
+            else _default_gather
+        return fn(self.k, self.v, ids)
+
+    def _run_scatter(self, payload: tuple, bid: int) -> None:
+        """Dispatch the (quant-aware) restore scatter, rebinding pools
+        from its donated outputs."""
+        dev_arrays = tuple(jax.device_put(a) for a in payload)
+        if self.quantized:
+            fn = self.scatter_fn if self.scatter_fn is not None \
+                else _default_scatter_q
+            (self.k, self.v, self.k_scale, self.v_scale) = fn(
+                self.k, self.v, self.k_scale, self.v_scale,
+                *dev_arrays, np.int32(bid))
+        else:
+            fn = self.scatter_fn if self.scatter_fn is not None \
+                else _default_scatter
+            self.k, self.v = fn(self.k, self.v, *dev_arrays,
+                                np.int32(bid))
 
     # -- internals -----------------------------------------------------
     def _run_cow(self, src, dst) -> None:
@@ -556,8 +943,7 @@ class PagedKVCache:
         if self._free:
             return self._free.pop()
         if self.index is not None:
-            bid = self.index.pop_evictable(
-                lambda b: self._refcount[b] == 0)
+            bid = self.index.pop_evictable(self._reclaimable)
             if bid is not None:
                 self.cache_block_evictions += 1
                 if self.tracer is not None:
@@ -577,8 +963,11 @@ class PagedKVCache:
         if self._refcount[bid] <= 0:
             raise ValueError(f"double free of block {bid}")
         self._refcount[bid] -= 1
-        if self._refcount[bid] == 0 and not (
-                self.index is not None and bid in self.index):
+        # a mid-transfer block is never returned here even when it drops
+        # unindexed — the harvest's abort path is its single freer (two
+        # freers would race into a double free-list entry)
+        if self._refcount[bid] == 0 and bid not in self._in_transfer \
+                and not (self.index is not None and bid in self.index):
             self._free.append(bid)
 
     def _mark(self):

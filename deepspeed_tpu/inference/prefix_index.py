@@ -30,6 +30,17 @@ query diverges (or simply ends) inside the NEXT block, a copy-on-write
 candidate: the child block sharing the longest leading run of tokens.
 The caller copies that block into a fresh one and overwrites from the
 divergence point — mid-block reuse without ever mutating shared state.
+
+**Tier tags** (docs/KV_TIERING.md): with the host-DRAM tier on, a node
+lives in exactly one of two tiers — ``"device"`` (``block`` names a
+pool block, registered in ``_by_block``) or ``"host"`` (``host_key``
+names a :class:`~deepspeed_tpu.inference.host_tier.HostBlockPool`
+entry, registered in ``_by_host``; ``block`` is -1). :meth:`match`
+returns a parallel ``tiers`` list so the cache can restore host links
+in the chain before mapping it; device-side reclaim
+(:meth:`pop_evictable` / :meth:`evictable_count`) sees ONLY the device
+tier, so a spilled block can never be double-claimed. The cache owns
+the host bytes — this index only carries the tags and the LRU order.
 """
 
 from dataclasses import dataclass, field
@@ -45,9 +56,12 @@ def _chunk_key(tokens: np.ndarray) -> bytes:
 class _Node:
     """One cached block: the full token chunk it holds, the pool block
     id, and radix-tree links. ``last_used`` is the index's logical tick
-    (monotonic), not wall time — LRU must be deterministic for tests."""
+    (monotonic), not wall time — LRU must be deterministic for tests.
+    ``tier`` is ``"device"`` (``block`` valid) or ``"host"``
+    (``host_key`` valid, ``block`` = -1)."""
 
-    __slots__ = ("chunk", "block", "parent", "children", "last_used")
+    __slots__ = ("chunk", "block", "parent", "children", "last_used",
+                 "tier", "host_key")
 
     def __init__(self, chunk: np.ndarray, block: int,
                  parent: Optional["_Node"]):
@@ -56,6 +70,8 @@ class _Node:
         self.parent = parent
         self.children: Dict[bytes, "_Node"] = {}
         self.last_used = 0
+        self.tier = "device"
+        self.host_key: Optional[int] = None
 
 
 @dataclass
@@ -64,11 +80,18 @@ class PrefixMatch:
     of fully-shared blocks (map read-only), ``cow_src``/``cow_tokens``
     the optional partially-matching block to copy-on-write (reuse its
     first ``cow_tokens`` positions). ``matched`` counts total reusable
-    tokens: ``len(block_ids) * block_size + cow_tokens``."""
+    tokens: ``len(block_ids) * block_size + cow_tokens``.
+
+    ``tiers`` parallels ``block_ids``: ``"device"`` entries are pool
+    block ids, ``"host"`` entries are host-pool keys the cache must
+    restore before the chain is mappable (the COW candidate is always
+    device-tier). Empty ``tiers`` with a non-empty chain means
+    all-device — the single-tier reading every pre-tier caller used."""
     block_ids: List[int] = field(default_factory=list)
     matched: int = 0
     cow_src: Optional[int] = None
     cow_tokens: int = 0
+    tiers: List[str] = field(default_factory=list)
 
 
 class PrefixIndex:
@@ -78,13 +101,22 @@ class PrefixIndex:
         self.block_size = int(block_size)
         self._root = _Node(np.zeros((0,), np.int32), -1, None)
         self._by_block: Dict[int, _Node] = {}
+        # host-tier nodes keyed by their HostBlockPool key — kept OUT of
+        # _by_block so every device-side predicate (``refcount[b]``,
+        # ``b in index``) stays safe against key/id collisions
+        self._by_host: Dict[int, _Node] = {}
         self._tick = 0
 
     def __len__(self) -> int:
+        """Device-tier nodes only (the pre-tier contract);
+        :meth:`host_len` counts the spilled side."""
         return len(self._by_block)
 
     def __contains__(self, block_id: int) -> bool:
         return int(block_id) in self._by_block
+
+    def host_len(self) -> int:
+        return len(self._by_host)
 
     def _touch(self, node: _Node) -> None:
         self._tick += 1
@@ -109,16 +141,22 @@ class PrefixIndex:
             if child is None:
                 break
             node = child
-            m.block_ids.append(child.block)
+            m.block_ids.append(child.block if child.tier == "device"
+                               else child.host_key)
+            m.tiers.append(child.tier)
             m.matched += bs
             if touch:
                 self._touch(child)
         # divergent / final partial block: the child sharing the longest
-        # leading token run is the copy-on-write candidate
+        # leading token run is the copy-on-write candidate — device-tier
+        # only (a host block's bytes are not addressable by the COW copy
+        # program; a spilled near-miss degrades to a plain miss)
         rem = tokens[m.matched:max_tokens]
         if len(rem) > 0:
             best, best_j = None, 0
             for child in node.children.values():
+                if child.tier != "device":
+                    continue
                 j = _common_prefix_len(child.chunk, rem)
                 if j > best_j:
                     best, best_j = child, j
@@ -131,12 +169,21 @@ class PrefixIndex:
         return m
 
     # -- registration --------------------------------------------------
-    def insert(self, tokens: np.ndarray, block_ids: List[int]) -> int:
+    def insert(self, tokens: np.ndarray, block_ids: List[int],
+               on_host_displaced: Optional[Callable[[int], None]] = None
+               ) -> int:
         """Register a chain: chunk ``i`` of ``tokens`` lives in
         ``block_ids[i]``. Chunks already cached keep their EXISTING
         block (the caller's duplicate stays private and is freed with
         its slot); new chunks extend the tree. Returns how many blocks
-        were newly registered."""
+        were newly registered.
+
+        A chunk whose node sits in the HOST tier is upgraded in place:
+        the registering slot just prefilled a fresh device copy (that's
+        why it is re-registering), which is at least as authoritative
+        as the spilled bytes — the node flips back to device on the new
+        block and ``on_host_displaced(host_key)`` lets the cache
+        discard the now-redundant host entry."""
         tokens = np.asarray(tokens, np.int32)
         bs = self.block_size
         n_full = min(len(tokens) // bs, len(block_ids))
@@ -157,17 +204,114 @@ class PrefixIndex:
                 node.children[key] = child
                 self._by_block[bid] = child
                 added += 1
+            elif child.tier == "host":
+                bid = int(block_ids[i])
+                if bid in self._by_block:
+                    raise ValueError(
+                        f"block {bid} is already registered in the index")
+                displaced = child.host_key
+                del self._by_host[displaced]
+                child.tier = "device"
+                child.host_key = None
+                child.block = bid
+                self._by_block[bid] = child
+                if on_host_displaced is not None:
+                    on_host_displaced(displaced)
+                added += 1
             self._touch(child)
             node = child
         return added
 
+    # -- tier transitions ----------------------------------------------
+    def to_host(self, block_id: int, host_key: int) -> None:
+        """Flip a device node to the host tier: ``block_id`` leaves the
+        device namespace (the pool block is the CACHE's to free) and
+        ``host_key`` names the spilled bytes from here on."""
+        node = self._by_block.pop(int(block_id))
+        node.tier = "host"
+        node.host_key = int(host_key)
+        node.block = -1
+        self._by_host[node.host_key] = node
+
+    def to_device(self, host_key: int, block_id: int) -> None:
+        """Flip a host node back to the device tier onto the freshly
+        restored ``block_id`` (the cache already scattered the bytes)."""
+        node = self._by_host.pop(int(host_key))
+        node.tier = "device"
+        node.host_key = None
+        node.block = int(block_id)
+        self._by_block[node.block] = node
+
+    def spill_candidates(self, can_spill: Callable[[int], bool],
+                         limit: int) -> List[int]:
+        """Up to ``limit`` device-tier blocks passing ``can_spill``
+        (the cache's refcount-0-and-not-in-transfer test), least
+        recently used first — the spill daemon's shopping list. Unlike
+        :meth:`pop_evictable` this may name INTERIOR nodes: a spilled
+        interior keeps its subtree reachable (the chain restores link
+        by link), whereas device eviction severs it."""
+        cands = [n for n in self._by_block.values() if can_spill(n.block)]
+        cands.sort(key=lambda n: n.last_used)
+        return [n.block for n in cands[:int(limit)]]
+
+    def remove_subtree(self, host_key: int):
+        """Remove the host node ``host_key`` AND every descendant (their
+        prefixes run through the doomed chunk, so none is servable once
+        it goes). Returns ``(device_ids, host_keys)`` of everything
+        unregistered — the cache reclaims the pool blocks it can and
+        discards the host entries. The corruption degrade path."""
+        node = self._by_host.get(int(host_key))
+        if node is None:
+            return [], []
+        dev: List[int] = []
+        hosts: List[int] = []
+
+        def walk(n: _Node) -> None:
+            for c in list(n.children.values()):
+                walk(c)
+            n.children.clear()
+            if n.tier == "host":
+                hosts.append(n.host_key)
+                self._by_host.pop(n.host_key, None)
+            else:
+                dev.append(n.block)
+                self._by_block.pop(n.block, None)
+            n.parent.children.pop(_chunk_key(n.chunk), None)
+
+        walk(node)
+        return dev, hosts
+
     # -- eviction ------------------------------------------------------
+    def _host_pinned(self) -> frozenset:
+        """Device blocks no leaf-first cascade can ever reach: the
+        ancestors of host-tier nodes. A host child never leaves via
+        device eviction, so its device ancestors are permanently
+        interior — counting them as reclaimable would let the
+        allocator's availability check pass and then strand
+        ``pop_evictable`` mid-allocation."""
+        if not self._by_host:
+            return frozenset()
+        pinned = set()
+        for n in self._by_host.values():
+            p = n.parent
+            while p is not None and p is not self._root:
+                if p.tier == "device":
+                    if p.block in pinned:
+                        break       # shared ancestor chain already walked
+                    pinned.add(p.block)
+                p = p.parent
+        return frozenset(pinned)
+
     def evictable_count(self, can_evict: Callable[[int], bool]) -> int:
         """How many cached blocks could be reclaimed right now — every
         indexed block the predicate clears, since leaf-first pops expose
         interior nodes as they go (refcount(parent) >= refcount(child),
-        so a clearable interior implies clearable descendants)."""
-        return sum(1 for bid in self._by_block if can_evict(bid))
+        so a clearable interior implies clearable descendants) — minus
+        the ancestors of host-tier nodes, which the cascade can never
+        expose (see :meth:`_host_pinned`)."""
+        blocked = self._host_pinned()
+        return sum(1 for bid in self._by_block
+                   if can_evict(bid) and bid not in blocked)
 
     def pop_evictable(self, can_evict: Callable[[int], bool]
                       ) -> Optional[int]:

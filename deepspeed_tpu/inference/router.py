@@ -641,7 +641,13 @@ class ReplicaRouter:
                 crashed = True
                 break
         self._absorb_terminal(rep)
+        # pending_snapshot(release=True) settles the dead replica's
+        # in-flight host-tier spills first (abort_transfers); record how
+        # many were cut short so a chaos run's timeline shows the
+        # drain/spill interaction explicitly
+        spill_aborts_before = rep.srv.cache.host_spill_aborts
         snap = rep.srv.pending_snapshot(release=True)
+        spill_aborts = rep.srv.cache.host_spill_aborts - spill_aborts_before
         reqs = [ServeRequest.from_snapshot(s) for s in snap
                 if s["rid"] not in self._results]
         placed = 0
@@ -657,7 +663,8 @@ class ReplicaRouter:
             self._stat["drained_requests"].inc()
         self.telemetry.tracer.event(
             "drain", step=self._clock, replica=rep.idx,
-            resumed=placed, rids=[r.rid for r in reqs])
+            resumed=placed, rids=[r.rid for r in reqs],
+            spill_aborts=spill_aborts)
         logger.warning(
             f"router: drained {placed}/{len(reqs)} in-flight requests "
             f"from replica {rep.idx} onto survivors")

@@ -141,6 +141,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.inference import sampling
+from deepspeed_tpu.inference.host_tier import resolve_host_tier
 from deepspeed_tpu.inference.paged_cache import (CacheExhausted,
                                                  PagedKVCache,
                                                  resolve_prefix_cache)
@@ -187,6 +188,15 @@ _STAT_FIELDS = (
     ("stop_hits", "c", "requests finished by a stop sequence"),
     ("spec_k_capped", "c", "verify participations depth-capped by low "
                            "acceptance"),
+    # host-tier mirrors (gauges set from the cache's own counters each
+    # step, so the serving stats contract exposes them without a second
+    # source of truth)
+    ("host_blocks", "g", "KV blocks resident on the host-DRAM tier"),
+    ("host_bytes", "g", "host-DRAM bytes held by spilled KV blocks"),
+    ("host_spills", "g", "blocks spilled device->host (total)"),
+    ("host_restores", "g", "blocks restored host->device (total)"),
+    ("host_restore_failures", "g", "restores degraded to re-prefill "
+                                   "(faults, corruption, dry free list)"),
 )
 
 
@@ -412,6 +422,17 @@ class ServingEngine:
       ``"int8"``/``"off"``; None defers to ``DS_KV_QUANT`` (default
       off — the unquantized pool stays the bit-reference; int8 is
       held to a documented greedy-match tolerance, not bit equality).
+    - ``host_tier`` / ``host_budget_bytes``: host-DRAM second tier for
+      refcount-zero cached prefix blocks (docs/KV_TIERING.md) — a
+      low-watermark spill daemon rides each step's decode dispatch and
+      a prefix hit on spilled links restores instead of re-prefilling.
+      Requires ``prefix_cache``; restores/spills degrade to cold-miss
+      re-prefill / plain eviction on any failure (CRC corruption,
+      injected faults, budget exhaustion). None defers to
+      ``DS_KV_HOST_TIER`` / ``DS_KV_HOST_BUDGET_MB`` (default off —
+      the device-only cache stays the bit-reference).
+      ``spill_watermark`` pins the free-list level below which the
+      daemon spills (None = cache watermark + transfer batch).
     """
 
     def __init__(self, engine, *, num_slots: int = 4, block_size: int = 16,
@@ -433,7 +454,10 @@ class ServingEngine:
                  spec_draft=None,
                  spec_accept_floor: float = 0.125,
                  spec_adapt_warmup: int = 4,
-                 kv_quant: Optional[str] = None):
+                 kv_quant: Optional[str] = None,
+                 host_tier: Optional[bool] = None,
+                 host_budget_bytes: Optional[int] = None,
+                 spill_watermark: Optional[int] = None):
         if engine.is_encoder:
             raise ValueError("serving needs a causal decoder engine")
         self.engine = engine
@@ -463,14 +487,28 @@ class ServingEngine:
         self._quant = self.kv_quant == "int8"
         cow = getattr(engine, "cow_blocks_q" if self._quant
                       else "cow_blocks", None)
+        # host-tier transfer programs: like COW, the engine's jitted
+        # (and correctly-sharded) gather/scatter are wired in when
+        # present; the quantized pair moves the scale sidecars too
+        gather = getattr(engine, "gather_blocks_q" if self._quant
+                         else "gather_blocks", None)
+        scatter = getattr(engine, "scatter_block_q" if self._quant
+                          else "scatter_block", None)
         self.cache = PagedKVCache(
             engine.cfg, num_slots=num_slots, block_size=block_size,
             num_blocks=num_blocks, hbm_budget_bytes=hbm_budget_bytes,
             dtype=engine.dtype, max_seq_len=engine.max_seq_len,
             faults=self.faults, prefix_cache=self.prefix_cache,
             copy_fn=cow, kv_quant=self.kv_quant,
+            host_tier=resolve_host_tier(host_tier),
+            host_budget_bytes=host_budget_bytes,
+            spill_watermark=spill_watermark,
+            gather_fn=gather, scatter_fn=scatter,
             tracer=self.telemetry.tracer
             if self.telemetry.enabled else None)
+        # the EFFECTIVE switch: the cache gates the tier on the prefix
+        # index existing (only indexed blocks ever spill)
+        self.host_tier = self.cache.host_tier
         mesh = getattr(engine, "mesh", None)
         if mesh is not None:
             # place the fresh pools exactly where the jitted programs
@@ -492,6 +530,9 @@ class ServingEngine:
         # mid-block divergence must not add a compile inside the
         # CompileWatch-pinned steady state
         self.cache.warm_cow()
+        # same contract for the host-tier transfer programs: the first
+        # spill/restore must not compile inside the pinned steady state
+        self.cache.warm_host_tier()
         self.num_slots = num_slots
         self.prefill_chunk = int(prefill_chunk)
         self.temperature = temperature
@@ -608,6 +649,20 @@ class ServingEngine:
                 buckets=(1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3,
                          1e-2, 2.5e-2, 5e-2, 1e-1, 2.5e-1)) \
                 if self._quant else None
+            # host-tier plane (docs/KV_TIERING.md): DRAM footprint gauge
+            # plus per-restore latency histogram — restores sit on the
+            # admission path, so their tail IS the warm-hit TTFT tax
+            self._g_host_bytes = reg.gauge(
+                "kv_host_tier_bytes",
+                "host-DRAM bytes held by spilled KV blocks") \
+                if self.host_tier else None
+            self._h_host_restore = reg.histogram(
+                "kv_host_restore_ms",
+                "per-block host->device restore wall time (CRC verify "
+                "+ H2D copy + scatter dispatch, ms)",
+                buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                         25.0, 50.0, 100.0)) \
+                if self.host_tier else None
 
             def _on_fault(site: str, kind: str, visit: int) -> None:
                 # injected faults land in the SAME timeline as the
@@ -623,6 +678,7 @@ class ServingEngine:
             self._h_ttft = self._h_tpot = self._h_qwait = self._h_occ = None
             self._h_accept = self._h_tps = self._h_temp = None
             self._h_kv_err = None
+            self._g_host_bytes = self._h_host_restore = None
             self._fault_listener = None
 
     # -- API -----------------------------------------------------------
@@ -716,6 +772,7 @@ class ServingEngine:
         self._prefill_step(now)
         bd.lap("prefill")
         occ = self._decode_step(now)
+        self._spill_step()
         bd.lap("decode")
         self._step_clock += 1
         self._stat["steps"].inc()
@@ -778,6 +835,11 @@ class ServingEngine:
         for pos, r in enumerate(self.queue):
             snap.append(snapshot_entry(r, queue_pos=pos))
         if release:
+            # drain/retire contract (docs/KV_TIERING.md): in-flight
+            # spills settle BEFORE any slot releases — a mid-transfer
+            # block must be releasable like any other, and the snapshot
+            # path must never race a harvest
+            self.cache.abort_transfers()
             for slot, r in enumerate(self.slots):
                 if r is not None:
                     self.cache.free(slot)
@@ -1192,6 +1254,43 @@ class ServingEngine:
         return len(live)
 
     # -- helpers ---------------------------------------------------------
+    def _spill_step(self) -> None:
+        """Host-tier daemon tick: runs right AFTER the decode dispatch
+        (the gather it queues overlaps the decode program; last tick's
+        gather is harvested here, a full step after dispatch — the
+        double buffer) and never on the admission path. Billed inside
+        the decode breakdown lap so the phase set is unchanged. The
+        tick's host time answers to the step watchdog, but only an
+        over-budget tick may strike — an in-budget tick must not reset
+        the decode dispatch's own strikes."""
+        if not self.host_tier:
+            return
+        t0 = time.perf_counter()
+        self.cache.spill_tick()
+        self._sync_host_stats()
+        if self.step_time_budget_s is not None:
+            elapsed = time.perf_counter() - t0
+            if elapsed > self.step_time_budget_s:
+                self._watchdog_note(elapsed)
+
+    def _sync_host_stats(self) -> None:
+        """Mirror the cache's host-tier counters into the serving stats
+        (single source of truth stays in the cache) and feed the
+        restore-latency histogram from the samples the cache buffered
+        since the last tick."""
+        c = self.cache
+        self._stat["host_blocks"].set(c.host_blocks)
+        self._stat["host_bytes"].set(c.host_bytes)
+        self._stat["host_spills"].set(c.host_spills)
+        self._stat["host_restores"].set(c.host_restores)
+        self._stat["host_restore_failures"].set(c.host_restore_failures)
+        samples = c.drain_restore_ms()
+        if self._g_host_bytes is not None:
+            self._g_host_bytes.set(c.host_bytes)
+        if self._h_host_restore is not None:
+            for ms in samples:
+                self._h_host_restore.observe(ms)
+
     def _watchdog_note(self, elapsed: float) -> None:
         """Score one decode/verify dispatch against the step budget:
         consecutive over-budget dispatches accumulate strikes until the

@@ -31,6 +31,19 @@ Sites currently instrumented:
                        and still BEFORE the device dispatch — donated
                        pool/scale buffers are untouched, so the
                        serving retry replays the step safely
+``cache.spill``        before a spill batch's gather dispatch in the
+                       host-tier spill daemon (``spill_tick``);
+                       ``cache_exhausted`` skips the batch — blocks
+                       stay device-resident behind exponential backoff
+``cache.restore``      before a host→device block restore on a prefix
+                       match; ``cache_exhausted`` truncates the match
+                       there (the tail re-prefills; the host entry
+                       survives for a later retry)
+``cache.host_corrupt`` at restore time, AFTER ``cache.restore``
+                       passed; ``cache_exhausted`` flips a real byte of
+                       the stored block so the CRC32 check itself
+                       drives the degrade path (chain discarded,
+                       cold-miss re-prefill — never wrong tokens)
 ``engine.decode``      ``InferenceEngine.decode_slots`` public wrapper
 ``engine.verify``      ``InferenceEngine.verify_slots`` public wrapper
                        (speculative verify); the scheduler degrades the
@@ -129,7 +142,7 @@ KNOWN_SITES = {
     "serving.decode", "serving.prefill", "serving.spec_draft",
     "engine.prefill", "engine.decode", "engine.verify",
     "cache.allocate", "cache.ensure", "cache.match", "cache.cow",
-    "cache.quantize",
+    "cache.quantize", "cache.spill", "cache.restore", "cache.host_corrupt",
     "checkpoint.pre_commit", "checkpoint.commit",
     "router.dispatch", "router.step", "router.drain",
 }

@@ -1,0 +1,671 @@
+"""Tiered KV cache tests (tentpole: host-DRAM second tier for
+refcount-zero cached prefix blocks in inference/host_tier.py /
+inference/paged_cache.py, wired through the serving scheduler).
+
+The contract under test (docs/KV_TIERING.md):
+
+  1. ``DS_KV_HOST_TIER=off`` (the default) is BIT-IDENTICAL to the
+     device-only cache — the off path stays the bit-reference;
+  2. spilled-then-restored prefix blocks produce TOKEN-IDENTICAL
+     streams to a cold re-prefill (the acceptance gate: the tier moves
+     bytes, never changes tokens);
+  3. every failure degrades, never corrupts: a dry free list, an
+     injected ``cache.spill``/``cache.restore`` fault or a CRC
+     mismatch (``cache.host_corrupt`` flips a REAL byte) ends in a
+     cold-miss re-prefill or a plain eviction;
+  4. the steady state compiles NOTHING — the fixed-width gather /
+     scatter transfer programs are pre-warmed;
+  5. interplay: int8 scale sidecars ride the spill, speculative decode
+     and router drain keep their invariants with the tier active.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.engine import InferenceEngine
+from deepspeed_tpu.inference.host_tier import (HostBlockPool,
+                                               HostCorruption,
+                                               resolve_host_budget,
+                                               resolve_host_tier)
+from deepspeed_tpu.inference.paged_cache import (CacheExhausted,
+                                                 PagedKVCache)
+from deepspeed_tpu.inference.prefix_index import PrefixIndex
+from deepspeed_tpu.inference.router import ReplicaRouter
+from deepspeed_tpu.inference.serving import ServeRequest, ServingEngine
+from deepspeed_tpu.models import gpt
+from deepspeed_tpu.utils import faults as faults_lib
+from deepspeed_tpu.utils.faults import Fault
+
+
+def tiny(**over):
+    cfg = gpt.GPTConfig(vocab_size=128, n_layers=2, n_heads=4, d_model=32,
+                        max_seq_len=96, use_flash_attention=False,
+                        remat=False, dtype=jnp.float32, **over)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def eng(devices):
+    cfg, params = tiny()
+    return InferenceEngine(config=cfg, params=params, dtype=jnp.float32)
+
+
+def _solo_refs(eng, prompts, n):
+    return [eng.generate(p[None], max_new_tokens=n)[0] for p in prompts]
+
+
+def toks(*vals):
+    return np.asarray(vals, np.int32)
+
+
+def _arrays(seed=0, shape=(2, 4, 3)):
+    r = np.random.default_rng(seed)
+    return tuple(r.standard_normal(shape).astype(np.float32)
+                 for _ in range(2))
+
+
+# ---------------------------------------------------------------------------
+# HostBlockPool unit tests (pure host)
+# ---------------------------------------------------------------------------
+
+def test_pool_put_get_roundtrip_and_budget_accounting():
+    pool = HostBlockPool(budget_bytes=1 << 20)
+    a = _arrays(0)
+    k = pool.put(a)
+    assert k is not None and len(pool) == 1
+    assert pool.bytes_used == sum(x.nbytes for x in a)
+    got = pool.get(k)
+    for x, y in zip(a, got):
+        np.testing.assert_array_equal(x, y)
+    # the stored copy is independent of the caller's buffers
+    a[0][...] = 0.0
+    assert not np.array_equal(pool.get(k)[0], a[0])
+    pool.discard(k)
+    assert len(pool) == 0 and pool.bytes_used == 0
+    # keys are monotone, never reused — a stale key can never alias a
+    # fresh entry
+    k2 = pool.put(_arrays(1))
+    assert k2 != k
+    with pytest.raises(KeyError):
+        pool.get(k)
+
+
+def test_pool_crc_detects_corruption():
+    pool = HostBlockPool(budget_bytes=1 << 20)
+    k = pool.put(_arrays(2))
+    pool.corrupt(k)                       # flips a REAL stored byte
+    with pytest.raises(HostCorruption) as e:
+        pool.get(k)
+    assert "0x" in str(e.value)           # names the stored checksum
+    pool.discard(k)                       # poisoned entries still free
+    assert pool.bytes_used == 0
+
+
+def test_pool_budget_refusal_and_discard_idempotent():
+    a = _arrays(3)
+    pool = HostBlockPool(budget_bytes=sum(x.nbytes for x in a))
+    k = pool.put(a)
+    assert k is not None
+    assert pool.put(_arrays(4)) is None   # over budget: refused, not oom
+    pool.discard(k)
+    pool.discard(k)                       # idempotent
+    assert pool.put(_arrays(4)) is not None  # budget freed by discard
+
+
+def test_host_tier_env_resolution(monkeypatch):
+    monkeypatch.delenv("DS_KV_HOST_TIER", raising=False)
+    assert resolve_host_tier(None) is False          # default off
+    assert resolve_host_tier(True) is True
+    monkeypatch.setenv("DS_KV_HOST_TIER", "on")
+    assert resolve_host_tier(None) is True
+    assert resolve_host_tier(False) is False         # arg wins over env
+    monkeypatch.setenv("DS_KV_HOST_TIER", "banana")
+    with pytest.raises(ValueError):
+        resolve_host_tier(None)
+    monkeypatch.setenv("DS_KV_HOST_BUDGET_MB", "2")
+    assert resolve_host_budget(None) == 2 << 20
+
+
+# ---------------------------------------------------------------------------
+# PrefixIndex tier tags
+# ---------------------------------------------------------------------------
+
+def test_index_to_host_and_back_roundtrip():
+    ix = PrefixIndex(block_size=2)
+    ix.insert(toks(1, 2, 3, 4, 5, 6), [7, 8, 9])
+    ix.to_host(8, host_key=100)
+    assert len(ix) == 2 and ix.host_len() == 1
+    m = ix.match(toks(1, 2, 3, 4, 5, 6, 0), max_tokens=6)
+    assert m.tiers == ["device", "host", "device"]
+    assert m.block_ids == [7, 100, 9]     # host links carry HOST keys
+    ix.to_device(100, 8)
+    assert ix.host_len() == 0
+    m = ix.match(toks(1, 2, 3, 4, 5, 6, 0), max_tokens=6)
+    assert m.tiers == ["device"] * 3 and m.block_ids == [7, 8, 9]
+
+
+def test_index_host_keys_never_collide_with_block_ids():
+    """A host key NUMERICALLY equal to a live device block id must not
+    alias it — host entries live in their own namespace."""
+    ix = PrefixIndex(block_size=2)
+    ix.insert(toks(1, 2, 3, 4), [5, 6])
+    ix.to_host(6, host_key=5)             # same number as device block 5
+    assert 5 in ix                        # device node untouched
+    m = ix.match(toks(1, 2, 3, 4, 0), max_tokens=4)
+    assert m.tiers == ["device", "host"]
+    assert m.block_ids == [5, 5]          # one device id, one host key
+
+
+def test_index_cow_candidate_skips_host_links():
+    """A partial tail block on HOST is not a COW candidate — the COW
+    program addresses device pool bytes only; the match degrades to a
+    plain (shorter) match instead."""
+    ix = PrefixIndex(block_size=2)
+    ix.insert(toks(1, 2, 3, 4), [5, 6])
+    m = ix.match(toks(1, 2, 3, 9, 9), max_tokens=5)
+    assert m.cow_src == 6                 # device: mid-block COW offered
+    ix.to_host(6, host_key=0)
+    m = ix.match(toks(1, 2, 3, 9, 9), max_tokens=5)
+    assert m.cow_src is None and m.matched == 2
+
+
+def test_index_host_pinned_ancestors_not_evictable():
+    """A device node with a HOST child can never leave leaf-first (the
+    host child never leaves via device eviction), so evictable_count
+    must not offer it — an overcount would let allocate start claiming
+    and then die mid-allocation."""
+    ix = PrefixIndex(block_size=2)
+    ix.insert(toks(1, 2, 3, 4, 5, 6), [7, 8, 9])
+    ix.to_host(9, host_key=0)             # leaf to host: 7-8 both pinned
+    assert ix.evictable_count(lambda b: True) == 0
+    assert ix.pop_evictable(lambda b: True) is None
+    ix.to_device(0, 9)                    # back on device: all 3 again
+    assert ix.evictable_count(lambda b: True) == 3
+    assert ix.pop_evictable(lambda b: True) == 9     # leaf-first order
+
+
+def test_index_spill_candidates_lru_and_interior():
+    ix = PrefixIndex(block_size=2)
+    ix.insert(toks(1, 2, 3, 4), [5, 6])
+    ix.insert(toks(1, 2, 9, 9), [5, 8])
+    ix.match(toks(1, 2, 9, 9, 0), max_tokens=4)      # 8 (and 5) recent
+    cands = ix.spill_candidates(lambda b: True, limit=8)
+    assert cands[0] == 6                  # stale branch goes first
+    assert 5 in cands                     # INTERIOR nodes are offered
+    assert ix.spill_candidates(lambda b: b == 8, limit=8) == [8]
+
+
+def test_index_insert_over_host_node_upgrades_it():
+    """Re-prefilling a chunk whose node sits on host (the degrade path
+    re-computed it) upgrades the node to device and reports the
+    displaced host key so the cache can discard the stale copy."""
+    ix = PrefixIndex(block_size=2)
+    ix.insert(toks(1, 2, 3, 4), [5, 6])
+    ix.to_host(6, host_key=42)
+    dropped = []
+    added = ix.insert(toks(1, 2, 3, 4), [5, 11], on_host_displaced=dropped.append)
+    assert added == 1 and dropped == [42]
+    assert ix.host_len() == 0 and 11 in ix
+    m = ix.match(toks(1, 2, 3, 4, 0), max_tokens=4)
+    assert m.block_ids == [5, 11] and m.tiers == ["device", "device"]
+
+
+def test_index_remove_subtree_discards_descendants():
+    ix = PrefixIndex(block_size=2)
+    ix.insert(toks(1, 2, 3, 4, 5, 6), [7, 8, 9])
+    ix.insert(toks(1, 2, 3, 4, 7, 7), [7, 8, 10])
+    ix.to_host(8, host_key=0)
+    ix.to_host(10, host_key=1)
+    dev, hosts = ix.remove_subtree(0)     # poisoned chunk at host key 0
+    assert sorted(dev) == [9] and sorted(hosts) == [0, 1]
+    assert len(ix) == 1 and ix.host_len() == 0       # only root child 7
+    m = ix.match(toks(1, 2, 3, 4, 5, 6, 0), max_tokens=6)
+    assert m.block_ids == [7] and m.matched == 2
+
+
+# ---------------------------------------------------------------------------
+# cache-level spill / restore mechanics
+# ---------------------------------------------------------------------------
+
+def cache_of(num_blocks=12, block_size=4, watermark=0, **kw):
+    cfg, _ = tiny()
+    kw.setdefault("prefix_cache", True)
+    kw.setdefault("host_tier", True)
+    kw.setdefault("spill_watermark", 99)  # constant pressure for tests
+    kw.setdefault("transfer_blocks", 2)
+    return PagedKVCache(cfg, num_slots=4, block_size=block_size,
+                        num_blocks=num_blocks, dtype=jnp.float32,
+                        watermark=watermark, **kw)
+
+
+def prefilled(c, slot, tokens):
+    m = c.allocate(slot, len(tokens), tokens=tokens)
+    c.lengths[slot] = len(tokens)
+    c.register_prefix(slot, tokens)
+    return m
+
+
+def _spill_all(c, ticks=6):
+    for _ in range(ticks):
+        c.spill_tick()
+
+
+def test_cache_spill_restore_bit_roundtrip():
+    """The headline mechanics: cached blocks spill to host (free list
+    grows), a later matching admission restores them, and the restored
+    pool bytes are BIT-IDENTICAL to what was spilled."""
+    c = cache_of()
+    t = np.arange(1, 13, dtype=np.int32)             # 3 blocks @ bs=4
+    prefilled(c, 0, t)
+    bids = list(c._owned[0][:2])
+    # stamp recognizable bytes so the round-trip is a REAL bit check
+    for j, b in enumerate(bids):
+        c.k = c.k.at[:, b].set(float(j + 1))
+        c.v = c.v.at[:, b].set(float(-(j + 1)))
+    before = [(np.asarray(c.k[:, b]).copy(), np.asarray(c.v[:, b]).copy())
+              for b in bids]
+    c.free(0)
+    free0 = len(c._free)
+    _spill_all(c)
+    assert c.host_spills >= 2 and c.host_blocks >= 2
+    assert len(c._free) > free0           # spilled blocks were freed
+    assert c.host_bytes == c.host_pool.bytes_used > 0
+    # warm re-admission: the host links restore (free list has room)
+    m = c.allocate(1, 12, tokens=t)
+    assert m >= 8 and c.host_restores >= 2
+    after_bids = c._owned[1][:2]
+    for (k0, v0), b in zip(before, after_bids):
+        np.testing.assert_array_equal(np.asarray(c.k[:, b]), k0)
+        np.testing.assert_array_equal(np.asarray(c.v[:, b]), v0)
+    assert len(c.drain_restore_ms()) >= 2            # latency samples
+    assert c.drain_restore_ms() == []     # drained: swap-and-return
+
+
+def test_cache_restore_is_free_list_only_and_truncates():
+    """A dry free list TRUNCATES the match at the first host link — the
+    restored prefix is kept, the tail re-prefills cold, and the host
+    entry SURVIVES for a later retry."""
+    c = cache_of(num_blocks=8)
+    t = np.arange(1, 9, dtype=np.int32)
+    prefilled(c, 0, t)
+    c.free(0)
+    _spill_all(c)
+    assert c.host_blocks == 2
+    # hold EVERY free block in one slot so restores cannot draw
+    c.allocate(1, len(c._free) * c.block_size)
+    assert len(c._free) == 0
+    t12 = np.arange(1, 13, dtype=np.int32)
+    with pytest.raises(CacheExhausted):
+        # nothing free, nothing evictable -> the admission fails, but
+        # the attempted restore must NOT have consumed the host copies
+        c.allocate(2, 12, tokens=t12)
+    assert c.host_blocks == 2 and c.host_restores == 0
+    # release the hoarder: the SAME host entries now restore cleanly
+    c.free(1)
+    m = c.allocate(2, 12, tokens=t12)
+    assert m >= 8 and c.host_restores == 2 and c.host_blocks == 0
+
+
+def test_cache_in_transfer_blocks_are_not_reclaimable():
+    """Mid-flight spill sources are excluded from EVERY reclaim path
+    until the harvest settles them — eviction or release while the
+    bytes fly would hand the block to two owners."""
+    c = cache_of()
+    t = np.arange(1, 9, dtype=np.int32)
+    prefilled(c, 0, t)
+    c.free(0)
+    c.spill_tick()                        # dispatch only: nothing landed
+    assert c._pending_spill is not None and len(c._in_transfer) == 2
+    inflight = set(c._in_transfer)
+    assert all(not c._reclaimable(b) for b in inflight)
+    assert c.index.pop_evictable(c._reclaimable) is None
+    assert all(b not in c._free for b in inflight)
+    c.spill_tick()                        # harvest settles them
+    assert not c._in_transfer and c.host_spills == 2
+
+
+def test_cache_harvest_aborts_repinned_block():
+    """A block re-claimed while its bytes were in flight must NOT land
+    on host (the device copy stays authoritative) and must NOT be
+    freed by the harvest."""
+    c = cache_of()
+    t = np.arange(1, 9, dtype=np.int32)
+    prefilled(c, 0, t)
+    c.free(0)
+    c.spill_tick()                        # dispatch
+    bid = next(iter(c._in_transfer))
+    c._refcount[bid] += 1                 # simulate allocate pinning it
+    c.spill_tick()                        # harvest
+    assert c.host_spill_aborts >= 1
+    assert bid in c.index and bid not in c._free
+    c._refcount[bid] -= 1                 # settle the simulated pin
+
+
+def test_cache_budget_exhaustion_degrades_to_plain_eviction():
+    """A full host budget refuses the landing (budget_refusals counts
+    it), the block stays device-cached, and ordinary LRU eviction still
+    reclaims it — graceful degradation, not an error."""
+    c = cache_of(host_budget_bytes=1)     # nothing fits
+    t = np.arange(1, 9, dtype=np.int32)
+    prefilled(c, 0, t)
+    c.free(0)
+    cached = c.cached_blocks
+    _spill_all(c)
+    assert c.host_budget_refusals >= 1 and c.host_spills == 0
+    assert c.host_blocks == 0 and c.cached_blocks == cached
+    assert c._spill_cooldown > 0          # backoff armed
+    # plain eviction still works on those very blocks
+    assert c.index.pop_evictable(c._reclaimable) is not None
+
+
+def test_cache_spill_backoff_doubles_and_resets():
+    c = cache_of(host_budget_bytes=1)
+    t = np.arange(1, 9, dtype=np.int32)
+    prefilled(c, 0, t)
+    c.free(0)
+    backoffs = []
+    for _ in range(30):
+        c.spill_tick()
+        backoffs.append(c._spill_backoff)
+    assert max(backoffs) >= 8             # kept doubling while refused
+    assert c.host_spills == 0
+    # lift the budget: the next landing resets the backoff to 1
+    c.host_pool.budget_bytes = 64 << 20
+    for _ in range(80):
+        c.spill_tick()
+        if c.host_spills:
+            break
+    assert c.host_spills >= 1 and c._spill_backoff == 1
+
+
+def test_cache_corrupt_host_entry_discards_chain_and_reprefills():
+    """A CRC mismatch on restore discards the poisoned subtree (every
+    descendant's prefix runs through the bad bytes) and the admission
+    degrades to a cold-miss re-prefill — never wrong tokens."""
+    c = cache_of()
+    t = np.arange(1, 13, dtype=np.int32)
+    prefilled(c, 0, t)
+    c.free(0)
+    _spill_all(c)
+    assert c.host_blocks >= 2
+    key = next(iter(c.host_pool._entries))
+    c.host_pool.corrupt(key)
+    m = c.allocate(1, 12, tokens=t)
+    assert c.host_restore_failures >= 1
+    assert key not in c.host_pool._entries           # poisoned: dropped
+    # the truncated match is a VALID device prefix (possibly empty)
+    assert m % c.block_size == 0
+    # allocator stayed coherent: slot 1 holds exactly its blocks
+    assert len(c._owned[1]) == c.blocks_for(12)
+
+
+def test_cache_abort_transfers_settles_inflight():
+    c = cache_of()
+    t = np.arange(1, 9, dtype=np.int32)
+    prefilled(c, 0, t)
+    c.free(0)
+    free0 = len(c._free)
+    c.spill_tick()                        # dispatch: 2 blocks in flight
+    assert len(c._in_transfer) == 2
+    aborted = c.abort_transfers()
+    assert aborted == 2
+    assert not c._in_transfer and c._pending_spill is None
+    assert c.host_spill_aborts >= 2 and c.host_blocks == 0
+    assert len(c._free) == free0          # still cached, NOT freed
+    assert (c._refcount >= 0).all()
+
+
+def test_cache_off_mode_is_inert():
+    """host_tier=False keeps every new surface dormant: no pool, no
+    transfers, spill_tick a no-op — the off path is the bit-reference
+    by construction."""
+    c = cache_of(host_tier=False)
+    assert c.host_tier is False and c.host_pool is None
+    t = np.arange(1, 9, dtype=np.int32)
+    prefilled(c, 0, t)
+    c.free(0)
+    assert c.spill_tick() == 0
+    assert c.host_spills == 0 and c.host_blocks == 0
+    st = c.stats()
+    assert st["host_blocks"] == 0 and st["host_spills"] == 0
+    # host tier REQUIRES the prefix index: without it the knob is inert
+    c2 = cache_of(prefix_cache=False, host_tier=True)
+    assert c2.host_tier is False
+
+
+# ---------------------------------------------------------------------------
+# serving integration
+# ---------------------------------------------------------------------------
+
+SYS_A = np.arange(1, 25, dtype=np.int32)
+SYS_B = np.arange(60, 84, dtype=np.int32)
+
+
+def fam_prompts(sys_prompt, n, seed, tail=4):
+    r = np.random.default_rng(seed)
+    return [np.concatenate([sys_prompt,
+                            r.integers(30, 58, tail).astype(np.int32)])
+            for _ in range(n)]
+
+
+def tier_workload():
+    """A-A-A B-B-B A-A: family A goes cold while B runs (its chain
+    spills under pressure), then returns (its chain restores)."""
+    return (fam_prompts(SYS_A, 3, 0) + fam_prompts(SYS_B, 3, 1)
+            + fam_prompts(SYS_A, 2, 2))
+
+
+def serve_tier(eng, prompts, host_tier=True, n_new=6, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("num_blocks", 14)
+    kw.setdefault("prefill_chunk", 16)
+    kw.setdefault("prefix_cache", True)
+    kw.setdefault("spill_watermark", 99)  # constant spill pressure
+    srv = ServingEngine(eng, host_tier=host_tier, **kw)
+    out = {}
+    for i, p in enumerate(prompts):
+        out.update(srv.run([ServeRequest(rid=i, prompt=p,
+                                         max_new_tokens=n_new)]))
+    return srv, out
+
+
+def test_serving_restore_token_parity_vs_cold(eng):
+    """THE acceptance gate: a serving run whose prefix hits restore
+    from host DRAM is token-identical to solo cold re-prefills."""
+    prompts = tier_workload()
+    refs = _solo_refs(eng, prompts, 6)
+    srv, out = serve_tier(eng, prompts)
+    assert srv.cache.host_spills > 0, "the tier never spilled"
+    touched = srv.cache.host_restores + srv.cache.host_restore_failures
+    assert touched > 0, "no admission ever touched the host tier"
+    if not faults_lib.active().faults:    # ambient chaos may eat them
+        assert srv.cache.host_restores > 0, "no restore landed"
+    for i, ref in enumerate(refs):
+        np.testing.assert_array_equal(
+            out[i], ref, err_msg=f"request {i} diverged after restore")
+    assert srv.cache.held_blocks == 0
+    assert (srv.cache._refcount == 0).all()
+
+
+def test_serving_off_path_matches_on_path_streams(eng):
+    """Tier on vs off over the same drive: identical streams (the tier
+    changes where cold bytes live, never the tokens produced)."""
+    prompts = tier_workload()
+    s_on, out_on = serve_tier(eng, prompts, host_tier=True)
+    s_off, out_off = serve_tier(eng, prompts, host_tier=False)
+    assert s_on.host_tier and not s_off.host_tier
+    assert s_off.cache.host_spills == 0
+    for i in out_on:
+        np.testing.assert_array_equal(out_on[i], out_off[i])
+
+
+def test_serving_host_stats_mirrors_cache(eng):
+    from deepspeed_tpu.telemetry import Telemetry
+    srv, _ = serve_tier(eng, tier_workload(), telemetry=Telemetry())
+    c = srv.cache
+    assert srv.stats["host_spills"] == c.host_spills > 0
+    assert srv.stats["host_restores"] == c.host_restores > 0
+    assert srv.stats["host_blocks"] == c.host_blocks
+    assert srv.stats["host_bytes"] == c.host_bytes
+    assert srv.stats["host_restore_failures"] == c.host_restore_failures
+    # telemetry: the restore-latency histogram saw every restore
+    h = srv.metrics.histogram("kv_host_restore_ms")
+    assert h.count == c.host_restores
+    assert srv.metrics.gauge("kv_host_tier_bytes").value == c.host_bytes
+
+
+def test_serving_env_knob_resolution(eng, monkeypatch):
+    monkeypatch.setenv("DS_KV_HOST_TIER", "on")
+    srv = ServingEngine(eng, num_slots=2, block_size=8, num_blocks=14,
+                        prefill_chunk=16, prefix_cache=True)
+    assert srv.host_tier is True
+    monkeypatch.setenv("DS_KV_HOST_TIER", "off")
+    srv = ServingEngine(eng, num_slots=2, block_size=8, num_blocks=14,
+                        prefill_chunk=16, prefix_cache=True)
+    assert srv.host_tier is False
+
+
+def test_serving_compile_contract_with_host_tier(devices):
+    """Compile-count contract, tier ON: after warmup (which pre-warms
+    the fixed-width gather/scatter transfer programs) the steady state
+    compiles NOTHING — spills and restores included."""
+    from deepspeed_tpu.utils.compile_guard import CompileWatch
+    cfg, params = tiny()
+    fresh = InferenceEngine(config=cfg, params=params, dtype=jnp.float32)
+    prompts = tier_workload()
+    srv = ServingEngine(fresh, num_slots=2, block_size=8, num_blocks=14,
+                        prefill_chunk=16, prefix_cache=True,
+                        host_tier=True, spill_watermark=99)
+    out = {}
+    out.update(srv.run([ServeRequest(rid="w", prompt=prompts[0],
+                                     max_new_tokens=4)]))
+    watch = CompileWatch(max_compiles=0, label="host-tier steady state")
+    with watch:
+        for i, p in enumerate(prompts):
+            srv.run([ServeRequest(rid=i, prompt=p, max_new_tokens=6)])
+    assert srv.cache.host_spills > 0      # transfers ran INSIDE watch
+    assert srv.cache.host_restores > 0
+
+
+# ---------------------------------------------------------------------------
+# chaos: the three new fault sites
+# ---------------------------------------------------------------------------
+
+def test_chaos_spill_fault_backs_off_blocks_stay_resident(eng):
+    """An injected ``cache.spill`` exhaustion skips that batch: the
+    candidates stay device-resident (nothing half-spilled), the daemon
+    backs off, and a later retry lands — with full parity."""
+    prompts = tier_workload()
+    refs = _solo_refs(eng, prompts, 6)
+    with faults_lib.injected(
+            Fault("cache.spill", "cache_exhausted", step=0), seed=0) as inj:
+        srv, out = serve_tier(eng, prompts)
+    assert ("cache.spill", "cache_exhausted", 0) in inj.fired
+    assert srv.cache.host_spills > 0      # the retry landed later
+    for i, ref in enumerate(refs):
+        np.testing.assert_array_equal(out[i], ref)
+    assert (srv.cache._refcount == 0).all()
+
+
+def test_chaos_restore_fault_degrades_to_cold_miss(eng):
+    """An injected ``cache.restore`` exhaustion truncates that match:
+    the tail re-prefills cold, the host entry SURVIVES for a later
+    retry, and parity holds."""
+    prompts = tier_workload()
+    refs = _solo_refs(eng, prompts, 6)
+    with faults_lib.injected(
+            Fault("cache.restore", "cache_exhausted", step=0),
+            seed=0) as inj:
+        srv, out = serve_tier(eng, prompts)
+    assert ("cache.restore", "cache_exhausted", 0) in inj.fired
+    assert srv.cache.host_restore_failures >= 1
+    for i, ref in enumerate(refs):
+        np.testing.assert_array_equal(out[i], ref)
+    assert srv.cache.held_blocks == 0
+
+
+def test_chaos_host_corruption_crc_catches_and_reprefills(eng):
+    """``cache.host_corrupt`` flips a REAL stored byte; the genuine
+    CRC32 verify catches it, the poisoned chain is discarded, and the
+    admission re-prefills — correct tokens, never garbage attention."""
+    prompts = tier_workload()
+    refs = _solo_refs(eng, prompts, 6)
+    with faults_lib.injected(
+            Fault("cache.host_corrupt", "cache_exhausted", step=0),
+            seed=0) as inj:
+        srv, out = serve_tier(eng, prompts)
+    assert ("cache.host_corrupt", "cache_exhausted", 0) in inj.fired
+    assert srv.cache.host_restore_failures >= 1
+    for i, ref in enumerate(refs):
+        np.testing.assert_array_equal(out[i], ref)
+    assert (srv.cache._refcount == 0).all()
+
+
+# ---------------------------------------------------------------------------
+# interplay: int8 pools, speculative decode, router drain
+# ---------------------------------------------------------------------------
+
+def test_hosttier_int8_scale_sidecars_roundtrip(eng):
+    """Under DS_KV_QUANT=int8 a spilled block is 4 host arrays (int8
+    K/V + fp32 scale sidecars) and the int8 tier-on streams are
+    IDENTICAL to int8 tier-off (quantization noise is the fp-parity
+    tolerance; the tier adds NONE on top)."""
+    prompts = tier_workload()
+    s_on, out_on = serve_tier(eng, prompts, host_tier=True,
+                              kv_quant="int8")
+    s_off, out_off = serve_tier(eng, prompts, host_tier=False,
+                                kv_quant="int8")
+    assert s_on.cache.host_spills > 0 and s_on.cache.host_restores > 0
+    for arrays, _, _ in s_on.cache.host_pool._entries.values():
+        assert len(arrays) == 4           # k, v, k_scale, v_scale
+        assert arrays[0].dtype == np.int8
+        assert arrays[2].dtype == np.float32
+    for i in out_on:
+        np.testing.assert_array_equal(out_on[i], out_off[i])
+
+
+def test_hosttier_spec_decode_rollback_parity(eng):
+    """Speculative decoding over host-restored prefix chains: rollback
+    targets always sit above the prompt boundary, so restored shared
+    blocks are never released by a reject — greedy parity holds."""
+    prompts = tier_workload()
+    s_on, out_on = serve_tier(eng, prompts, host_tier=True,
+                              spec_decode=True, n_new=8)
+    s_off, out_off = serve_tier(eng, prompts, host_tier=False,
+                                spec_decode=True, n_new=8)
+    assert s_on.cache.host_spills > 0
+    for i in out_on:
+        np.testing.assert_array_equal(out_on[i], out_off[i])
+    assert (s_on.cache._refcount == 0).all()
+
+
+def test_hosttier_router_drain_releases_restored_blocks(eng):
+    """Retiring a replica mid-flight with transfers pending: the
+    snapshot path aborts in-flight spills FIRST (no block is freed by
+    a harvest after its slot released it), drained requests finish on
+    the survivor, and the retired cache is fully released."""
+    prompts = tier_workload()
+    refs = _solo_refs(eng, prompts, 6)
+    fleet = [ServingEngine(eng, num_slots=2, block_size=8, num_blocks=14,
+                           prefill_chunk=16, prefix_cache=True,
+                           host_tier=True, spill_watermark=99)
+             for _ in range(2)]
+    router = ReplicaRouter(fleet)
+    for i, p in enumerate(prompts):
+        router.submit(ServeRequest(rid=i, prompt=p, max_new_tokens=6))
+    for _ in range(4):                    # let spills get in flight
+        router.step()
+    router.retire_replica(0)
+    out = router.run()
+    c0 = fleet[0].cache
+    assert c0._pending_spill is None and not c0._in_transfer
+    assert c0.held_blocks == 0 and (c0._refcount == 0).all()
+    assert set(out) == set(range(len(prompts)))
+    for i, ref in enumerate(refs):
+        np.testing.assert_array_equal(
+            out[i], ref, err_msg=f"request {i} lost parity over retire")

@@ -29,7 +29,17 @@ if [[ "${1:-}" == "chaos" ]]; then
     # pinned seed, so a red run is reproducible bit-for-bit
     echo "gate(chaos): fault-injection smoke (DS_FAULT_SEED=0)"
     DS_FAULT_SEED=0 python -m pytest tests/test_chaos.py \
-        tests/test_checkpointing.py tests/test_router.py -q
+        tests/test_checkpointing.py tests/test_router.py \
+        tests/test_host_tier.py -q
+    # tiered-KV three-site ambient injection: spill, restore and CRC
+    # corruption all fire against the LIVE serving drives — every one
+    # must degrade (blocks stay resident / cold-miss re-prefill), and
+    # token parity must still hold (docs/KV_TIERING.md)
+    echo "gate(chaos): host-tier three-site injection (DS_FAULT_SEED=0)"
+    DS_FAULT_SEED=0 \
+    DS_FAULTS="cache.spill:cache_exhausted@0;cache.restore:cache_exhausted@1;cache.host_corrupt:cache_exhausted@0" \
+        python -m pytest tests/test_host_tier.py \
+        -k "parity or drain_releases" -q
 elif [[ "${1:-}" == "quick" ]]; then
     # lint only the .py files this change touches (full-tree scan is the
     # full gate's job); baseline + inline suppressions apply as usual
@@ -95,6 +105,16 @@ else
     DS_KV_QUANT=int8 python -m pytest tests/test_serving.py \
         tests/test_prefix_cache.py tests/test_spec_serving.py \
         tests/test_kv_quant.py tests/test_kv_quant_serving.py -q
+    # host-DRAM KV tier knob smoke: the suite default leaves
+    # DS_KV_HOST_TIER unset (= off, the device-only bit-reference), so
+    # rerun the serving + prefix-sharing + chaos suites once with the
+    # tier forced ON (and the prefix cache it requires) — spill/restore
+    # bookkeeping, every degrade path and the zero-recompile contract
+    # must hold with the second tier active (docs/KV_TIERING.md)
+    echo "gate: serving smoke (DS_KV_HOST_TIER=on)"
+    DS_KV_HOST_TIER=on DS_PREFIX_CACHE=on python -m pytest \
+        tests/test_serving.py tests/test_prefix_cache.py \
+        tests/test_host_tier.py tests/test_chaos.py -q
     # sampled-mode smoke: the suites above exercise temperature=0
     # requests by default, so rerun the sampling + spec suites once
     # with speculation forced ON — this is the path where sampled

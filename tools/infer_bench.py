@@ -185,6 +185,8 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
                   int8=False, int8_fused=False, seed=0, decode_impl=None,
                   prefix_cache=None, shared_prefix_len=0,
                   spec_decode=None, spec_k=None, kv_quant=None,
+                  host_tier=None, host_budget_bytes=None,
+                  spill_watermark=None, prefix_families=1,
                   temperature=0.0, top_p=1.0, sample_seed=0, emit=True):
     """Continuous-batching serving row: synthetic Poisson arrivals driven
     through ServingEngine.step, wall-clock tokens/s, TTFT/TPOT latency
@@ -220,6 +222,14 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
     ``slots_admittable`` reports how many decode slots the unquantized
     pool's HBM budget admits at the row's pool layout — the capacity-
     per-chip headline (~2x for int8 over bf16).
+
+    ``host_tier`` pins the host-DRAM KV second tier on/off (None =
+    ``DS_KV_HOST_TIER``); ``prefix_families`` > 1 rotates requests
+    through that many DISTINCT system prompts in two passes each, so a
+    family's chain goes cold between visits — at a constrained
+    ``num_blocks`` the device-only cache must evict it, while the host
+    tier spills and restores it (``spill_watermark`` pins the daemon's
+    pressure threshold). Rows report the host transfer counters.
 
     ``temperature``/``top_p`` > defaults turn the drive into a SAMPLED
     workload (every request seeded ``sample_seed + rid``, so a row is
@@ -259,24 +269,38 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
                         num_blocks=num_blocks, prefill_chunk=prefill_chunk,
                         decode_impl=decode_impl, prefix_cache=prefix_cache,
                         spec_decode=spec_decode, spec_k=spec_k,
-                        kv_quant=kv_quant, telemetry=Telemetry())
+                        kv_quant=kv_quant, host_tier=host_tier,
+                        host_budget_bytes=host_budget_bytes,
+                        spill_watermark=spill_watermark,
+                        telemetry=Telemetry())
 
     rng = np.random.default_rng(seed)
     arrive = np.floor(np.cumsum(
         rng.exponential(mean_gap_steps, num_requests))).astype(int)
-    # the shared-prefix workload: every request opens with the SAME
-    # system prompt (deterministic, independent of the tail rng stream)
-    sys_prompt = (1 + np.arange(shared_prefix_len)
-                  % (cfg.vocab_size - 1)).astype(np.int32) \
-        if shared_prefix_len else None
+    # the shared-prefix workload: requests open with a deterministic
+    # system prompt (independent of the tail rng stream). family 0 is
+    # bit-identical to the single-family formula; prefix_families > 1
+    # rotates groups A A.. B B.. A A.. so chains go cold between visits
+    if not shared_prefix_len:
+        fams = None
+    elif prefix_families <= 1:
+        fams = [(1 + np.arange(shared_prefix_len)
+                 % (cfg.vocab_size - 1)).astype(np.int32)]
+    else:
+        fams = [((1 + 131 * f + np.arange(shared_prefix_len))
+                 % (cfg.vocab_size - 1)).astype(np.int32)
+                for f in range(prefix_families)]
+    group = max(1, -(-num_requests // (2 * max(1, prefix_families))))
 
-    def mk_prompt():
+    def mk_prompt(i):
         tail = rng.integers(0, cfg.vocab_size,
                             rng.integers(*prompt_lens)).astype(np.int32)
-        return tail if sys_prompt is None \
-            else np.concatenate([sys_prompt, tail])
+        if fams is None:
+            return tail
+        sys_prompt = fams[(i // group) % len(fams)]
+        return np.concatenate([sys_prompt, tail])
 
-    reqs = [ServeRequest(rid=i, prompt=mk_prompt(),
+    reqs = [ServeRequest(rid=i, prompt=mk_prompt(i),
                          max_new_tokens=new_tokens,
                          temperature=temperature, top_p=top_p,
                          seed=sample_seed + i)
@@ -287,7 +311,9 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
                       num_blocks=num_blocks, prefill_chunk=prefill_chunk,
                       decode_impl=decode_impl, prefix_cache=prefix_cache,
                       spec_decode=spec_decode, spec_k=spec_k,
-                      kv_quant=kv_quant)
+                      kv_quant=kv_quant, host_tier=host_tier,
+                      host_budget_bytes=host_budget_bytes,
+                      spill_watermark=spill_watermark)
     w.run([ServeRequest(rid="w", prompt=reqs[0].prompt.copy(),
                         max_new_tokens=2)])
 
@@ -369,6 +395,17 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
             st["prefix_hits"] / max(st["admitted"], 1), 3),
         "prefix_tokens_saved": st["prefix_tokens_saved"],
         "prefill_chunks": st["prefill_chunks"],
+        # host-DRAM KV tier columns (all zero with the tier off): how
+        # many cold prefix blocks were spilled off-device, how many a
+        # later prefix hit pulled back instead of re-prefilling, and
+        # restores the CRC/fault degrade path turned into cold misses
+        "host_tier": bool(srv.host_tier),
+        "prefix_families": prefix_families,
+        "host_spills": cache.host_spills,
+        "host_restores": cache.host_restores,
+        "host_restore_failures": cache.host_restore_failures,
+        "host_blocks": cache.host_blocks,
+        "host_bytes": cache.host_bytes,
         # speculative-decode columns, registry-sourced: accept_rate is
         # drafts-the-target-agreed-with over drafts offered;
         # tokens_per_step is emitted tokens per slot per verify step
@@ -462,6 +499,46 @@ def bench_serving_prefix_compare(name, shared_prefix_len=64, **kw):
         "tokens_per_s_off": off["tokens_per_s"],
         "tokens_per_s_on": on["tokens_per_s"],
         "cow_copies": on["cache_stats"]["cow_copies"],
+    }), flush=True)
+
+
+def bench_serving_hosttier_compare(name, shared_prefix_len=24,
+                                   prefix_families=3, num_blocks=None,
+                                   spill_watermark=None, **kw):
+    """Same multi-family shared-prefix drive at the SAME constrained
+    device pool, host tier OFF then ON: greedy streams must be
+    identical (the tier changes where cold prefix bytes live, never
+    the tokens produced); the row is the prefix hit rate the host tier
+    recovers at fixed HBM — chains the device-only cache must evict to
+    admit the next family instead spill to host DRAM and restore when
+    the family returns."""
+    off = bench_serving(f"{name}[off]", prefix_cache=True,
+                        host_tier=False,
+                        shared_prefix_len=shared_prefix_len,
+                        prefix_families=prefix_families,
+                        num_blocks=num_blocks, **kw)
+    on = bench_serving(f"{name}[on]", prefix_cache=True, host_tier=True,
+                       shared_prefix_len=shared_prefix_len,
+                       prefix_families=prefix_families,
+                       num_blocks=num_blocks,
+                       spill_watermark=spill_watermark, **kw)
+    print(json.dumps({
+        "config": name, "preset": off["preset"],
+        "host_tier": "off-vs-on",
+        "shared_prefix_len": shared_prefix_len,
+        "prefix_families": prefix_families,
+        "num_blocks": num_blocks,
+        "output_identical": off["_results"] == on["_results"],
+        "prefix_hit_rate_off": off["prefix_hit_rate"],
+        "prefix_hit_rate_on": on["prefix_hit_rate"],
+        "prefix_tokens_saved_off": off["prefix_tokens_saved"],
+        "prefix_tokens_saved_on": on["prefix_tokens_saved"],
+        "host_spills": on["host_spills"],
+        "host_restores": on["host_restores"],
+        "host_restore_failures": on["host_restore_failures"],
+        "host_bytes": on["host_bytes"],
+        "tokens_per_s_off": off["tokens_per_s"],
+        "tokens_per_s_on": on["tokens_per_s"],
     }), flush=True)
 
 
@@ -814,6 +891,24 @@ SERVE_COMPARE_CONFIGS = [
         mean_gap_steps=1.5, prompt_lens=(16, 128), new_tokens=64,
         num_slots=8, block_size=16, prefill_chunk=128,
         shared_prefix_len=256)),
+    # host-DRAM KV tier at a CONSTRAINED device pool: three prompt
+    # families rotate through two visits each, so every family's chain
+    # goes cold between visits — the off row loses those chains to
+    # device eviction, the on row must report host_spills > 0,
+    # host_restores > 0 and a higher prefix_hit_rate at the same
+    # num_blocks, with identical greedy streams
+    ("serve-hosttier-smoke", dict(mode="hosttier", num_requests=12,
+                                  mean_gap_steps=2.0, prompt_lens=(4, 12),
+                                  new_tokens=8, num_slots=2, block_size=8,
+                                  prefill_chunk=16, shared_prefix_len=24,
+                                  prefix_families=3, num_blocks=14,
+                                  spill_watermark=12)),
+    ("serve-hosttier-gpt2-medium", dict(
+        mode="hosttier", preset="gpt2-medium", num_requests=24,
+        mean_gap_steps=1.5, prompt_lens=(16, 96), new_tokens=32,
+        num_slots=4, block_size=16, prefill_chunk=64,
+        shared_prefix_len=192, prefix_families=3, num_blocks=88,
+        spill_watermark=32)),
     # speculative decoding on vs off over a self-similar greedy workload
     # (tiny-model greedy loops repeat, exactly what the prompt-lookup
     # drafter exploits): streams must be identical and the on row must
@@ -879,8 +974,68 @@ SERVE_COMPARE_CONFIGS = [
 ]
 
 
+def _backend_probe(timeout=240):
+    """Probe the accelerator backend in a SUBPROCESS and say WHY it
+    failed: a wedged TPU tunnel hangs jax.devices() forever (observed
+    on this rig — bench.py grew the same guard first), and a hang
+    inside the driver's bench run would record nothing at all. Returns
+    ``(ok, reason)``; reason is None on success."""
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        return True, None    # a local CPU backend cannot be unreachable
+    import subprocess
+    probe = ("import sys; sys.path.insert(0, '.')\n"
+             "from deepspeed_tpu.utils import honor_platform_request\n"
+             "honor_platform_request()\n"
+             "import jax; print(jax.devices())\n")
+    try:
+        r = subprocess.run([sys.executable, "-c", probe],
+                           capture_output=True, timeout=timeout)
+        if r.returncode == 0:
+            return True, None
+        tail = r.stderr.decode("utf-8", "replace").strip()[-200:]
+        return False, f"probe exited {r.returncode}: {tail}"
+    except subprocess.TimeoutExpired:
+        return False, f"probe hung past {timeout}s (wedged tunnel?)"
+    except Exception as e:                       # noqa: BLE001
+        return False, f"probe spawn failed: {repr(e)[:200]}"
+
+
+def _wait_for_backend():
+    """Bounded recovery loop with exponential backoff: a transient
+    tunnel wedge must not forfeit the whole bench round, but an
+    unreachable backend must not hang it forever either. Total budget
+    via ``BENCH_RECOVERY_MINUTES`` (default 25, 0 = single probe).
+    Returns ``(ok, attempts, last_reason)``."""
+    budget_s = float(os.environ.get("BENCH_RECOVERY_MINUTES", "25")) * 60
+    deadline = time.time() + budget_s
+    delay = 60
+    attempt = 0
+    while True:
+        attempt += 1
+        ok, reason = _backend_probe()
+        if ok:
+            return True, attempt, None
+        if time.time() + delay >= deadline:
+            print(f"infer_bench: backend unreachable after {attempt} "
+                  f"probes", file=sys.stderr)
+            return False, attempt, reason
+        print(f"infer_bench: backend probe {attempt} failed "
+              f"({reason}), retrying in {delay}s", file=sys.stderr)
+        time.sleep(delay)
+        delay = min(delay * 2, 480)
+
+
 def main():
     from deepspeed_tpu.utils.hbm import MemoryGuardError
+    ok, attempts, reason = _wait_for_backend()
+    if not ok:
+        # structured outage row: a consumer must be able to tell
+        # "backend gone" from "bench crashed" without parsing stderr
+        print(json.dumps({"config": "backend-probe", "probe_fail": True,
+                          "status": "error:backend_unreachable",
+                          "reason": reason, "attempts": attempts}),
+              flush=True)
+        return
     for name, kw in CONFIGS:
         try:
             bench_config(name, **kw)
@@ -912,6 +1067,7 @@ def main():
         kw = dict(kw)
         mode = kw.pop("mode", "impl")
         compare = {"prefix": bench_serving_prefix_compare,
+                   "hosttier": bench_serving_hosttier_compare,
                    "spec": bench_serving_spec_compare,
                    "kvquant": bench_serving_kvquant_compare,
                    "router": bench_serving_router_compare,
