@@ -984,7 +984,7 @@ class InferenceEngine:
                 v_pool.at[:, dst].set(v_pool[:, src]))
 
     def cow_blocks(self, k_pool, v_pool, src, dst):
-        return self._cow_blocks(k_pool, v_pool,
+        return self._cow_blocks(k_pool, v_pool,  # dslint: disable=DS012 — caller paged_cache._cow fires cache.cow before delegating here
                                 jnp.asarray(src, jnp.int32),
                                 jnp.asarray(dst, jnp.int32))
 
@@ -1083,7 +1083,7 @@ class InferenceEngine:
                 v_scale.at[:, dst].set(v_scale[:, src]))
 
     def cow_blocks_q(self, k_pool, v_pool, k_scale, v_scale, src, dst):
-        return self._cow_blocks_q(k_pool, v_pool, k_scale, v_scale,
+        return self._cow_blocks_q(k_pool, v_pool, k_scale, v_scale,  # dslint: disable=DS012 — caller paged_cache._cow fires cache.cow before delegating here
                                   jnp.asarray(src, jnp.int32),
                                   jnp.asarray(dst, jnp.int32))
 
@@ -1104,7 +1104,7 @@ class InferenceEngine:
                 v_pool.at[:, dst].set(v_blk))
 
     def scatter_block(self, k_pool, v_pool, k_blk, v_blk, dst):
-        return self._scatter_block(k_pool, v_pool, k_blk, v_blk,
+        return self._scatter_block(k_pool, v_pool, k_blk, v_blk,  # dslint: disable=DS012 — caller paged_cache._dispatch_restore fires cache.restore before delegating here
                                    jnp.asarray(dst, jnp.int32))
 
     def _gather_blocks_q_fn(self, k_pool, v_pool, k_scale, v_scale, ids):
@@ -1128,7 +1128,7 @@ class InferenceEngine:
 
     def scatter_block_q(self, k_pool, v_pool, k_scale, v_scale,
                         k_blk, v_blk, ks_blk, vs_blk, dst):
-        return self._scatter_block_q(k_pool, v_pool, k_scale, v_scale,
+        return self._scatter_block_q(k_pool, v_pool, k_scale, v_scale,  # dslint: disable=DS012 — caller paged_cache._dispatch_restore fires cache.restore before delegating here
                                      k_blk, v_blk, ks_blk, vs_blk,
                                      jnp.asarray(dst, jnp.int32))
 
@@ -1324,7 +1324,7 @@ class InferenceEngine:
             if i == max_new_tokens - 1:
                 break
             rng, r = jax.random.split(rng)
-            logits, cache = self._decode(
+            logits, cache = self._decode(  # dslint: disable=DS012 — offline batch API; chaos coverage targets the serving dispatches (engine.decode fires in decode_slots)
                 self.params, cache, token[:, None],
                 jnp.asarray(S + i, jnp.int32),
                 None if row_len is None else row_len + i)

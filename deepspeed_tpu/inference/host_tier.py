@@ -25,11 +25,12 @@ None and the caller leaves the block device-resident, where plain LRU
 eviction — exactly the tier-off behavior — remains the backstop.
 """
 
-import os
 import zlib
 from typing import Dict, Optional, Tuple
 
 import numpy as np
+
+from deepspeed_tpu.utils.env import resolve_flag
 
 
 class HostCorruption(Exception):
@@ -45,16 +46,7 @@ def resolve_host_tier(flag: Optional[bool] = None) -> bool:
     (``on``/``off``, also ``1``/``0``/``true``/``false``), else OFF —
     the single-tier (device-only) cache is the behavioral
     bit-reference."""
-    if flag is not None:
-        return bool(flag)
-    v = os.environ.get("DS_KV_HOST_TIER", "")  # dslint: disable=DS005 — documented serving knob, resolved once at engine construction and overridable per ServingEngine
-    v = v.strip().lower()
-    if v in ("", "off", "0", "false", "no"):
-        return False
-    if v in ("on", "1", "true", "yes"):
-        return True
-    # ValueError, not assert: validates user env input, survives python -O
-    raise ValueError(f"DS_KV_HOST_TIER={v!r}: expected 'on' or 'off'")
+    return resolve_flag("DS_KV_HOST_TIER", flag)
 
 
 def resolve_host_budget(budget_bytes: Optional[int] = None) -> int:
@@ -63,9 +55,7 @@ def resolve_host_budget(budget_bytes: Optional[int] = None) -> int:
     not free, and an unbounded pool would hide leaks)."""
     if budget_bytes is not None:
         return int(budget_bytes)
-    v = os.environ.get("DS_KV_HOST_BUDGET_MB", "")  # dslint: disable=DS005 — documented serving knob, resolved once at cache construction
-    mb = float(v) if v.strip() else 256.0
-    return int(mb * (1 << 20))
+    return int(resolve_flag("DS_KV_HOST_BUDGET_MB") * (1 << 20))
 
 
 class HostBlockPool:

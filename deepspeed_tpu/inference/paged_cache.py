@@ -59,7 +59,6 @@ behind exponential backoff, and an exhausted host budget falls back to
 plain eviction — exactly the tier-off behavior.
 """
 
-import os
 import time
 from typing import Callable, Dict, List, Optional
 
@@ -67,6 +66,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepspeed_tpu.utils.env import resolve_flag
 from deepspeed_tpu.inference.host_tier import (
     HostBlockPool, HostCorruption, resolve_host_tier)
 from deepspeed_tpu.inference.prefix_index import PrefixIndex, PrefixMatch
@@ -86,16 +86,7 @@ def resolve_prefix_cache(flag: Optional[bool] = None) -> bool:
     Explicit argument wins, else the ``DS_PREFIX_CACHE`` env var
     (``on``/``off``, also ``1``/``0``/``true``/``false``), else OFF —
     the refcount-free allocator is the behavioral bit-reference."""
-    if flag is not None:
-        return bool(flag)
-    v = os.environ.get("DS_PREFIX_CACHE", "")  # dslint: disable=DS005 — documented serving knob, resolved once at engine construction and overridable per ServingEngine
-    v = v.strip().lower()
-    if v in ("", "off", "0", "false", "no"):
-        return False
-    if v in ("on", "1", "true", "yes"):
-        return True
-    # ValueError, not assert: validates user env input, survives python -O
-    raise ValueError(f"DS_PREFIX_CACHE={v!r}: expected 'on' or 'off'")
+    return resolve_flag("DS_PREFIX_CACHE", flag)
 
 
 def _cow_copy_fn(k_pool, v_pool, src, dst):

@@ -35,10 +35,11 @@ Two drafters ship:
   only when the draft is much smaller than the target.
 """
 
-import os
 from typing import Any, Optional
 
 import numpy as np
+
+from deepspeed_tpu.utils.env import resolve_flag
 
 
 def resolve_spec_decode(flag: Optional[bool] = None) -> bool:
@@ -47,24 +48,14 @@ def resolve_spec_decode(flag: Optional[bool] = None) -> bool:
     Explicit argument wins, else the ``DS_SPEC_DECODE`` env var
     (``on``/``off``, also ``1``/``0``/``true``/``false``), else OFF —
     plain one-token decode stays the behavioral bit-reference."""
-    if flag is not None:
-        return bool(flag)
-    v = os.environ.get("DS_SPEC_DECODE", "")  # dslint: disable=DS005 — documented serving knob, resolved once at engine construction and overridable per ServingEngine
-    v = v.strip().lower()
-    if v in ("", "off", "0", "false", "no"):
-        return False
-    if v in ("on", "1", "true", "yes"):
-        return True
-    # ValueError, not assert: validates user env input, survives python -O
-    raise ValueError(f"DS_SPEC_DECODE={v!r}: expected 'on' or 'off'")
+    return resolve_flag("DS_SPEC_DECODE", flag)
 
 
 def resolve_spec_draft(spec: Optional[str] = None) -> str:
     """Resolve the drafter NAME: explicit argument, else
     ``DS_SPEC_DRAFT``, else ``"ngram"`` (the no-second-model default)."""
     if spec is None:
-        spec = os.environ.get("DS_SPEC_DRAFT", "")  # dslint: disable=DS005 — documented serving knob, resolved once at engine construction and overridable per ServingEngine
-        spec = spec.strip().lower() or "ngram"
+        spec = str(resolve_flag("DS_SPEC_DRAFT")).strip().lower()
     if spec != "ngram":
         raise ValueError(
             f"DS_SPEC_DRAFT={spec!r}: 'ngram' is the only named drafter "
@@ -76,10 +67,7 @@ def resolve_spec_draft(spec: Optional[str] = None) -> str:
 def resolve_spec_k(k: Optional[int] = None) -> int:
     """Draft chunk length: explicit argument, else ``DS_SPEC_K``, else
     4 (docs/SPECULATIVE.md discusses tuning)."""
-    if k is None:
-        v = os.environ.get("DS_SPEC_K", "")  # dslint: disable=DS005 — documented serving knob, resolved once at engine construction and overridable per ServingEngine
-        k = int(v) if v.strip() else 4
-    k = int(k)
+    k = int(resolve_flag("DS_SPEC_K", k))
     if k < 1:
         raise ValueError(f"spec_k={k}: need at least one draft token")
     return k

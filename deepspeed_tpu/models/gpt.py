@@ -300,10 +300,9 @@ def _int8_fused_enabled() -> bool:
     to fuse _kernel_of's dequant — the fallback the reference covers
     with dedicated int8 GEMM kernels (ref: csrc/transformer/inference
     pt_binding.cpp:866). TPU-only: the kernel needs Mosaic."""
-    import os
-
     from deepspeed_tpu.utils import on_tpu
-    return os.environ.get("DS_INT8_FUSED") == "1" and on_tpu()  # dslint: disable=DS005 — experimental kernel gate, deliberately env-only
+    from deepspeed_tpu.utils.env import resolve_flag
+    return resolve_flag("DS_INT8_FUSED") and on_tpu()
 
 
 def _dense(h, p):

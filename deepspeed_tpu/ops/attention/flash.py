@@ -19,7 +19,6 @@ All matmuls hit the MXU in the input dtype with fp32 accumulation
 """
 
 import functools
-import os
 from typing import Optional
 
 import jax
@@ -69,7 +68,8 @@ def resolve_window_impl(window, window_impl=None):
     PARITY.md quarantine advice works uniformly."""
     if window is None or isinstance(window, tuple):
         return window
-    impl = window_impl or os.environ.get("DS_FLASH_WINDOW_IMPL", "banded")  # dslint: disable=DS005 — documented debug override (PARITY.md quarantine switch)
+    from deepspeed_tpu.utils.env import resolve_flag
+    impl = window_impl or resolve_flag("DS_FLASH_WINDOW_IMPL")
     if impl not in ("banded", "masked"):
         # ValueError, not assert: this validates user input (env var /
         # config) and must survive python -O

@@ -44,7 +44,6 @@ default; tests drive this kernel in interpret mode under
 """
 
 import functools
-import os
 from typing import Optional
 
 import jax
@@ -69,7 +68,8 @@ def resolve_decode_impl(impl: Optional[str] = None) -> str:
     portable fallback). Shared by InferenceEngine and ServingEngine so
     env overrides work uniformly."""
     if impl is None:
-        impl = os.environ.get("DS_PAGED_DECODE_IMPL") or None  # dslint: disable=DS005 — documented impl override shared by both engines
+        from deepspeed_tpu.utils.env import resolve_flag
+        impl = resolve_flag("DS_PAGED_DECODE_IMPL")
     if impl is None:
         from deepspeed_tpu.utils import on_tpu
         impl = "pallas" if on_tpu() else "gather"

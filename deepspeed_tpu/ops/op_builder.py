@@ -22,7 +22,7 @@ from deepspeed_tpu.utils.logging import logger
 
 _PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _CSRC = os.path.join(_PKG_DIR, "csrc")
-_BUILD_DIR = os.environ.get(  # dslint: disable=DS005 — build-dir knob for the op compiler, read once at import on purpose
+_BUILD_DIR = os.environ.get(  # dslint: disable=DS005,DS013 — build-dir path for the op compiler, read once at import on purpose; a path, not a feature flag, so it stays outside the FLAGS registry
     "DS_TPU_BUILD_DIR",
     os.path.join(os.path.dirname(_PKG_DIR), "build"))
 
@@ -48,7 +48,7 @@ class OpBuilder:
         return [os.path.join(_CSRC, s) for s in self.sources]
 
     def cxx_flags(self) -> List[str]:
-        march = [] if os.environ.get("DS_TPU_NO_NATIVE_ARCH") else ["-march=native"]  # dslint: disable=DS005 — compiler-flag escape hatch
+        march = [] if os.environ.get("DS_TPU_NO_NATIVE_ARCH") else ["-march=native"]  # dslint: disable=DS005,DS013 — compiler-flag escape hatch for the native build, truthiness on purpose (any value disables)
         return (["-O3", "-std=c++17", "-shared", "-fPIC", "-fopenmp",
                  "-Wall"] + march + list(self.extra_flags))
 

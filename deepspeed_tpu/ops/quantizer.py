@@ -22,12 +22,13 @@ Conventions
   uses curand inside the kernel.
 """
 
-import os
 from functools import partial
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from deepspeed_tpu.utils.env import resolve_flag
 
 
 def _grouped(x: jnp.ndarray, groups: int) -> jnp.ndarray:
@@ -207,22 +208,11 @@ def resolve_kv_quant(mode=None) -> str:
     """Resolve the KV-cache quantization mode: ``"off"`` or ``"int8"``.
 
     Explicit ``mode`` wins; otherwise the ``DS_KV_QUANT`` env var;
-    otherwise off. Same knob pattern as ``resolve_prefix_cache`` /
-    ``resolve_decode_impl``.
+    otherwise off. Booleans map onto the on/off aliases (True → int8).
+    Parse/validation live in the shared FLAGS registry
+    (:mod:`deepspeed_tpu.utils.env`).
     """
-    if mode is not None:
-        if isinstance(mode, bool):
-            mode = "int8" if mode else "off"
-        mode = str(mode).strip().lower()
-    else:
-        # dslint: disable=DS005 — knob resolver, read once at construction
-        mode = os.environ.get("DS_KV_QUANT", "").strip().lower() or "off"
-    if mode in ("off", "0", "false", "no", "none"):
-        return "off"
-    if mode in ("int8", "on", "1", "true", "yes"):
-        return "int8"
-    raise ValueError(
-        f"DS_KV_QUANT={mode!r}: expected 'int8' or 'off'")
+    return resolve_flag("DS_KV_QUANT", mode)
 
 
 def kv_block_scales(x: jnp.ndarray) -> jnp.ndarray:

@@ -29,10 +29,10 @@ attribute access per call site and the compile/parity contracts are
 byte-identical either way).
 """
 
-import os
 import time
 from typing import Optional
 
+from deepspeed_tpu.utils.env import resolve_flag
 from deepspeed_tpu.telemetry.breakdown import (NoopBreakdown, PHASES,
                                                StepBreakdown)
 from deepspeed_tpu.telemetry.metrics import (Counter, DEFAULT_BUCKETS,
@@ -52,10 +52,7 @@ __all__ = ["Telemetry", "NoopTelemetry", "NOOP", "resolve_telemetry",
 def resolve_telemetry(flag: Optional[bool] = None) -> bool:
     """Explicit flag wins; else the ``DS_TELEMETRY`` env knob; default
     off (the no-op plane is the bit-reference)."""
-    if flag is not None:
-        return bool(flag)
-    v = os.environ.get("DS_TELEMETRY", "")  # dslint: disable=DS005 — documented serving knob, resolved once at engine construction and overridable per ServingEngine
-    return v.strip().lower() in ("1", "on", "true", "yes")
+    return resolve_flag("DS_TELEMETRY", flag)
 
 
 class Telemetry:

@@ -42,10 +42,14 @@ from typing import List, Optional
 
 import jax
 
-# substring match, not equality: jax has moved this event between
-# /jax/core/compile/backend_compile_duration and sibling names across
-# releases; every variant keeps the backend_compile stem
-_COMPILE_EVENT_STEM = "backend_compile"
+# what counts as a jit entry point (wrapper chains, compile-event stem)
+# is shared with tools/dslint via jit_registry so the runtime watch and
+# the static lint police the same callable set
+from deepspeed_tpu.utils.jit_registry import (COMPILE_EVENT_STEM,
+                                              is_compile_event)
+from deepspeed_tpu.utils.jit_registry import cache_size as _registry_cache_size
+
+_COMPILE_EVENT_STEM = COMPILE_EVENT_STEM  # back-compat alias
 
 
 class RecompileError(AssertionError):
@@ -122,7 +126,7 @@ class CompileWatch:
             reg, self._unreg = api
 
             def _on_event(event, duration=None, **kw):
-                if _COMPILE_EVENT_STEM not in event:
+                if not is_compile_event(event):
                     return
                 with self._lock:
                     if self._armed:
@@ -162,8 +166,7 @@ def cache_size(jitted_fn) -> Optional[int]:
     """Number of compiled programs held by a jitted callable, or None
     when the jax build doesn't expose it.  Use to pin 'exactly N
     programs' (cache sizes) alongside CompileWatch's 'zero new
-    compiles' (cache deltas)."""
-    probe = getattr(jitted_fn, "_cache_size", None)
-    if probe is None:
-        return None
-    return int(probe())
+    compiles' (cache deltas).  (Implementation lives in
+    :mod:`~deepspeed_tpu.utils.jit_registry`, the shared jit-entry-point
+    definition; this re-export keeps the historical import path.)"""
+    return _registry_cache_size(jitted_fn)

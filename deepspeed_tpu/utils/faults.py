@@ -88,7 +88,6 @@ fire ``kind`` at ``site`` on visits ``[step, step+count)`` with float
 ``param`` (sleep seconds for ``slow``).
 """
 
-import os
 import time
 import warnings
 from contextlib import contextmanager
@@ -235,10 +234,12 @@ class FaultInjector:
 
     @classmethod
     def from_env(cls, env=None) -> "FaultInjector":
-        # ambient chaos config; tests pin it via install()/injected()
-        env = os.environ if env is None else env  # dslint: disable=DS005 — fault spec is deliberately ambient (chaos knob), parsed once and overridable via install()
-        spec = env.get("DS_FAULTS", "")
-        seed = int(env.get("DS_FAULT_SEED", "0") or "0")
+        # ambient chaos config; tests pin it via install()/injected().
+        # resolve_flag carries the declared defaults ("" / seed 0) and
+        # honors the explicit env mapping chaos tests pass in
+        from deepspeed_tpu.utils.env import resolve_flag
+        spec = resolve_flag("DS_FAULTS", env=env)
+        seed = resolve_flag("DS_FAULT_SEED", env=env)
         return cls(parse_spec(spec), seed=seed)
 
     # -- scheduling ----------------------------------------------------

@@ -11,10 +11,10 @@ Must set env before jax is imported anywhere.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # force: the session env may point at a real TPU
-flags = os.environ.get("XLA_FLAGS", "")
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the session env may point at a real TPU  # dslint: disable=DS005 — must pin the platform BEFORE jax imports
+flags = os.environ.get("XLA_FLAGS", "")  # dslint: disable=DS005 — bootstrap: XLA flags only apply pre-import
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"  # dslint: disable=DS005 — bootstrap: XLA flags only apply pre-import
 
 import jax  # noqa: E402
 

@@ -69,7 +69,7 @@ def test_checkpoint_wrapper_under_jit(rng):
     checkpointing.configure()
     w = jnp.asarray(rng.standard_normal((8, 8)), jnp.float32)
 
-    @jax.jit
+    @jax.jit  # dslint: disable=DS002 — jitted once per test run; the wrapper-under-jit behavior is what's under test
     def f(w):
         blk = checkpointing.checkpoint_wrapper(lambda a: jnp.sin(a @ a.T))
         return jnp.sum(blk(w))

@@ -1,15 +1,22 @@
 """dslint — JAX/TPU-aware static analysis for this repo.
 
-CLI: ``python -m tools.dslint deepspeed_tpu tools`` (see __main__.py).
-Library surface (used by tests): analyze_source / analyze_paths,
-load_baseline / apply_baseline / write_baseline, default_rules.
+CLI: ``python -m tools.dslint deepspeed_tpu tools tests`` (see
+__main__.py). Library surface (used by tests): analyze_source /
+analyze_paths / analyze_package, load_baseline / apply_baseline /
+write_baseline, default_rules, interproc_rules, build_symbol_table,
+to_sarif.
 """
 
-from tools.dslint.core import (Finding, analyze_paths, analyze_source,
-                               apply_baseline, load_baseline,
-                               write_baseline)
+from tools.dslint.core import (Finding, analyze_package, analyze_paths,
+                               analyze_source, apply_baseline,
+                               load_baseline, write_baseline)
+from tools.dslint.interproc import interproc_catalog, interproc_rules
 from tools.dslint.rules import default_rules, rule_catalog
+from tools.dslint.sarif import to_sarif, write_sarif
+from tools.dslint.symbols import build_symbol_table
 
-__all__ = ["Finding", "analyze_paths", "analyze_source", "apply_baseline",
-           "load_baseline", "write_baseline", "default_rules",
-           "rule_catalog"]
+__all__ = ["Finding", "analyze_package", "analyze_paths", "analyze_source",
+           "apply_baseline", "load_baseline", "write_baseline",
+           "default_rules", "rule_catalog", "interproc_rules",
+           "interproc_catalog", "build_symbol_table", "to_sarif",
+           "write_sarif"]

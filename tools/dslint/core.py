@@ -61,6 +61,9 @@ class Finding:
 
 def link_parents(tree: ast.AST) -> ast.AST:
     """Annotate every node with ``_ds_parent`` so rules can walk upward."""
+    if getattr(tree, "_ds_linked", False):
+        return tree
+    tree._ds_linked = True
     for node in ast.walk(tree):
         for child in ast.iter_child_nodes(node):
             child._ds_parent = node
@@ -164,6 +167,101 @@ def analyze_paths(paths: Iterable[str],
                                        rules=rules))
     findings.sort(key=lambda x: (x.path, x.line, x.col, x.rule))
     return findings
+
+
+def analyze_package(paths: Iterable[str],
+                    rules: Optional[Sequence] = None,
+                    interproc: Optional[Sequence] = None,
+                    docs_root=None,
+                    schema_path=None,
+                    partial: bool = False,
+                    stats: Optional[Dict[str, float]] = None,
+                    symtab_out: Optional[list] = None) -> List[Finding]:
+    """The two-phase driver: parse every file ONCE, run the per-file
+    rules (phase 1 consumers), build the package-wide symbol table, run
+    the interprocedural rules (phase 2) over it. Inline suppressions
+    cover interprocedural findings exactly like per-file ones.
+
+    ``interproc=None`` runs the full DS011–DS014 set; pass ``[]`` to
+    skip phase 2. ``partial=True`` (closure mode) disables the
+    whole-tree completeness directions inside the interproc rules.
+    ``stats`` (a dict) is filled with phase timings in seconds.
+    ``symtab_out`` (a list) receives the built SymbolTable, so callers
+    can persist the import graph for ``--closure``.
+    """
+    import time
+    t0 = time.perf_counter()
+    if rules is None:
+        from tools.dslint.rules import default_rules
+        rules = default_rules()
+    if interproc is None:
+        from tools.dslint.interproc import interproc_rules
+        interproc = interproc_rules()
+
+    parsed: List[Tuple[str, ast.AST, List[str]]] = []
+    sup: Dict[str, Tuple[Set[str], Dict[int, Set[str]]]] = {}
+    findings: List[Finding] = []
+    for f in iter_py_files(paths):
+        path = _norm_path(str(f))
+        try:
+            src = f.read_text(encoding="utf-8")
+        except (OSError, UnicodeDecodeError) as e:
+            findings.append(Finding("DS000", path, 0, 0,
+                                    f"unreadable: {e}"))
+            continue
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            findings.append(Finding("DS000", path, int(e.lineno or 0),
+                                    int(e.offset or 0),
+                                    f"syntax error: {e.msg}"))
+            continue
+        link_parents(tree)
+        lines = src.splitlines()
+        parsed.append((path, tree, lines))
+        sup[path] = parse_suppressions(lines)
+    if stats is not None:
+        stats["parse_s"] = time.perf_counter() - t0
+
+    t1 = time.perf_counter()
+    for path, tree, lines in parsed:
+        for rule in rules:
+            findings.extend(rule.check(tree, lines, path))
+    if stats is not None:
+        stats["intraproc_s"] = time.perf_counter() - t1
+
+    t2 = time.perf_counter()
+    if interproc:
+        from tools.dslint.symbols import build_symbol_table
+        table = build_symbol_table(parsed)
+        if symtab_out is not None:
+            symtab_out.append(table)
+        for rule in interproc:
+            findings.extend(rule.check_package(
+                table, docs_root=docs_root, schema_path=schema_path,
+                partial=partial))
+    elif symtab_out is not None:
+        from tools.dslint.symbols import build_symbol_table
+        symtab_out.append(build_symbol_table(parsed))
+    if stats is not None:
+        stats["interproc_s"] = time.perf_counter() - t2
+
+    lines_by_path = {p: ls for p, _, ls in parsed}
+    for f in findings:
+        ls = lines_by_path.get(f.path)
+        if not f.snippet and ls and 0 < f.line <= len(ls):
+            f.snippet = ls[f.line - 1].strip()
+    out = []
+    for f in findings:
+        file_sup, line_sup = sup.get(f.path, (set(), {}))
+        if f.rule in file_sup or f.rule in line_sup.get(f.line, ()):
+            continue
+        out.append(f)
+    out.sort(key=lambda x: (x.path, x.line, x.col, x.rule))
+    if stats is not None:
+        stats["total_s"] = time.perf_counter() - t0
+        stats["files"] = len(parsed)
+    return out
 
 
 # --------------------------------------------------------------------------

@@ -143,7 +143,13 @@ class BlockingHostSync(Rule):
                  "serializes the pipeline; accumulate on device and pull "
                  "once (batched jax.device_get) after the loop")
 
+    # convergence tests pull the loss scalar every step on purpose —
+    # that's the assertion, not a pipeline bug
+    _TEST_PATHS = re.compile(r"(^|/)tests/")
+
     def check(self, tree, lines, path):
+        if self._TEST_PATHS.search(path.replace("\\", "/")):
+            return []
         out = []
         for node in ast.walk(tree):
             if not isinstance(node, ast.Call):

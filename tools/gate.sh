@@ -41,14 +41,21 @@ if [[ "${1:-}" == "chaos" ]]; then
         python -m pytest tests/test_host_tier.py \
         -k "parity or drain_releases" -q
 elif [[ "${1:-}" == "quick" ]]; then
-    # lint only the .py files this change touches (full-tree scan is the
-    # full gate's job); baseline + inline suppressions apply as usual
+    # lint the changed .py files PLUS their direct importers (--closure
+    # quick mode, cached import graph from the last full run) so the
+    # interprocedural rules (DS011-DS014) see cross-module breakage a
+    # change introduces; whole-tree completeness checks are the full
+    # gate's job. Falls back to a full two-phase pass (which seeds the
+    # cache) when no cache exists yet.
     lint_changed=$(git diff --name-only --diff-filter=d HEAD -- \
                    'deepspeed_tpu/*.py' 'deepspeed_tpu/**/*.py' \
-                   'tools/*.py' 'tools/**/*.py' | tr '\n' ' ')
+                   'tools/*.py' 'tools/**/*.py' \
+                   'tests/*.py' 'tests/**/*.py' | tr '\n' ' ')
     if [[ -n "${lint_changed// }" ]]; then
-        echo "gate(quick) dslint: $lint_changed"
-        python -m tools.dslint $lint_changed
+        echo "gate(quick) dslint --closure: $lint_changed"
+        mkdir -p build
+        python -m tools.dslint --closure $lint_changed \
+            --sarif build/dslint.sarif
     fi
     # changed TEST files run as-is; changed source files map to test
     # files by name heuristic; plus the always-on smoke set
@@ -67,7 +74,13 @@ elif [[ "${1:-}" == "quick" ]]; then
     echo "gate(quick): $tests"
     python -m pytest $tests -q
 else
-    python -m tools.dslint deepspeed_tpu tools
+    # full two-phase lint (per-file DS001-DS010 + interprocedural
+    # DS011-DS014 over the package symbol table); also refreshes the
+    # import-graph cache the quick gate's --closure mode reads and
+    # leaves a SARIF log for CI viewers
+    mkdir -p build
+    python -m tools.dslint deepspeed_tpu tools tests \
+        --stats --sarif build/dslint.sarif
     python -m pytest tests/ -q
     # shared-prefix cache knob smoke: the serving path must be green with
     # the prefix cache forced ON and forced OFF. The suite default leaves
