@@ -82,13 +82,14 @@ def quantize_weights_int8(params):
     return out
 
 
-def _mlp(h, p, cfg):
-    m = _dense(h, p["mlp_in"])
+def _mlp(h, p, cfg, lora=None):
+    lr = (lambda t: None) if lora is None else lora.get
+    m = _dense(h, p["mlp_in"], lora=lr("mlp_in"))
     if cfg.activation == "swiglu":
-        m = jax.nn.silu(_dense(h, p["mlp_gate"])) * m
+        m = jax.nn.silu(_dense(h, p["mlp_gate"], lora=lr("mlp_gate"))) * m
     else:
         m = jax.nn.gelu(m, approximate=True)
-    return _dense(m, p["mlp_out"])
+    return _dense(m, p["mlp_out"], lora=lr("mlp_out"))
 
 
 def _block_prefill(x, p, cfg: GPTConfig, kv_mask=None, positions=None):
@@ -110,9 +111,11 @@ def _block_prefill(x, p, cfg: GPTConfig, kv_mask=None, positions=None):
     return x + _ffn(h, p, cfg), k, v
 
 
-def _ffn(h, p, cfg):
+def _ffn(h, p, cfg, lora=None):
     """Dense MLP or MoE FFN for one block (ref MoE inference path:
-    ops/transformer/inference/moe_inference.py).
+    ops/transformer/inference/moe_inference.py). ``lora`` (multi-tenant
+    serving, inference/adapters.py) applies to the dense MLP targets
+    only — MoE expert stacks are not adaptable pool targets.
 
     The MoE eval path NEVER drops a token (GShard capacity bounds
     training dispatch; it must not change eval semantics — the gate's
@@ -123,7 +126,7 @@ def _ffn(h, p, cfg):
     tokens mix their top-k renormalized softmax weights, exactly
     Mixtral's softmax-over-top-k router semantics."""
     if "moe" not in p:
-        return _mlp(h, p, cfg)
+        return _mlp(h, p, cfg, lora=lora)
     from deepspeed_tpu.moe.experts import ffn_expert_fn
     k = getattr(cfg, "moe_k", 1)
     B, S, D = h.shape
@@ -252,7 +255,7 @@ def _gather_blocks(pool, tables):
 
 def _block_decode_paged(x, k_pool, v_pool, tables, lengths, active, p,
                         cfg: GPTConfig, impl: str = "gather",
-                        k_scale=None, v_scale=None):
+                        k_scale=None, v_scale=None, lora=None):
     """One block for ONE new token per slot, K/V addressed through block
     tables — the paged generalization of _block_decode. x: [B, 1, D];
     pools [N, block, Hkv, Dh]; tables [B, NB]; lengths [B] per-slot
@@ -270,16 +273,22 @@ def _block_decode_paged(x, k_pool, v_pool, tables, lengths, active, p,
     block (dequantize, insert the token, zero stale lanes, requantize —
     ops/quantizer KV helpers), the scales update alongside, and the
     returns grow to a 5-tuple. ``k_scale=None`` (the default) traces the
-    exact pre-quant program — the bit-reference path is untouched."""
+    exact pre-quant program — the bit-reference path is untouched.
+
+    ``lora`` (multi-tenant adapter serving, inference/adapters.py) is a
+    dict target -> per-slot gathered rank-block factors handed through
+    to :func:`~deepspeed_tpu.models.gpt._dense`; ``lora=None`` (the
+    default) traces the exact base-only program."""
     B, _, D = x.shape
     H, Dh = cfg.n_heads, cfg.head_dim
     Hkv = cfg.kv_heads
     group = H // Hkv
     bs = k_pool.shape[1]
     NB = tables.shape[1]
+    lr = (lambda t: None) if lora is None else lora.get
 
     h = _norm(x, p["ln1"], cfg)
-    qkv = _dense(h, p["qkv"])
+    qkv = _dense(h, p["qkv"], lora=lr("qkv"))
     q, k, v = jnp.split(qkv, [H * Dh, (H + Hkv) * Dh], axis=-1)
     if cfg.rotary_dim:
         from deepspeed_tpu.ops.attention.rotary import apply_rotary
@@ -348,13 +357,13 @@ def _block_decode_paged(x, k_pool, v_pool, tables, lengths, active, p,
             scores = jnp.where(idx > pos - cfg.attn_window, scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
         attn = jnp.einsum("bkgs,bskd->bkgd", probs, vc).reshape(B, 1, D)
-    attn = _dense(attn, p["attn_out"])
+    attn = _dense(attn, p["attn_out"], lora=lr("attn_out"))
     if cfg.parallel_residual:
-        y = x + attn + _ffn(h, p, cfg)
+        y = x + attn + _ffn(h, p, cfg, lora=lora)
     else:
         x = x + attn
         h = _norm(x, p["ln2"], cfg)
-        y = x + _ffn(h, p, cfg)
+        y = x + _ffn(h, p, cfg, lora=lora)
     if k_scale is None:
         return y, k_pool, v_pool
     return y, k_pool, v_pool, k_scale, v_scale
@@ -362,7 +371,7 @@ def _block_decode_paged(x, k_pool, v_pool, tables, lengths, active, p,
 
 def _block_verify_paged(x, k_pool, v_pool, tables, lengths, active, p,
                         cfg: GPTConfig, impl: str = "gather",
-                        k_scale=None, v_scale=None):
+                        k_scale=None, v_scale=None, lora=None):
     """One block for a G-token SPECULATIVE CHUNK per slot, K/V addressed
     through block tables — the q_len>1 generalization of
     _block_decode_paged for draft/verify serving. x: [B, G, D]; chunk
@@ -381,16 +390,18 @@ def _block_verify_paged(x, k_pool, v_pool, tables, lengths, active, p,
     With ``k_scale``/``v_scale`` the pools are int8 and the write is a
     read-modify-requantize of the W consecutive blocks the G-token chunk
     can straddle (W = 1 + ceil((G-1)/block)); returns grow to a 5-tuple.
-    ``k_scale=None`` traces the exact pre-quant program."""
+    ``k_scale=None`` traces the exact pre-quant program; ``lora=None``
+    the exact base-only program (see _block_decode_paged)."""
     B, G, D = x.shape
     H, Dh = cfg.n_heads, cfg.head_dim
     Hkv = cfg.kv_heads
     group = H // Hkv
     bs = k_pool.shape[1]
     NB = tables.shape[1]
+    lr = (lambda t: None) if lora is None else lora.get
 
     h = _norm(x, p["ln1"], cfg)
-    qkv = _dense(h, p["qkv"])
+    qkv = _dense(h, p["qkv"], lora=lr("qkv"))
     pos = lengths[:, None] + jnp.arange(G, dtype=jnp.int32)[None]  # [B, G]
     q, k, v = _qkv_split_rotary(qkv, cfg, pos, B, G)
     qg = q.reshape(B, G, Hkv, group, Dh)
@@ -474,20 +485,21 @@ def _block_verify_paged(x, k_pool, v_pool, tables, lengths, active, p,
             scores = jnp.where(idx > qpos - cfg.attn_window, scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
         attn = jnp.einsum("bkgqs,bskd->bqkgd", probs, vc).reshape(B, G, D)
-    attn = _dense(attn, p["attn_out"])
+    attn = _dense(attn, p["attn_out"], lora=lr("attn_out"))
     if cfg.parallel_residual:
-        y = x + attn + _ffn(h, p, cfg)
+        y = x + attn + _ffn(h, p, cfg, lora=lora)
     else:
         x = x + attn
         h = _norm(x, p["ln2"], cfg)
-        y = x + _ffn(h, p, cfg)
+        y = x + _ffn(h, p, cfg, lora=lora)
     if k_scale is None:
         return y, k_pool, v_pool
     return y, k_pool, v_pool, k_scale, v_scale
 
 
 def _block_prefill_paged(x, k_pool, v_pool, table_row, positions, n_valid,
-                         p, cfg: GPTConfig, k_scale=None, v_scale=None):
+                         p, cfg: GPTConfig, k_scale=None, v_scale=None,
+                         lora=None):
     """Forward one block over a PROMPT CHUNK for one slot, writing the
     chunk's K/V through the slot's block table and attending over the
     slot's full cache so far (history from earlier chunks + this chunk)
@@ -503,16 +515,19 @@ def _block_prefill_paged(x, k_pool, v_pool, table_row, positions, n_valid,
     untouched blocks (including shared prefix blocks mapped read-only)
     are written back byte-identical, so sharing semantics are
     preserved. Returns grow to a 5-tuple; ``k_scale=None`` traces the
-    exact pre-quant program."""
+    exact pre-quant program; ``lora=None`` the exact base-only program
+    (see _block_decode_paged; here the gathered factors carry the
+    prefill row's B=1 leading dim)."""
     B, C, D = x.shape
     H, Dh = cfg.n_heads, cfg.head_dim
     Hkv = cfg.kv_heads
     group = H // Hkv
     bs = k_pool.shape[1]
     NB = table_row.shape[0]
+    lr = (lambda t: None) if lora is None else lora.get
 
     h = _norm(x, p["ln1"], cfg)
-    qkv = _dense(h, p["qkv"])
+    qkv = _dense(h, p["qkv"], lora=lr("qkv"))
     q, k, v = gpt_lib._qkv_split_rotary(qkv, cfg, positions[None], B, C)
 
     valid = jnp.arange(C) < n_valid
@@ -575,13 +590,13 @@ def _block_prefill_paged(x, k_pool, v_pool, table_row, positions, n_valid,
         scores = jnp.where(sidx > qpos - cfg.attn_window, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
     attn = jnp.einsum("ckgs,skd->ckgd", probs, vc).reshape(1, C, D)
-    attn = _dense(attn, p["attn_out"])
+    attn = _dense(attn, p["attn_out"], lora=lr("attn_out"))
     if cfg.parallel_residual:
-        y = x + attn + _ffn(h, p, cfg)
+        y = x + attn + _ffn(h, p, cfg, lora=lora)
     else:
         x = x + attn
         h = _norm(x, p["ln2"], cfg)
-        y = x + _ffn(h, p, cfg)
+        y = x + _ffn(h, p, cfg, lora=lora)
     if k_scale is None:
         return y, k_pool, v_pool
     return y, k_pool, v_pool, k_scale, v_scale
@@ -740,6 +755,31 @@ class InferenceEngine:
                                            static_argnums=(9,))
             self._cow_blocks_q = jax.jit(self._cow_blocks_q_fn,
                                          donate_argnums=(0, 1, 2, 3))
+            # multi-tenant LoRA twins (DS_LORA_SERVE=on, inference/
+            # adapters.py): adapter pools + the per-slot adapter-table
+            # rows ride at the END of each signature as traced DATA —
+            # donate/static indices are unchanged, and the pools are
+            # never donated (read-only, shared across steps and slots).
+            # A lora run compiles ONLY these (base-only serving keeps
+            # the fp/_q programs cold and vice versa), so the steady-
+            # state program COUNT contract holds either way, for ANY
+            # number of registered adapters
+            self._prefill_slot_l = jax.jit(self._prefill_slot_l_fn,
+                                           donate_argnums=(1, 2))
+            self._decode_slots_l = jax.jit(self._decode_slots_l_fn,
+                                           donate_argnums=(1, 2),
+                                           static_argnums=(7,))
+            self._verify_slots_l = jax.jit(self._verify_slots_l_fn,
+                                           donate_argnums=(1, 2),
+                                           static_argnums=(7,))
+            self._prefill_slot_ql = jax.jit(self._prefill_slot_ql_fn,
+                                            donate_argnums=(1, 2, 3, 4))
+            self._decode_slots_ql = jax.jit(self._decode_slots_ql_fn,
+                                            donate_argnums=(1, 2, 3, 4),
+                                            static_argnums=(9,))
+            self._verify_slots_ql = jax.jit(self._verify_slots_ql_fn,
+                                            donate_argnums=(1, 2, 3, 4),
+                                            static_argnums=(9,))
             # host-tier transfer programs (DS_KV_HOST_TIER=on): the
             # spill gather keeps the pools live (the copy rides out
             # while decode keeps serving), the restore scatter donates
@@ -1074,6 +1114,196 @@ class InferenceEngine:
             body, x, (params["block"], k_pool, v_pool, k_scale, v_scale))
         return self._logits(params, x), ks, vs, kss, vss
 
+    @staticmethod
+    def _gather_lora(lora_a, lora_b, ablocks):
+        """Per-layer slice of the adapter pools -> per-slot gathered
+        factors for gpt._dense's lora hook. ``lora_a[t]``: [NB, in, rb]
+        (the scan already consumed the leading L); ``ablocks``:
+        [B, NBa] per-slot pool-block rows (traced data — any adapter
+        mix reuses the one program). Base-only rows are all zeros and
+        gather the permanent trash block."""
+        return {t: (lora_a[t][ablocks], lora_b[t][ablocks])
+                for t in lora_a}
+
+    def _prefill_slot_l_fn(self, params, k_pool, v_pool, table_row, tokens,
+                           start, n_valid, key, gen_count, temp, top_k,
+                           top_p, rep_pen, seen_row, lora_a, lora_b,
+                           ablock_row):
+        """LoRA twin of _prefill_slot_fn: the adapter pools thread
+        through the scan alongside the block params and the slot's
+        adapter-table row selects its rank blocks (inference/
+        adapters.py). An all-zeros row gathers the trash block — the
+        base-only prefill bit-for-bit."""
+        cfg = self.cfg
+        C = tokens.shape[0]
+        positions = start + jnp.arange(C, dtype=jnp.int32)
+        x = params["wte"]["embedding"][tokens][None]
+        if cfg.use_wpe:
+            safe = jnp.clip(positions, 0, self.max_seq_len - 1)
+            x = x + params["wpe"]["embedding"][safe][None]
+
+        def body(x, layer):
+            layer_p, kp, vp, la, lb = layer
+            lora = self._gather_lora(la, lb, ablock_row[None])
+            y, kp, vp = _block_prefill_paged(x, kp, vp, table_row,
+                                             positions, n_valid, layer_p,
+                                             cfg, lora=lora)
+            return y, (kp, vp)
+
+        x, (ks, vs) = jax.lax.scan(
+            body, x, (params["block"], k_pool, v_pool, lora_a, lora_b))
+        last = jnp.clip(n_valid - 1, 0, C - 1)
+        x_last = jax.lax.dynamic_slice_in_dim(x, last, 1, axis=1)
+        logits = self._logits(params, x_last)
+        tok, lp = sampling.sample_tokens(
+            logits[:, -1], key.reshape(1, 2), gen_count.reshape(1),
+            temp.reshape(1), top_k.reshape(1), top_p.reshape(1),
+            rep_pen.reshape(1), seen_row.reshape(1, -1))
+        return logits, tok, lp, ks, vs
+
+    def _decode_slots_l_fn(self, params, k_pool, v_pool, tables, lengths,
+                           tokens, active, impl, keys, gen_counts, temps,
+                           top_ks, top_ps, rep_pens, seen, lora_a, lora_b,
+                           ablocks):
+        """LoRA twin of _decode_slots_fn: one compiled program decodes
+        any mix of adapters and base-only slots — ``ablocks`` [B, NBa]
+        is traced data exactly like the sampling lanes."""
+        cfg = self.cfg
+        x = params["wte"]["embedding"][tokens[:, None]]
+        if cfg.use_wpe:
+            safe = jnp.clip(lengths, 0, self.max_seq_len - 1)
+            x = x + params["wpe"]["embedding"][safe][:, None]
+
+        def body(x, layer):
+            layer_p, kp, vp, la, lb = layer
+            lora = self._gather_lora(la, lb, ablocks)
+            y, kp, vp = _block_decode_paged(x, kp, vp, tables, lengths,
+                                            active, layer_p, cfg,
+                                            impl=impl, lora=lora)
+            return y, (kp, vp)
+
+        x, (ks, vs) = jax.lax.scan(
+            body, x, (params["block"], k_pool, v_pool, lora_a, lora_b))
+        logits = self._logits(params, x)
+        toks, lps = sampling.sample_tokens(logits[:, -1], keys, gen_counts,
+                                           temps, top_ks, top_ps, rep_pens,
+                                           seen)
+        return logits, toks, lps, ks, vs
+
+    def _verify_slots_l_fn(self, params, k_pool, v_pool, tables, lengths,
+                           tokens, active, impl, lora_a, lora_b, ablocks):
+        """LoRA twin of _verify_slots_fn: each slot's draft chunk is
+        scored under ITS adapter (speculative decode composes with
+        multi-tenant serving — the verify distribution is the adapted
+        model's, so accept/reject stays lossless per tenant)."""
+        cfg = self.cfg
+        B, G = tokens.shape
+        x = params["wte"]["embedding"][tokens]
+        if cfg.use_wpe:
+            pos = lengths[:, None] + jnp.arange(G, dtype=jnp.int32)[None]
+            safe = jnp.clip(pos, 0, self.max_seq_len - 1)
+            x = x + params["wpe"]["embedding"][safe]
+
+        def body(x, layer):
+            layer_p, kp, vp, la, lb = layer
+            lora = self._gather_lora(la, lb, ablocks)
+            y, kp, vp = _block_verify_paged(x, kp, vp, tables, lengths,
+                                            active, layer_p, cfg,
+                                            impl=impl, lora=lora)
+            return y, (kp, vp)
+
+        x, (ks, vs) = jax.lax.scan(
+            body, x, (params["block"], k_pool, v_pool, lora_a, lora_b))
+        return self._logits(params, x), ks, vs
+
+    def _prefill_slot_ql_fn(self, params, k_pool, v_pool, k_scale, v_scale,
+                            table_row, tokens, start, n_valid, key,
+                            gen_count, temp, top_k, top_p, rep_pen,
+                            seen_row, lora_a, lora_b, ablock_row):
+        """int8-pool + LoRA combo twin (DS_KV_QUANT=int8 with
+        DS_LORA_SERVE=on): quantized KV write path, adapted
+        projections."""
+        cfg = self.cfg
+        C = tokens.shape[0]
+        positions = start + jnp.arange(C, dtype=jnp.int32)
+        x = params["wte"]["embedding"][tokens][None]
+        if cfg.use_wpe:
+            safe = jnp.clip(positions, 0, self.max_seq_len - 1)
+            x = x + params["wpe"]["embedding"][safe][None]
+
+        def body(x, layer):
+            layer_p, kp, vp, ksp, vsp, la, lb = layer
+            lora = self._gather_lora(la, lb, ablock_row[None])
+            y, kp, vp, ksp, vsp = _block_prefill_paged(
+                x, kp, vp, table_row, positions, n_valid, layer_p, cfg,
+                k_scale=ksp, v_scale=vsp, lora=lora)
+            return y, (kp, vp, ksp, vsp)
+
+        x, (ks, vs, kss, vss) = jax.lax.scan(
+            body, x, (params["block"], k_pool, v_pool, k_scale, v_scale,
+                      lora_a, lora_b))
+        last = jnp.clip(n_valid - 1, 0, C - 1)
+        x_last = jax.lax.dynamic_slice_in_dim(x, last, 1, axis=1)
+        logits = self._logits(params, x_last)
+        tok, lp = sampling.sample_tokens(
+            logits[:, -1], key.reshape(1, 2), gen_count.reshape(1),
+            temp.reshape(1), top_k.reshape(1), top_p.reshape(1),
+            rep_pen.reshape(1), seen_row.reshape(1, -1))
+        return logits, tok, lp, ks, vs, kss, vss
+
+    def _decode_slots_ql_fn(self, params, k_pool, v_pool, k_scale, v_scale,
+                            tables, lengths, tokens, active, impl, keys,
+                            gen_counts, temps, top_ks, top_ps, rep_pens,
+                            seen, lora_a, lora_b, ablocks):
+        """int8-pool + LoRA combo twin of _decode_slots_fn."""
+        cfg = self.cfg
+        x = params["wte"]["embedding"][tokens[:, None]]
+        if cfg.use_wpe:
+            safe = jnp.clip(lengths, 0, self.max_seq_len - 1)
+            x = x + params["wpe"]["embedding"][safe][:, None]
+
+        def body(x, layer):
+            layer_p, kp, vp, ksp, vsp, la, lb = layer
+            lora = self._gather_lora(la, lb, ablocks)
+            y, kp, vp, ksp, vsp = _block_decode_paged(
+                x, kp, vp, tables, lengths, active, layer_p, cfg,
+                impl=impl, k_scale=ksp, v_scale=vsp, lora=lora)
+            return y, (kp, vp, ksp, vsp)
+
+        x, (ks, vs, kss, vss) = jax.lax.scan(
+            body, x, (params["block"], k_pool, v_pool, k_scale, v_scale,
+                      lora_a, lora_b))
+        logits = self._logits(params, x)
+        toks, lps = sampling.sample_tokens(logits[:, -1], keys, gen_counts,
+                                           temps, top_ks, top_ps, rep_pens,
+                                           seen)
+        return logits, toks, lps, ks, vs, kss, vss
+
+    def _verify_slots_ql_fn(self, params, k_pool, v_pool, k_scale, v_scale,
+                            tables, lengths, tokens, active, impl,
+                            lora_a, lora_b, ablocks):
+        """int8-pool + LoRA combo twin of _verify_slots_fn."""
+        cfg = self.cfg
+        B, G = tokens.shape
+        x = params["wte"]["embedding"][tokens]
+        if cfg.use_wpe:
+            pos = lengths[:, None] + jnp.arange(G, dtype=jnp.int32)[None]
+            safe = jnp.clip(pos, 0, self.max_seq_len - 1)
+            x = x + params["wpe"]["embedding"][safe]
+
+        def body(x, layer):
+            layer_p, kp, vp, ksp, vsp, la, lb = layer
+            lora = self._gather_lora(la, lb, ablocks)
+            y, kp, vp, ksp, vsp = _block_verify_paged(
+                x, kp, vp, tables, lengths, active, layer_p, cfg,
+                impl=impl, k_scale=ksp, v_scale=vsp, lora=lora)
+            return y, (kp, vp, ksp, vsp)
+
+        x, (ks, vs, kss, vss) = jax.lax.scan(
+            body, x, (params["block"], k_pool, v_pool, k_scale, v_scale,
+                      lora_a, lora_b))
+        return self._logits(params, x), ks, vs, kss, vss
+
     def _cow_blocks_q_fn(self, k_pool, v_pool, k_scale, v_scale, src, dst):
         """Quantized-pool COW: the block's scales travel with its int8
         payload (paged_cache._cow wires this in when kv_quant=int8)."""
@@ -1161,61 +1391,80 @@ class InferenceEngine:
                 jnp.asarray(top_ps, jnp.float32),
                 jnp.asarray(pens, jnp.float32), jnp.asarray(seen, bool))
 
+    @staticmethod
+    def _lora_operands(lora):
+        """Coerce the serving engine's ``lora`` kwarg — ``(a_pool,
+        b_pool, ablocks)`` from AdapterPool.lora_args — to the trailing
+        traced operands of the ``_l``/``_ql`` twins. None selects the
+        base-only program (and keeps the lora twins cold)."""
+        if lora is None:
+            return ()
+        a_pool, b_pool, ablocks = lora
+        return (a_pool, b_pool, jnp.asarray(ablocks, jnp.int32))
+
     def prefill_into_slot(self, k_pool, v_pool, table_row, tokens, start,
                           n_valid, k_scale=None, v_scale=None,
-                          sample_state=None):
+                          sample_state=None, lora=None):
         from deepspeed_tpu.utils.faults import maybe_fire
         maybe_fire("engine.prefill")
         legacy = sample_state is None
         lanes = self._samp_lanes(sample_state, 1, self.cfg.vocab_size,
                                  scalar=True)
+        largs = self._lora_operands(lora)
         if k_scale is None:
-            out = self._prefill_slot(
+            pf = self._prefill_slot if lora is None else self._prefill_slot_l
+            out = pf(
                 self.params, k_pool, v_pool,
                 jnp.asarray(table_row, jnp.int32),
                 jnp.asarray(tokens, jnp.int32),
                 jnp.asarray(start, jnp.int32),
-                jnp.asarray(n_valid, jnp.int32), *lanes)
+                jnp.asarray(n_valid, jnp.int32), *lanes, *largs)
             return (out[0],) + out[3:] if legacy else out
         # ``cache.quantize`` fires before the dispatch touches the
         # donated pools OR scale pools: a TransientDeviceError here is
         # retryable against intact buffers
         maybe_fire("cache.quantize")
-        out = self._prefill_slot_q(
+        pf = (self._prefill_slot_q if lora is None
+              else self._prefill_slot_ql)
+        out = pf(
             self.params, k_pool, v_pool, k_scale, v_scale,  # dslint: disable=DS003 — exclusive branch: the fp dispatch above already returned
             jnp.asarray(table_row, jnp.int32),
             jnp.asarray(tokens, jnp.int32),
             jnp.asarray(start, jnp.int32), jnp.asarray(n_valid, jnp.int32),
-            *lanes)
+            *lanes, *largs)
         return (out[0],) + out[3:] if legacy else out
 
     def decode_slots(self, k_pool, v_pool, tables, lengths, tokens, active,
                      impl=None, k_scale=None, v_scale=None,
-                     sample_state=None):
+                     sample_state=None, lora=None):
         from deepspeed_tpu.utils.faults import maybe_fire
         maybe_fire("engine.decode")
         legacy = sample_state is None
         lanes = self._samp_lanes(sample_state, len(np.asarray(tokens)),
                                  self.cfg.vocab_size)
+        largs = self._lora_operands(lora)
         if k_scale is None:
-            out = self._decode_slots(
+            df = self._decode_slots if lora is None else self._decode_slots_l
+            out = df(
                 self.params, k_pool, v_pool,
                 jnp.asarray(tables, jnp.int32),
                 jnp.asarray(lengths, jnp.int32),
                 jnp.asarray(tokens, jnp.int32), jnp.asarray(active, bool),
-                self.decode_impl if impl is None else impl, *lanes)
+                self.decode_impl if impl is None else impl, *lanes, *largs)
             return (out[0],) + out[3:] if legacy else out
         maybe_fire("cache.quantize")
-        out = self._decode_slots_q(
+        df = (self._decode_slots_q if lora is None
+              else self._decode_slots_ql)
+        out = df(
             self.params, k_pool, v_pool, k_scale, v_scale,  # dslint: disable=DS003 — exclusive branch: the fp dispatch above already returned
             jnp.asarray(tables, jnp.int32),
             jnp.asarray(lengths, jnp.int32),
             jnp.asarray(tokens, jnp.int32), jnp.asarray(active, bool),
-            self.decode_impl if impl is None else impl, *lanes)
+            self.decode_impl if impl is None else impl, *lanes, *largs)
         return (out[0],) + out[3:] if legacy else out
 
     def verify_slots(self, k_pool, v_pool, tables, lengths, tokens, active,
-                     impl=None, k_scale=None, v_scale=None):
+                     impl=None, k_scale=None, v_scale=None, lora=None):
         """Speculative chunk verify for every serving slot (tokens:
         [B, G] — each slot's pending token followed by its draft
         proposals). The ``engine.verify`` fault site (and
@@ -1225,20 +1474,24 @@ class InferenceEngine:
         buffers."""
         from deepspeed_tpu.utils.faults import maybe_fire
         maybe_fire("engine.verify")
+        largs = self._lora_operands(lora)
         if k_scale is None:
-            return self._verify_slots(
+            vf = self._verify_slots if lora is None else self._verify_slots_l
+            return vf(
                 self.params, k_pool, v_pool,
                 jnp.asarray(tables, jnp.int32),
                 jnp.asarray(lengths, jnp.int32),
                 jnp.asarray(tokens, jnp.int32), jnp.asarray(active, bool),
-                self.decode_impl if impl is None else impl)
+                self.decode_impl if impl is None else impl, *largs)
         maybe_fire("cache.quantize")
-        return self._verify_slots_q(
+        vf = (self._verify_slots_q if lora is None
+              else self._verify_slots_ql)
+        return vf(
             self.params, k_pool, v_pool, k_scale, v_scale,  # dslint: disable=DS003 — exclusive branch: the fp dispatch above already returned
             jnp.asarray(tables, jnp.int32),
             jnp.asarray(lengths, jnp.int32),
             jnp.asarray(tokens, jnp.int32), jnp.asarray(active, bool),
-            self.decode_impl if impl is None else impl)
+            self.decode_impl if impl is None else impl, *largs)
 
     def _forward_fn(self, params, tokens):
         x = self._embed(params, tokens)

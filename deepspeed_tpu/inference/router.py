@@ -119,6 +119,9 @@ _ROUTER_STAT_FIELDS = (
     ("steps", "c", "router scheduler iterations"),
     ("dispatched", "c", "requests dispatched to a replica"),
     ("affinity_hits", "c", "dispatches routed by prefix affinity"),
+    ("adapter_affinity_hits", "c", "dispatches routed by adapter affinity "
+                                   "(the target already holds the "
+                                   "request's LoRA adapter pool-resident)"),
     ("redispatches", "c", "dispatch retries after a dispatch-site fault"),
     ("drained_requests", "c",
      "in-flight requests drained from a broken replica onto survivors"),
@@ -234,6 +237,11 @@ class ReplicaRouter:
         self._finished: List[ServeRequest] = []
         self._orphans: List[ServeRequest] = []   # undispatchable drain work
         self._affinity: Dict[bytes, int] = {}
+        # adapter affinity (docs/ADAPTERS.md): last replica that served
+        # each adapter_id — steering a tenant back there turns its next
+        # admission into a pool hit instead of a reload, under the SAME
+        # imbalance cap the prefix affinity honors
+        self._adapter_affinity: Dict[str, int] = {}
         self._rr = 0                             # round-robin step cursor
         self._clock = 0
         # SLO controller hook: ticked once per step() when set; the
@@ -507,6 +515,18 @@ class ReplicaRouter:
             return None
         best = min(cands, key=lambda rep: (self._load(rep), rep.idx))
         if req.deadline is None:
+            # adapter affinity outranks prefix affinity: a pool reload
+            # (H2D copy at admission) costs more than re-prefilling a
+            # shared prefix, and a deadline still outranks both
+            aid = req.adapter_id
+            idx = (self._adapter_affinity.get(aid)
+                   if aid is not None else None)
+            if idx is not None and idx != best.idx:
+                aff = next((rep for rep in cands if rep.idx == idx), None)
+                if aff is not None and (self._load(aff) <= self._load(best)
+                                        + self.affinity_max_imbalance):
+                    self._stat["adapter_affinity_hits"].inc()
+                    return aff
             key = self._affinity_key(req.prompt)
             idx = self._affinity.get(key) if key is not None else None
             if idx is not None and idx != best.idx:
@@ -552,6 +572,8 @@ class ReplicaRouter:
             key = self._affinity_key(req.prompt)
             if ok and key is not None:
                 self._affinity[key] = rep.idx
+            if ok and req.adapter_id is not None:
+                self._adapter_affinity[req.adapter_id] = rep.idx
             if ok and rep.health == RECOVERING:
                 rep.probe_rids.add(req.rid)
             self._stat["dispatched"].inc()

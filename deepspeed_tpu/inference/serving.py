@@ -141,6 +141,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.inference import sampling
+from deepspeed_tpu.inference.adapters import (AdapterLoadError, AdapterPool,
+                                              resolve_lora_serve)
 from deepspeed_tpu.inference.host_tier import resolve_host_tier
 from deepspeed_tpu.inference.paged_cache import (CacheExhausted,
                                                  PagedKVCache,
@@ -156,7 +158,7 @@ from deepspeed_tpu.utils import faults as faults_lib
 from deepspeed_tpu.utils.faults import TransientDeviceError
 from deepspeed_tpu.utils.logging import logger
 
-TERMINAL_STATES = ("done", "timeout", "shed")
+TERMINAL_STATES = ("done", "timeout", "shed", "error")
 
 # the stats contract: same keys (and order) as the pre-telemetry dict,
 # now backed by registry metrics ("c" counter / "g" gauge) and exposed
@@ -188,6 +190,14 @@ _STAT_FIELDS = (
     ("stop_hits", "c", "requests finished by a stop sequence"),
     ("spec_k_capped", "c", "verify participations depth-capped by low "
                            "acceptance"),
+    # multi-tenant LoRA serving (inference/adapters.py): pool-residency
+    # traffic counters, incremented via the pool's stat hooks so there
+    # is one source of truth
+    ("adapter_hits", "c", "adapter acquisitions served pool-resident"),
+    ("adapter_loads", "c", "adapter loads into the device pool"),
+    ("adapter_evictions", "c", "refcount-zero adapters evicted (LRU)"),
+    ("adapter_load_errors", "c", "requests retired state=error by a "
+                                 "failed adapter load"),
     # host-tier mirrors (gauges set from the cache's own counters each
     # step, so the serving stats contract exposes them without a second
     # source of truth)
@@ -254,6 +264,11 @@ class ServeRequest:
     eos_id: Optional[int] = None
     deadline: Optional[float] = None
     priority: Optional[str] = None
+    # multi-tenant LoRA serving: which registered adapter decodes this
+    # request (None = the base model; requires lora_serve on the
+    # engine). An unloadable adapter retires the request with
+    # state="error" — never wrong tokens (docs/ADAPTERS.md)
+    adapter_id: Optional[str] = None
     temperature: Optional[float] = None
     top_k: Optional[int] = None
     top_p: Optional[float] = None
@@ -298,6 +313,7 @@ class ServeRequest:
             eos_id=entry.get("eos_id"),
             deadline=entry.get("deadline"),
             priority=entry.get("priority"),
+            adapter_id=entry.get("adapter_id"),
             temperature=entry.get("temperature"),
             top_k=entry.get("top_k"),
             top_p=entry.get("top_p"),
@@ -348,6 +364,9 @@ def snapshot_entry(req: ServeRequest, **extra) -> Dict:
              "eos_id": req.eos_id,
              "deadline": req.deadline,
              "priority": req.priority,
+             # a drained/resumed request re-attaches (or re-loads) its
+             # adapter at the survivor's admission (docs/ADAPTERS.md)
+             "adapter_id": req.adapter_id,
              # sampling state: the per-token key is a pure function of
              # (seed, len(out)), so these fields ARE the key-chain state
              # a drain/resume needs (docs/SAMPLING.md)
@@ -457,7 +476,12 @@ class ServingEngine:
                  kv_quant: Optional[str] = None,
                  host_tier: Optional[bool] = None,
                  host_budget_bytes: Optional[int] = None,
-                 spill_watermark: Optional[int] = None):
+                 spill_watermark: Optional[int] = None,
+                 lora_serve: Optional[bool] = None,
+                 lora_pool_mb: Optional[float] = None,
+                 lora_pool_blocks: Optional[int] = None,
+                 lora_max_rank: Optional[int] = None,
+                 lora_rank_block: Optional[int] = None):
         if engine.is_encoder:
             raise ValueError("serving needs a causal decoder engine")
         self.engine = engine
@@ -485,6 +509,11 @@ class ServingEngine:
         # set or the int8 set, never both
         self.kv_quant = resolve_kv_quant(kv_quant)
         self._quant = self.kv_quant == "int8"
+        # multi-tenant LoRA serving (inference/adapters.py): resolved
+        # once here, pinned for the run — the lora program twins are
+        # separate executables, so a run uses EITHER the base set or
+        # the lora set, never both (docs/ADAPTERS.md)
+        self.lora_serve = resolve_lora_serve(lora_serve)
         cow = getattr(engine, "cow_blocks_q" if self._quant
                       else "cow_blocks", None)
         # host-tier transfer programs: like COW, the engine's jitted
@@ -663,6 +692,16 @@ class ServingEngine:
                 buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
                          25.0, 50.0, 100.0)) \
                 if self.host_tier else None
+            # adapter plane (docs/ADAPTERS.md): pool residency + size,
+            # refreshed by the pool's stat hooks below
+            self._g_lora_active = reg.gauge(
+                "lora_active_adapters",
+                "LoRA adapters resident in the device pool") \
+                if self.lora_serve else None
+            self._g_lora_pool = reg.gauge(
+                "lora_pool_bytes",
+                "device bytes reserved by the paged adapter pool") \
+                if self.lora_serve else None
 
             def _on_fault(site: str, kind: str, visit: int) -> None:
                 # injected faults land in the SAME timeline as the
@@ -679,7 +718,58 @@ class ServingEngine:
             self._h_accept = self._h_tps = self._h_temp = None
             self._h_kv_err = None
             self._g_host_bytes = self._h_host_restore = None
+            self._g_lora_active = self._g_lora_pool = None
             self._fault_listener = None
+        # the paged adapter pool + per-slot adapter-table rows: row j
+        # holds the block ids the compiled programs gather slot j's
+        # adapter factors through (all zeros = base-only: trash block 0
+        # gathers exact zeros, keeping base-only slots bit-identical to
+        # the pre-subsystem stream)
+        if self.lora_serve:
+            self.adapters = AdapterPool(
+                engine, pool_mb=lora_pool_mb, pool_blocks=lora_pool_blocks,
+                max_rank=lora_max_rank, rank_block=lora_rank_block,
+                faults=self.faults,
+                tracer=(self.telemetry.tracer if self.telemetry.enabled
+                        else None),
+                hooks={"on_hit": self._stat["adapter_hits"].inc,
+                       "on_load": self._on_adapter_load,
+                       "on_evict": self._on_adapter_evict})
+            self._slot_arows = np.zeros(
+                (num_slots, self.adapters.blocks_per_adapter), np.int32)
+            if self._g_lora_pool is not None:
+                self._g_lora_pool.set(self.adapters.pool_bytes)
+        else:
+            self.adapters = None
+            self._slot_arows = None
+
+    def _on_adapter_load(self) -> None:
+        self._stat["adapter_loads"].inc()
+        if self._g_lora_active is not None:
+            self._g_lora_active.set(self.adapters.active_adapters)
+
+    def _on_adapter_evict(self) -> None:
+        self._stat["adapter_evictions"].inc()
+        if self._g_lora_active is not None:
+            self._g_lora_active.set(self.adapters.active_adapters)
+
+    def register_adapter(self, adapter_id: str, source) -> None:
+        """Stage a ``runtime/lora.py`` adapter export for serving under
+        ``adapter_id`` (requires ``lora_serve``); device residency is
+        deferred to the first admission that names it."""
+        if self.adapters is None:
+            raise ValueError("register_adapter requires lora_serve=True "
+                             "(DS_LORA_SERVE=on)")
+        self.adapters.register(adapter_id, source)
+
+    def _lora_args(self, slot: Optional[int] = None):
+        """The engine's ``lora=`` operand for the whole batch (or one
+        prefill slot). None when the subsystem is off — the base
+        programs stay the only ones ever traced."""
+        if self.adapters is None:
+            return None
+        rows = self._slot_arows if slot is None else self._slot_arows[slot]
+        return self.adapters.lora_args(rows)
 
     # -- API -----------------------------------------------------------
     def submit(self, req: ServeRequest, now: float = 0.0) -> bool:
@@ -716,7 +806,8 @@ class ServingEngine:
                 clone = ServeRequest(
                     rid=f"{req.rid}#{i}", prompt=req.prompt,
                     max_new_tokens=req.max_new_tokens, eos_id=req.eos_id,
-                    deadline=req.deadline, temperature=req.temperature,
+                    deadline=req.deadline, adapter_id=req.adapter_id,
+                    temperature=req.temperature,
                     top_k=req.top_k, top_p=req.top_p,
                     seed=sampling.candidate_seed(params.seed, i),
                     repetition_penalty=req.repetition_penalty,
@@ -842,6 +933,7 @@ class ServingEngine:
             self.cache.abort_transfers()
             for slot, r in enumerate(self.slots):
                 if r is not None:
+                    self._release_adapter(slot, r)
                     self.cache.free(slot)
                     self.slots[slot] = None
                     self.sampler.release(slot)
@@ -892,20 +984,54 @@ class ServingEngine:
             # idle engine: skip the watermark so a lone request that
             # fits the pool always makes progress (no livelock); the
             # admission charge covers only the uncached suffix when the
-            # prefix cache can share blocks
-            ok = self.cache.can_admit(len(req._work), tokens=req._work,
+            # prefix cache can share blocks. Adapter-carrying requests
+            # bypass prefix sharing entirely: the index keys blocks by
+            # TOKENS only, but their K/V was computed under some
+            # adapter's weights — a cross-tenant hit would serve
+            # another adapter's activations (docs/ADAPTERS.md)
+            tok_key = None if req.adapter_id is not None else req._work
+            ok = self.cache.can_admit(len(req._work), tokens=tok_key,
                                       watermark=None if occupied else 0)
             if not ok:
                 break
             try:
                 matched = self.cache.allocate(slot, len(req._work),
-                                              tokens=req._work)
+                                              tokens=tok_key)
             except CacheExhausted:
                 # an injected (or racing) exhaustion at admission: the
                 # request stays at the queue head and retries next step
                 break
+            arow = None
+            if req.adapter_id is not None:
+                try:
+                    if self.adapters is None:
+                        raise AdapterLoadError(
+                            f"request {req.rid} names adapter "
+                            f"{req.adapter_id!r} but lora_serve is off")
+                    arow = self.adapters.acquire(req.adapter_id)
+                except (AdapterLoadError, TransientDeviceError) as e:
+                    # structured degradation (docs/ADAPTERS.md): the
+                    # request retires with state="error" — the batch
+                    # keeps serving, and a slot NEVER decodes with base
+                    # (or stale) weights in place of its named adapter
+                    self.cache.free(slot)
+                    self.queue.popleft()
+                    req.state = "error"
+                    req.finished_at = now
+                    self.finished.append(req)
+                    self._stat["adapter_load_errors"].inc()
+                    logger.warning(
+                        f"serving: adapter {req.adapter_id!r} failed to "
+                        f"load for request {req.rid} ({e}); retiring "
+                        f"state=error")
+                    self.telemetry.tracer.event(
+                        "finish", rid=req.rid, step=self._step_clock,
+                        state="error", generated=len(req.out))
+                    continue
             self.queue.popleft()
             self.slots[slot] = req
+            if arow is not None:
+                self._slot_arows[slot] = arow
             # prefill resumes at the matched boundary — the shared
             # blocks' K/V is already resident, so those tokens are
             # never recomputed
@@ -948,12 +1074,13 @@ class ServingEngine:
             # the slot's sampling lane rides every chunk (data, not a
             # signature change); only the FINAL chunk's sample is kept
             lane = self.sampler.lane(slot, len(req.out))
+            lora = self._lora_args(slot)
             if self._quant:
                 (logits, tok, lp, self.cache.k, self.cache.v,
                  self.cache.k_scale, self.cache.v_scale) = self._device_call(
                     "serving.prefill",
                     lambda *a: self.engine.prefill_into_slot(
-                        *a, sample_state=lane),
+                        *a, sample_state=lane, lora=lora),
                     self.cache.k, self.cache.v, self.cache.tables[slot],
                     chunk, done, n, self.cache.k_scale,
                     self.cache.v_scale, now=now)
@@ -962,7 +1089,7 @@ class ServingEngine:
                  self.cache.v) = self._device_call(
                     "serving.prefill",
                     lambda *a: self.engine.prefill_into_slot(
-                        *a, sample_state=lane),
+                        *a, sample_state=lane, lora=lora),
                     self.cache.k, self.cache.v, self.cache.tables[slot],
                     chunk, done, n, now=now)
             self.cache.advance(slot, n)
@@ -974,8 +1101,12 @@ class ServingEngine:
             if self._progress[slot] == len(req._work):
                 # prompt fully resident: publish its full blocks to the
                 # prefix index (before _emit, which may free the slot)
-                # so the NEXT request sharing this prefix skips them
-                self.cache.register_prefix(slot, req._work)
+                # so the NEXT request sharing this prefix skips them —
+                # unless this slot decoded under an adapter: its K/V
+                # carries that adapter's weights and must never be
+                # served to another tenant (docs/ADAPTERS.md)
+                if req.adapter_id is None:
+                    self.cache.register_prefix(slot, req._work)
                 self.telemetry.tracer.event(
                     "prefill_done", rid=req.rid, step=self._step_clock,
                     slot=slot)
@@ -1057,11 +1188,13 @@ class ServingEngine:
         lanes = self.sampler.lanes(gen_counts)
         budget = self.step_time_budget_s
         t0 = time.perf_counter() if budget is not None else 0.0
+        lora = self._lora_args()
         if self._quant:
             (logits, toks, lps, self.cache.k, self.cache.v,
              self.cache.k_scale, self.cache.v_scale) = self._device_call(
                 "serving.decode",
-                lambda *a: self.engine.decode_slots(*a, sample_state=lanes),
+                lambda *a: self.engine.decode_slots(
+                    *a, sample_state=lanes, lora=lora),
                 self.cache.k, self.cache.v, self.cache.tables,
                 self.cache.lengths, tokens, active, self.decode_impl,
                 self.cache.k_scale, self.cache.v_scale, now=now)
@@ -1069,7 +1202,8 @@ class ServingEngine:
             (logits, toks, lps, self.cache.k,
              self.cache.v) = self._device_call(
                 "serving.decode",
-                lambda *a: self.engine.decode_slots(*a, sample_state=lanes),
+                lambda *a: self.engine.decode_slots(
+                    *a, sample_state=lanes, lora=lora),
                 self.cache.k, self.cache.v, self.cache.tables,
                 self.cache.lengths, tokens, active, self.decode_impl,
                 now=now)
@@ -1149,18 +1283,19 @@ class ServingEngine:
             # no retry wrapper: a verify fault degrades to the plain
             # path (which retries) instead of re-speculating — the fault
             # fires before dispatch, so the donated pools are intact
+            lora = self._lora_args()
             if self._quant:
                 (logits, self.cache.k, self.cache.v, self.cache.k_scale,
                  self.cache.v_scale) = self.engine.verify_slots(
                     self.cache.k, self.cache.v, self.cache.tables,
                     self.cache.lengths, tokens, active, self.decode_impl,
-                    self.cache.k_scale, self.cache.v_scale)
+                    self.cache.k_scale, self.cache.v_scale, lora=lora)
             else:
                 logits, self.cache.k, self.cache.v = \
                     self.engine.verify_slots(
                         self.cache.k, self.cache.v, self.cache.tables,
                         self.cache.lengths, tokens, active,
-                        self.decode_impl)
+                        self.decode_impl, lora=lora)
         except TransientDeviceError:
             self._stat["spec_fallbacks"].inc()
             logger.warning("serving: verify fault; degrading this step "
@@ -1407,11 +1542,23 @@ class ServingEngine:
             pending=self.pending_snapshot(),
             stats=dict(self.stats))
 
+    def _release_adapter(self, slot: int, req: ServeRequest) -> None:
+        """Drop the slot's adapter pin (if it holds one) and zero its
+        table row. The nonzero row IS the pin marker — a request whose
+        acquire failed never set it, so release stays balanced."""
+        if self.adapters is None or req.adapter_id is None:
+            return
+        if not self._slot_arows[slot].any():
+            return
+        self.adapters.release(req.adapter_id)
+        self._slot_arows[slot] = 0
+
     def _finish(self, slot: int, req: ServeRequest, now: float,
                 state: str = "done") -> None:
         """Retire a request: blocks back to the pool, slot reopened."""
         req.state = state
         req.finished_at = now
+        self._release_adapter(slot, req)
         self.cache.free(slot)
         self.slots[slot] = None
         self.sampler.release(slot)
@@ -1507,6 +1654,7 @@ class ServingEngine:
         self.telemetry.tracer.event(
             "evict", rid=req.rid, step=self._step_clock, slot=slot,
             generated=len(req.out))
+        self._release_adapter(slot, req)
         self.cache.free(slot)
         self.slots[slot] = None
         self.sampler.release(slot)

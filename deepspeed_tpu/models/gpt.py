@@ -305,10 +305,17 @@ def _int8_fused_enabled() -> bool:
     return resolve_flag("DS_INT8_FUSED") and on_tpu()
 
 
-def _dense(h, p):
+def _dense(h, p, lora=None):
     """h @ kernel (+ bias when the config kept biases). A LoRA-adapted
     entry (runtime/lora.py) adds the low-rank path h @ A @ B * scale —
-    the dense delta is never materialized."""
+    the dense delta is never materialized.
+
+    ``lora`` is the serving-time multi-tenant hook (inference/
+    adapters.py): a pair of per-slot gathered rank-block factors
+    ``(a_blk [B, NBa, in, rb], b_blk [B, NBa, rb, out])`` applied as
+    batched low-rank matmuls summed over the rank-block axis. Scale is
+    pre-folded into b_blk; base-only slots gather the pool's all-zeros
+    trash block, so their contribution is exactly +0.0."""
     blocks = None
     if "q" in p and p["q"].ndim == 2 and _int8_fused_enabled():
         from deepspeed_tpu.ops.int8_matmul import fit_blocks, int8_matmul
@@ -324,6 +331,10 @@ def _dense(h, p):
     if "lora_a" in p:
         y = y + ((h @ p["lora_a"].astype(h.dtype))
                  @ p["lora_b"].astype(h.dtype))             * p["lora_scale"].astype(h.dtype)
+    if lora is not None:
+        a_blk, b_blk = lora
+        u = jnp.einsum("bsi,bnir->bnsr", h, a_blk.astype(h.dtype))
+        y = y + jnp.einsum("bnsr,bnro->bso", u, b_blk.astype(h.dtype))
     b = p.get("bias")
     return y if b is None else y + b.astype(h.dtype)
 

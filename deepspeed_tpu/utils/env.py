@@ -96,6 +96,19 @@ FLAGS: Dict[str, Flag] = dict([
     _mk("DS_INT8_FUSED", "bool", False,
         "route int8 dense entries through the Pallas fused "
         "dequant-matmul kernel (TPU-only experiment; models/gpt.py)"),
+    _mk("DS_LORA_SERVE", "bool", False,
+        "multi-tenant LoRA adapter serving (paged adapter pool + "
+        "heterogeneous-adapter batched decode); off is the base-only "
+        "bit-reference (docs/ADAPTERS.md)"),
+    _mk("DS_LORA_POOL_MB", "float", 16.0,
+        "device adapter-pool byte budget in MiB (sizes the paged "
+        "rank-block pool; docs/ADAPTERS.md)"),
+    _mk("DS_LORA_MAX_RANK", "int", 16,
+        "largest adapter rank the pool accepts; fixes the static "
+        "per-slot adapter-table width ceil(max_rank/rank_block)"),
+    _mk("DS_LORA_RANK_BLOCK", "int", 8,
+        "rank granularity of one adapter-pool block (an adapter "
+        "occupies ceil(rank/rank_block) blocks)"),
     _mk("DS_FAULTS", "str", "",
         "ambient chaos spec 'site:kind@step[*count][~param];...' "
         "(docs/ROBUSTNESS.md); empty injects nothing"),

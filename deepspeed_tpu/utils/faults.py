@@ -44,6 +44,13 @@ Sites currently instrumented:
                        the stored block so the CRC32 check itself
                        drives the degrade path (chain discarded,
                        cold-miss re-prefill — never wrong tokens)
+``cache.adapter_load`` before a LoRA adapter's pool load at admission
+                       (``AdapterPool.acquire``), BEFORE any pool
+                       state moves; ``device_error``/``cache_exhausted``
+                       degrade that request to a structured ``error``
+                       terminal state — the batch keeps serving, never
+                       wrong tokens — while ``crash`` kills the replica
+                       (the router drains it) (docs/ADAPTERS.md)
 ``engine.decode``      ``InferenceEngine.decode_slots`` public wrapper
 ``engine.verify``      ``InferenceEngine.verify_slots`` public wrapper
                        (speculative verify); the scheduler degrades the
@@ -142,6 +149,7 @@ KNOWN_SITES = {
     "engine.prefill", "engine.decode", "engine.verify",
     "cache.allocate", "cache.ensure", "cache.match", "cache.cow",
     "cache.quantize", "cache.spill", "cache.restore", "cache.host_corrupt",
+    "cache.adapter_load",
     "checkpoint.pre_commit", "checkpoint.commit",
     "router.dispatch", "router.step", "router.drain",
 }

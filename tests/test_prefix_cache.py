@@ -367,11 +367,13 @@ def test_serving_compile_contract_with_prefix_cache(devices):
         srv.run([ServeRequest(rid=2, prompt=div, max_new_tokens=4)])
     assert srv.cache.cow_copies >= 1                  # COW ran inside watch
     assert srv.stats["prefix_hits"] >= 2
-    # under DS_KV_QUANT=int8 the active set is the _q jit twins — the
-    # per-program count contract (incl. the COW copy) is the same
+    # under DS_KV_QUANT=int8 / DS_LORA_SERVE=on the active set is the
+    # _q / _l / _ql jit twin family — the per-program count contract is
+    # the same (COW copies blocks, not adapters: no _l twin there)
     quant = srv.kv_quant == "int8"
-    pf = eng._prefill_slot_q if quant else eng._prefill_slot
-    dc = eng._decode_slots_q if quant else eng._decode_slots
+    sfx = ("_q" if quant else "") + ("_l" if srv.lora_serve else "")
+    pf = getattr(eng, "_prefill_slot" + sfx)
+    dc = getattr(eng, "_decode_slots" + sfx)
     cw = eng._cow_blocks_q if quant else eng._cow_blocks
     n_prefill = cache_size(pf)
     if n_prefill is not None:

@@ -312,11 +312,13 @@ def test_serving_compile_count_contract(devices):
     assert srv.stats["evictions"] >= 1     # the workload really preempts
     # exactly two compiled serving programs after warmup — one prefill
     # (chunks are padded to prefill_chunk, so ONE shape) and one decode.
-    # Under DS_KV_QUANT=int8 the active set is the _q jit twins; the
-    # program COUNT contract is identical either way
-    quant = srv.kv_quant == "int8"
-    pf = eng._prefill_slot_q if quant else eng._prefill_slot
-    dc = eng._decode_slots_q if quant else eng._decode_slots
+    # Under DS_KV_QUANT=int8 / DS_LORA_SERVE=on the active set is the
+    # _q / _l / _ql jit twin family; the program COUNT contract is
+    # identical in every mode
+    sfx = ("_q" if srv.kv_quant == "int8" else "") + \
+          ("_l" if srv.lora_serve else "")
+    pf = getattr(eng, "_prefill_slot" + sfx)
+    dc = getattr(eng, "_decode_slots" + sfx)
     n_prefill = cache_size(pf)
     n_decode = cache_size(dc)
     if n_prefill is not None:

@@ -325,12 +325,14 @@ def test_spec_compile_count_contract(devices):
 
     srv, warm_out = run_workload()
     assert srv.stats["evictions"] >= 1   # the workload really preempts
-    # under DS_KV_QUANT=int8 the active set is the _q jit twins; the
-    # verify-replaces-decode count contract is identical either way
-    quant = srv.kv_quant == "int8"
-    pf = eng._prefill_slot_q if quant else eng._prefill_slot
-    vf = eng._verify_slots_q if quant else eng._verify_slots
-    dc = eng._decode_slots_q if quant else eng._decode_slots
+    # under DS_KV_QUANT=int8 / DS_LORA_SERVE=on the active set is the
+    # _q / _l / _ql jit twin family; the verify-replaces-decode count
+    # contract is identical in every mode
+    sfx = ("_q" if srv.kv_quant == "int8" else "") + \
+          ("_l" if srv.lora_serve else "")
+    pf = getattr(eng, "_prefill_slot" + sfx)
+    vf = getattr(eng, "_verify_slots" + sfx)
+    dc = getattr(eng, "_decode_slots" + sfx)
     n_prefill = cache_size(pf)
     n_verify = cache_size(vf)
     n_decode = cache_size(dc)

@@ -40,6 +40,43 @@ if [[ "${1:-}" == "chaos" ]]; then
     DS_FAULTS="cache.spill:cache_exhausted@0;cache.restore:cache_exhausted@1;cache.host_corrupt:cache_exhausted@0" \
         python -m pytest tests/test_host_tier.py \
         -k "parity or drain_releases" -q
+    # adapter-load injection against the AMBIENT injector install path
+    # (the suite's own chaos test builds its injector explicitly): the
+    # first acquire fails -> that request retires state="error" with the
+    # pool untouched, the co-batched base request keeps parity, and the
+    # same tenant loads cleanly once the window passes — degraded loads
+    # never become wrong tokens (docs/ADAPTERS.md, docs/ROBUSTNESS.md)
+    echo "gate(chaos): adapter-load injection (ambient DS_FAULTS, DS_FAULT_SEED=0)"
+    DS_FAULT_SEED=0 DS_FAULTS="cache.adapter_load:cache_exhausted@0" \
+    DS_LORA_SERVE=on python - <<'PYEOF'
+import jax, jax.numpy as jnp, numpy as np
+from deepspeed_tpu.inference.engine import InferenceEngine
+from deepspeed_tpu.inference.serving import ServeRequest, ServingEngine
+from deepspeed_tpu.models import gpt
+from deepspeed_tpu.runtime.lora import add_lora, adapter_state_dict
+
+cfg = gpt.GPTConfig(vocab_size=128, n_layers=2, n_heads=4, d_model=32,
+                    max_seq_len=64, use_flash_attention=False, remat=False,
+                    dtype=jnp.float32)
+params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+eng = InferenceEngine(config=cfg, params=params, dtype=jnp.float32)
+p1, p2 = (np.arange(3, 11, dtype=np.int32), np.arange(20, 27, dtype=np.int32))
+ref = eng.generate(p2[None], max_new_tokens=5)[0]
+srv = ServingEngine(eng, num_slots=2, block_size=4, num_blocks=24,
+                    lora_pool_blocks=2, lora_max_rank=4, lora_rank_block=4)
+srv.register_adapter("t1", adapter_state_dict(
+    add_lora(params, rng=jax.random.PRNGKey(1), rank=4, alpha=8.0)))
+bad = ServeRequest(rid="bad", prompt=p1, max_new_tokens=5, adapter_id="t1")
+ok = ServeRequest(rid="ok", prompt=p2, max_new_tokens=5)
+out = srv.run([bad, ok])
+assert bad.state == "error" and ok.state == "done", (bad.state, ok.state)
+np.testing.assert_array_equal(out["ok"], ref)
+assert srv.adapters.stats()["resident"] == 0, "failed load leaked pool state"
+retry = ServeRequest(rid="r", prompt=p1, max_new_tokens=5, adapter_id="t1")
+srv.run([retry])
+assert retry.state == "done", retry.state
+print("gate(chaos): adapter-load degrade ok")
+PYEOF
 elif [[ "${1:-}" == "quick" ]]; then
     # lint the changed .py files PLUS their direct importers (--closure
     # quick mode, cached import graph from the last full run) so the
@@ -128,6 +165,17 @@ else
     DS_KV_HOST_TIER=on DS_PREFIX_CACHE=on python -m pytest \
         tests/test_serving.py tests/test_prefix_cache.py \
         tests/test_host_tier.py tests/test_chaos.py -q
+    # multi-tenant LoRA knob smoke: the suite default leaves
+    # DS_LORA_SERVE unset (= off, the base-only bit-reference with zero
+    # lora programs), so rerun the serving + spec + prefix suites once
+    # with the adapter subsystem forced ON — base-only traffic must
+    # stay bit-identical through the _l twins' zero trash-block row,
+    # and the compile contract must hold on the lora program set
+    # (docs/ADAPTERS.md)
+    echo "gate: serving smoke (DS_LORA_SERVE=on)"
+    DS_LORA_SERVE=on python -m pytest tests/test_serving.py \
+        tests/test_spec_serving.py tests/test_prefix_cache.py \
+        tests/test_adapter_serving.py -q
     # sampled-mode smoke: the suites above exercise temperature=0
     # requests by default, so rerun the sampling + spec suites once
     # with speculation forced ON — this is the path where sampled

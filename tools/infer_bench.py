@@ -747,6 +747,188 @@ def bench_serving_router_compare(name, preset=None, num_requests=12,
     }), flush=True)
 
 
+def bench_serving_lora_compare(name, preset=None, num_requests=10,
+                               mean_gap_steps=2.0, prompt_lens=(6, 14),
+                               new_tokens=8, num_slots=2, block_size=8,
+                               num_blocks=None, prefill_chunk=16,
+                               n_adapters=3, rank=4,
+                               lora_pool_blocks=None, seed=0):
+    """Multi-tenant LoRA serving (docs/ADAPTERS.md), three legs over
+    one seeded tenant population: (a) merged-single — adapter 0 baked
+    into the weights with ``merge_lora``, base-only serving (the
+    pre-subsystem reference and the ms/token floor); (b)
+    unmerged-single — the SAME requests through the adapter pool, whose
+    greedy streams must be IDENTICAL to (a); (c) mixed — a
+    Zipf-popular multi-adapter + base-only population in one engine,
+    every stream checked against its own tenant's merged reference.
+    The row is the bit-parity verdict, the pool's hit/load/eviction
+    economics, and the ms/token price of the gathered low-rank
+    matmuls."""
+    from deepspeed_tpu.models import gpt
+    import deepspeed_tpu
+    from deepspeed_tpu.inference.serving import ServeRequest, ServingEngine
+    from deepspeed_tpu.runtime.lora import (add_lora, adapter_state_dict,
+                                            merge_lora)
+
+    on_tpu = "tpu" in (jax.devices()[0].platform +
+                       jax.devices()[0].device_kind).lower()
+    max_seq = prompt_lens[1] + new_tokens + 8
+    if preset:
+        cfg = gpt.preset(preset, max_seq_len=max_seq, dtype=jnp.bfloat16,
+                         use_flash_attention=on_tpu)
+    else:
+        cfg = gpt.GPTConfig(vocab_size=512, n_layers=4, n_heads=8,
+                            d_model=256, max_seq_len=max_seq,
+                            use_flash_attention=False, remat=False,
+                            dtype=jnp.float32)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+
+    # n_adapters distinct fine-tunes: add_lora's B starts at zero (a
+    # zero delta would make every leg trivially identical), so each
+    # tenant gets seeded noise in B — distinct, nonzero deltas
+    exports = []
+    merged = []
+    for t in range(n_adapters):
+        lp = add_lora(params, rank=rank, alpha=2.0 * rank,
+                      rng=jax.random.PRNGKey(seed + 100 + t))
+        nrng = np.random.default_rng(seed + 200 + t)
+        blk = dict(lp["block"])
+        for tgt, entry in blk.items():
+            if isinstance(entry, dict) and "lora_b" in entry:
+                e = dict(entry)
+                e["lora_b"] = jnp.asarray(
+                    nrng.standard_normal(e["lora_b"].shape) * 0.05,
+                    jnp.float32)
+                blk[tgt] = e
+        lp = dict(lp)
+        lp["block"] = blk
+        exports.append(adapter_state_dict(lp))
+        merged.append(merge_lora(lp))
+
+    rng = np.random.default_rng(seed)
+    arrive = np.floor(np.cumsum(
+        rng.exponential(mean_gap_steps, num_requests))).astype(int)
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            rng.integers(*prompt_lens)).astype(np.int32)
+               for _ in range(num_requests)]
+    # Zipf-popular tenant per request, with a base-only fraction; rid 0
+    # pinned to tenant 0 so the single-adapter legs are never empty
+    tenants = [0] + [
+        None if rng.random() < 0.25
+        else (int(rng.zipf(1.5)) - 1) % n_adapters
+        for _ in range(num_requests - 1)]
+
+    def mk_reqs(only=None):
+        return [ServeRequest(
+                    rid=i, prompt=prompts[i].copy(),
+                    max_new_tokens=new_tokens,
+                    adapter_id=(f"tenant-{tenants[i]}"
+                                if tenants[i] is not None else None))
+                for i in range(num_requests)
+                if only is None or tenants[i] == only]
+
+    def drive(srv, reqs, register=()):
+        for aid, sd in register:
+            srv.register_adapter(aid, sd)
+        t0 = time.perf_counter()
+        s = nxt = 0
+        byrid = {r.rid: r for r in reqs}
+        order = sorted(byrid)
+        while nxt < len(order) or srv.busy:
+            while nxt < len(order) and arrive[order[nxt]] <= s:
+                srv.submit(byrid[order[nxt]], now=time.perf_counter())
+                nxt += 1
+            srv.step(now=time.perf_counter())
+            s += 1
+        wall = time.perf_counter() - t0
+        gen = sum(len(r.out) for r in srv.finished)
+        return ({r.rid: r.tokens.tolist() for r in srv.finished},
+                round(wall / max(gen, 1) * 1e3, 3))
+
+    def mk_srv(eng, lora=False):
+        return ServingEngine(
+            eng, num_slots=num_slots, block_size=block_size,
+            num_blocks=num_blocks, prefill_chunk=prefill_chunk,
+            spec_decode=False, lora_serve=lora,
+            lora_pool_blocks=lora_pool_blocks if lora else None)
+
+    # per-tenant merged reference engines (+ the plain base engine for
+    # base-only requests); compile outside the timed legs via warmup
+    eng_base = deepspeed_tpu.init_inference(model=(cfg, params),
+                                            dtype=dtype)
+    engs_merged = [deepspeed_tpu.init_inference(model=(cfg, m),
+                                                dtype=dtype)
+                   for m in merged]
+    eng_lora = deepspeed_tpu.init_inference(model=(cfg, params),
+                                            dtype=dtype)
+    warm = [ServeRequest(rid="w", prompt=prompts[0].copy(),
+                        max_new_tokens=2)]
+    mk_srv(eng_base).run([ServeRequest(rid="w", prompt=prompts[0].copy(),
+                                       max_new_tokens=2)])
+    for e in engs_merged:
+        mk_srv(e).run([ServeRequest(rid="w", prompt=prompts[0].copy(),
+                                    max_new_tokens=2)])
+    wsrv = mk_srv(eng_lora, lora=True)
+    wsrv.register_adapter("tenant-0", exports[0])
+    warm[0].adapter_id = "tenant-0"
+    wsrv.run(warm)
+
+    # reference streams: every tenant's requests through ITS merged
+    # engine, base-only requests through the base engine (burst drive —
+    # greedy slot streams are batching-independent by contract)
+    refs = {}
+    for t in range(n_adapters):
+        reqs = [ServeRequest(rid=r.rid, prompt=r.prompt,
+                             max_new_tokens=r.max_new_tokens)
+                for r in mk_reqs(only=t)]
+        if reqs:
+            refs.update(mk_srv(engs_merged[t]).run(reqs))
+    base_reqs = [ServeRequest(rid=r.rid, prompt=r.prompt,
+                              max_new_tokens=r.max_new_tokens)
+                 for r in mk_reqs(only=None) if r.adapter_id is None]
+    if base_reqs:
+        refs.update(mk_srv(eng_base).run(base_reqs))
+    refs = {rid: np.asarray(t).tolist() for rid, t in refs.items()}
+
+    # leg (a): merged-single — tenant 0 baked in, base-only serving
+    out_m, mspt_merged = drive(mk_srv(engs_merged[0]),
+                               [ServeRequest(rid=r.rid, prompt=r.prompt,
+                                             max_new_tokens=r.max_new_tokens)
+                                for r in mk_reqs(only=0)])
+    # leg (b): unmerged-single — same requests through the pool
+    out_u, mspt_unmerged = drive(mk_srv(eng_lora, lora=True),
+                                 mk_reqs(only=0),
+                                 register=[("tenant-0", exports[0])])
+    # leg (c): mixed-adapter batch, full population
+    srv_x = mk_srv(eng_lora, lora=True)
+    out_x, mspt_mixed = drive(
+        srv_x, mk_reqs(),
+        register=[(f"tenant-{t}", exports[t])
+                  for t in range(n_adapters)])
+    st = srv_x.stats
+    pool = srv_x.adapters.stats()
+    acq = st["adapter_hits"] + st["adapter_loads"]
+    print(json.dumps({
+        "config": name, "preset": preset or "cpu-smoke",
+        "lora": f"merged-vs-unmerged-vs-mixed({n_adapters} adapters)",
+        "num_requests": num_requests, "n_adapters": n_adapters,
+        "rank": rank, "pool_blocks": pool["pool_blocks"],
+        "single_adapter_identical": out_u == out_m,
+        "output_identical": all(out_x.get(rid) == refs[rid]
+                                for rid in refs),
+        "base_only_requests": sum(1 for t in tenants if t is None),
+        "adapter_hit_rate": round(st["adapter_hits"] / max(acq, 1), 3),
+        "adapter_loads": st["adapter_loads"],
+        "adapter_evictions": st["adapter_evictions"],
+        "adapter_load_errors": st["adapter_load_errors"],
+        "ms_per_token_merged_single": mspt_merged,
+        "ms_per_token_unmerged_single": mspt_unmerged,
+        "ms_per_token_mixed": mspt_mixed,
+        "ms_per_token_delta": round(mspt_unmerged - mspt_merged, 3),
+    }), flush=True)
+
+
 def bench_serving_autoscale_compare(name, preset=None, num_slots=2,
                                     block_size=8, num_blocks=None,
                                     prefill_chunk=16, max_replicas=3,
@@ -971,6 +1153,22 @@ SERVE_COMPARE_CONFIGS = [
         mode="autoscale", preset="gpt2-medium", num_slots=4,
         block_size=16, prefill_chunk=64, max_replicas=3, ttft_slo=12.0,
         phases=((6, 0.2), (60, 0.5), (30, 0.05)))),
+    # multi-tenant LoRA serving: merged-single vs unmerged-single must
+    # stream identically (the bit-parity contract), and the mixed
+    # Zipf-tenant drive must match per-tenant merged references while
+    # the constrained pool (smoke: 3 blocks < 4 tenants, pinned slots
+    # can never exhaust it) reports loads/hits/evictions; the
+    # ms_per_token delta is the gathered low-rank matmuls' price
+    ("serve-lora-smoke", dict(mode="lora", num_requests=10,
+                              mean_gap_steps=2.0, prompt_lens=(6, 14),
+                              new_tokens=8, num_slots=2, block_size=8,
+                              prefill_chunk=16, n_adapters=4, rank=4,
+                              lora_pool_blocks=3)),
+    ("serve-lora-gpt2-medium", dict(
+        mode="lora", preset="gpt2-medium", num_requests=24,
+        mean_gap_steps=1.5, prompt_lens=(16, 96), new_tokens=32,
+        num_slots=4, block_size=16, prefill_chunk=64, n_adapters=4,
+        rank=8)),
 ]
 
 
@@ -1073,6 +1271,7 @@ def main():
                    "router": bench_serving_router_compare,
                    "sampling": bench_serving_sampling_compare,
                    "autoscale": bench_serving_autoscale_compare,
+                   "lora": bench_serving_lora_compare,
                    }.get(mode, bench_serving_impl_compare)
         try:
             compare(name, **kw)

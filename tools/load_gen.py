@@ -11,7 +11,10 @@ bench row regressed bit-for-bit. This module provides both halves:
   shared-system-prompt turns, interactive-heavy), ``rag`` (long-prefill
   retrieval contexts, short answers), ``repetitive`` (tiny-alphabet
   highly-predictable prompts, the spec-decode-friendly shape, batch-
-  heavy) and ``heavy_tail`` (adversarial Pareto-tailed lengths);
+  heavy), ``heavy_tail`` (adversarial Pareto-tailed lengths) and
+  ``multitenant`` (a Zipf-popular LoRA tenant population plus a
+  base-only fraction — the adapter-pool / adapter-affinity shape,
+  docs/ADAPTERS.md);
 - **arrivals**: an open-loop Poisson process over piecewise-constant
   rate ``phases`` (``[(duration, rate), ...]`` — a spike is just a
   high-rate middle phase), or a burst (every request at t=0) for
@@ -43,7 +46,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 # terminal request states the drive loop treats as "finished"
-_TERMINAL = ("done", "timeout", "shed")
+_TERMINAL = ("done", "timeout", "shed", "error")
 
 # mix parameters: prompt/output length ranges are inclusive uniform
 # unless pareto=True (heavy tail: lo + Pareto(alpha) * scale, clipped);
@@ -61,6 +64,13 @@ MIXES: Dict[str, Dict[str, Any]] = {
                        alphabet=8, batch_frac=0.7, pareto=False),
     "heavy_tail": dict(plen=(3, 40), new=(2, 24), shared_prefix=0,
                        alphabet=None, batch_frac=0.5, pareto=True),
+    # adapters: tenant population size; zipf_a: popularity skew (a few
+    # hot tenants, a long warm tail — the pool-hit/eviction shape);
+    # base_frac: requests that name no adapter at all. shared_prefix
+    # stays 0: adapter requests bypass prefix sharing by design
+    "multitenant": dict(plen=(6, 16), new=(4, 12), shared_prefix=0,
+                        alphabet=None, batch_frac=0.2, pareto=False,
+                        adapters=6, zipf_a=1.5, base_frac=0.25),
 }
 
 TRACE_VERSION = 1
@@ -120,6 +130,14 @@ def make_requests(*, seed: int, mix: str = "chat", n: Optional[int] = None,
             return int(min(max(v, lo), hi))
         return int(rng.integers(lo, hi + 1))
 
+    def adapter() -> Optional[str]:
+        n_adapters = params.get("adapters")
+        if not n_adapters or rng.random() < params.get("base_frac", 0.0):
+            return None
+        # Zipf draw folded onto the tenant population: tenant-0 is the
+        # hot adapter, the tail stays warm (the LRU-pool shape)
+        return f"tenant-{(int(rng.zipf(params['zipf_a'])) - 1) % n_adapters}"
+
     out: List[Dict] = []
     for i, at in enumerate(ats):
         plen = min(length(*params["plen"]), max_prompt_len)
@@ -131,6 +149,7 @@ def make_requests(*, seed: int, mix: str = "chat", n: Optional[int] = None,
             "kind": mix,
             "priority": ("batch" if rng.random() < params["batch_frac"]
                          else "interactive"),
+            "adapter_id": adapter(),
             "prompt": [int(t) for t in prompt],
             "max_new_tokens": length(*params["new"]),
         })
@@ -164,7 +183,8 @@ def _mk_serve_requests(entries: List[Dict]) -> List:
     return [ServeRequest(rid=e["rid"],
                          prompt=np.asarray(e["prompt"], np.int32),
                          max_new_tokens=int(e["max_new_tokens"]),
-                         priority=e.get("priority"))
+                         priority=e.get("priority"),
+                         adapter_id=e.get("adapter_id"))
             for e in entries]
 
 
