@@ -720,6 +720,15 @@ class InferenceEngine:
             self._decode_slots = jax.jit(self._decode_slots_fn,
                                          donate_argnums=(1, 2),
                                          static_argnums=(7,))
+            # fused multi-step decode (DS_DECODE_HORIZON > 1,
+            # docs/MULTISTEP.md): the SAME donated-pool decode body
+            # scanned N times on-device with the stop/length predicates
+            # as in-program masks. n_steps joins impl as a static — a
+            # serving run pins one N, so steady state stays at the same
+            # program count, and N=1 serving never compiles this family
+            self._decode_horizon = jax.jit(self._decode_horizon_fn,
+                                           donate_argnums=(1, 2),
+                                           static_argnums=(7, 8))
             # speculative verify: all k+1 chunk positions per slot in
             # ONE extended-decode program — when serving runs with
             # spec_decode on, this REPLACES the plain decode program in
@@ -750,6 +759,9 @@ class InferenceEngine:
             self._decode_slots_q = jax.jit(self._decode_slots_q_fn,
                                            donate_argnums=(1, 2, 3, 4),
                                            static_argnums=(9,))
+            self._decode_horizon_q = jax.jit(self._decode_horizon_q_fn,
+                                             donate_argnums=(1, 2, 3, 4),
+                                             static_argnums=(9, 10))
             self._verify_slots_q = jax.jit(self._verify_slots_q_fn,
                                            donate_argnums=(1, 2, 3, 4),
                                            static_argnums=(9,))
@@ -769,6 +781,9 @@ class InferenceEngine:
             self._decode_slots_l = jax.jit(self._decode_slots_l_fn,
                                            donate_argnums=(1, 2),
                                            static_argnums=(7,))
+            self._decode_horizon_l = jax.jit(self._decode_horizon_l_fn,
+                                             donate_argnums=(1, 2),
+                                             static_argnums=(7, 8))
             self._verify_slots_l = jax.jit(self._verify_slots_l_fn,
                                            donate_argnums=(1, 2),
                                            static_argnums=(7,))
@@ -777,6 +792,9 @@ class InferenceEngine:
             self._decode_slots_ql = jax.jit(self._decode_slots_ql_fn,
                                             donate_argnums=(1, 2, 3, 4),
                                             static_argnums=(9,))
+            self._decode_horizon_ql = jax.jit(self._decode_horizon_ql_fn,
+                                              donate_argnums=(1, 2, 3, 4),
+                                              static_argnums=(9, 10))
             self._verify_slots_ql = jax.jit(self._verify_slots_ql_fn,
                                             donate_argnums=(1, 2, 3, 4),
                                             static_argnums=(9,))
@@ -1304,6 +1322,168 @@ class InferenceEngine:
                       lora_a, lora_b))
         return self._logits(params, x), ks, vs, kss, vss
 
+    def _decode_horizon_core(self, params, k_pool, v_pool, tables, lengths,
+                             tokens, active, impl, n_steps, lanes, preds,
+                             k_scale=None, v_scale=None, lora_ops=None):
+        """N fused decode iterations in ONE compiled program
+        (docs/MULTISTEP.md): the _decode_slots_fn body — paged attention
+        with trash-block write routing, the fused sampler with its pure
+        fold_in key chain advanced per iteration — wrapped in an OUTER
+        lax.scan over the step index, with the budget / eos /
+        stop-sequence predicates evaluated in-program as per-slot done
+        masks. A finished lane FREEZES: its length stops advancing (so
+        its writes route to the trash block through the active mask),
+        its carried token stops updating, and its later sampled lanes
+        are dead outputs the harvest never reads (``produced`` counts
+        the real ones). Iteration 0 is bit-identical to the N=1 decode
+        program, and each later live iteration sees exactly the state
+        the next N=1 dispatch would have seen (the key chain advances by
+        the per-slot emitted count), so token streams match N=1
+        bit-for-bit.
+
+        Shared by all four twins — quant (``k_scale``/``v_scale``) and
+        LoRA (``lora_ops``) compose by Python-level xs-tuple layout, not
+        new hand-written scan bodies. ``preds``: budgets [B] (tokens
+        this slot may emit this horizon), eos_ids [B] (-1 = none),
+        stop_ids [B, S, W] right-aligned, stop_lens [B, S] (0 = unused
+        row), tail [B, W] (the slot's last W emitted tokens, -1
+        padded). Returns ([N, B] tokens, [N, B] logprobs, [B] produced,
+        [B] done, pools...)."""
+        cfg = self.cfg
+        keys, gen_counts, temps, top_ks, top_ps, rep_pens, seen = lanes
+        budgets, eos_ids, stop_ids, stop_lens, tail = preds
+        B = tokens.shape[0]
+        W = tail.shape[1]
+        quant = k_scale is not None
+        rows = jnp.arange(B)
+
+        def step(carry, i):
+            tok, lens, live, produced, seen_c, tail_c, pools = carry
+            lane_active = jnp.logical_and(active, live)
+            x = params["wte"]["embedding"][tok[:, None]]
+            if cfg.use_wpe:
+                safe = jnp.clip(lens, 0, self.max_seq_len - 1)
+                x = x + params["wpe"]["embedding"][safe][:, None]
+
+            xs = (params["block"],) + pools
+            if lora_ops is not None:
+                xs = xs + (lora_ops[0], lora_ops[1])
+
+            def body(x, layer):
+                kw = {}
+                if quant:
+                    kw["k_scale"], kw["v_scale"] = layer[3], layer[4]
+                if lora_ops is not None:
+                    kw["lora"] = self._gather_lora(layer[-2], layer[-1],
+                                                   lora_ops[2])
+                out = _block_decode_paged(x, layer[1], layer[2], tables,
+                                          lens, lane_active, layer[0],
+                                          cfg, impl=impl, **kw)
+                return out[0], tuple(out[1:])
+
+            x, pools = jax.lax.scan(body, x, xs)
+            logits = self._logits(params, x)
+            toks_i, lps_i = sampling.sample_tokens(
+                logits[:, -1], keys, gen_counts + i, temps, top_ks,
+                top_ps, rep_pens, seen_c)
+
+            emit = lane_active
+            tok = jnp.where(emit, toks_i, tok)
+            lens = lens + emit.astype(jnp.int32)
+            produced = produced + emit.astype(jnp.int32)
+            # the host mirror marks ``seen`` only on penalized lanes;
+            # marking every emitting lane is bitwise-inert at pen==1.0
+            # (the penalty divides by 1.0), so one program serves both
+            marked = seen_c.at[rows, toks_i].set(True)
+            seen_c = jnp.where(emit[:, None], marked, seen_c)
+            rolled = jnp.concatenate([tail_c[:, 1:], toks_i[:, None]], 1)
+            tail_c = jnp.where(emit[:, None], rolled, tail_c)
+
+            total = gen_counts + produced
+            budget_done = produced >= budgets
+            eos_done = jnp.logical_and(eos_ids >= 0, toks_i == eos_ids)
+            at = jnp.arange(W, dtype=jnp.int32)
+            # right-aligned suffix compare, gated so the -1 tail padding
+            # of a short stream can never satisfy a real stop row
+            valid = at[None, None, :] >= (W - stop_lens)[:, :, None]
+            hit = jnp.all(jnp.logical_or(jnp.logical_not(valid),
+                                         tail_c[:, None, :] == stop_ids),
+                          axis=-1)
+            hit = jnp.logical_and(hit, stop_lens > 0)
+            hit = jnp.logical_and(hit, total[:, None] >= stop_lens)
+            done_now = jnp.logical_and(
+                emit, budget_done | eos_done | jnp.any(hit, axis=-1))
+            live = jnp.logical_and(live, jnp.logical_not(done_now))
+            return (tok, lens, live, produced, seen_c, tail_c,
+                    pools), (toks_i, lps_i)
+
+        pools0 = (k_pool, v_pool) + ((k_scale, v_scale) if quant else ())
+        init = (tokens, lengths, active, jnp.zeros_like(lengths), seen,
+                tail, pools0)
+        carry, (toks, lps) = jax.lax.scan(
+            step, init, jnp.arange(n_steps, dtype=jnp.int32))
+        _, _, live, produced, _, _, pools = carry
+        return (toks, lps, produced, jnp.logical_not(live)) + pools
+
+    def _decode_horizon_fn(self, params, k_pool, v_pool, tables, lengths,
+                           tokens, active, impl, n_steps, keys, gen_counts,
+                           temps, top_ks, top_ps, rep_pens, seen, budgets,
+                           eos_ids, stop_ids, stop_lens, tail):
+        """Fused multi-step decode for every serving slot
+        (_decode_horizon_core): n_steps joins impl as a STATIC jit
+        argument — a serving run pins one N, so the steady-state
+        program count is unchanged (and N=1 serving never compiles
+        this family at all)."""
+        return self._decode_horizon_core(
+            params, k_pool, v_pool, tables, lengths, tokens, active,
+            impl, n_steps,
+            (keys, gen_counts, temps, top_ks, top_ps, rep_pens, seen),
+            (budgets, eos_ids, stop_ids, stop_lens, tail))
+
+    def _decode_horizon_q_fn(self, params, k_pool, v_pool, k_scale,
+                             v_scale, tables, lengths, tokens, active,
+                             impl, n_steps, keys, gen_counts, temps,
+                             top_ks, top_ps, rep_pens, seen, budgets,
+                             eos_ids, stop_ids, stop_lens, tail):
+        """int8-pool twin of _decode_horizon_fn: the scale pools thread
+        through the same core's scan carry (see _block_decode_paged's
+        quantized write path)."""
+        return self._decode_horizon_core(
+            params, k_pool, v_pool, tables, lengths, tokens, active,
+            impl, n_steps,
+            (keys, gen_counts, temps, top_ks, top_ps, rep_pens, seen),
+            (budgets, eos_ids, stop_ids, stop_lens, tail),
+            k_scale=k_scale, v_scale=v_scale)
+
+    def _decode_horizon_l_fn(self, params, k_pool, v_pool, tables, lengths,
+                             tokens, active, impl, n_steps, keys,
+                             gen_counts, temps, top_ks, top_ps, rep_pens,
+                             seen, budgets, eos_ids, stop_ids, stop_lens,
+                             tail, lora_a, lora_b, ablocks):
+        """LoRA twin of _decode_horizon_fn: the adapter pools ride the
+        same core's xs layout, gathered per layer per iteration."""
+        return self._decode_horizon_core(
+            params, k_pool, v_pool, tables, lengths, tokens, active,
+            impl, n_steps,
+            (keys, gen_counts, temps, top_ks, top_ps, rep_pens, seen),
+            (budgets, eos_ids, stop_ids, stop_lens, tail),
+            lora_ops=(lora_a, lora_b, ablocks))
+
+    def _decode_horizon_ql_fn(self, params, k_pool, v_pool, k_scale,
+                              v_scale, tables, lengths, tokens, active,
+                              impl, n_steps, keys, gen_counts, temps,
+                              top_ks, top_ps, rep_pens, seen, budgets,
+                              eos_ids, stop_ids, stop_lens, tail, lora_a,
+                              lora_b, ablocks):
+        """int8-pool + LoRA combo twin of _decode_horizon_fn."""
+        return self._decode_horizon_core(
+            params, k_pool, v_pool, tables, lengths, tokens, active,
+            impl, n_steps,
+            (keys, gen_counts, temps, top_ks, top_ps, rep_pens, seen),
+            (budgets, eos_ids, stop_ids, stop_lens, tail),
+            k_scale=k_scale, v_scale=v_scale,
+            lora_ops=(lora_a, lora_b, ablocks))
+
     def _cow_blocks_q_fn(self, k_pool, v_pool, k_scale, v_scale, src, dst):
         """Quantized-pool COW: the block's scales travel with its int8
         payload (paged_cache._cow wires this in when kv_quant=int8)."""
@@ -1462,6 +1642,51 @@ class InferenceEngine:
             jnp.asarray(tokens, jnp.int32), jnp.asarray(active, bool),
             self.decode_impl if impl is None else impl, *lanes, *largs)
         return (out[0],) + out[3:] if legacy else out
+
+    def decode_horizon(self, k_pool, v_pool, tables, lengths, tokens,
+                       active, n_steps, budgets, eos_ids, stop_ids,
+                       stop_lens, tail, impl=None, k_scale=None,
+                       v_scale=None, sample_state=None, lora=None):
+        """Fused multi-step decode for every serving slot: n_steps
+        iterations of the decode body in ONE dispatch, with per-slot
+        emission budgets and eos/stop predicates freezing finished
+        lanes in-program (_decode_horizon_core, docs/MULTISTEP.md).
+        Returns ([n_steps, B] tokens, [n_steps, B] logprobs, [B]
+        produced counts, [B] done flags, updated pools). The
+        ``engine.decode`` site (and ``cache.quantize`` with int8 pools)
+        fires BEFORE the dispatch touches the donated pools, so the
+        serving engine can degrade a faulted horizon to single-step
+        decode against intact buffers."""
+        from deepspeed_tpu.utils.faults import maybe_fire
+        maybe_fire("engine.decode")
+        lanes = self._samp_lanes(sample_state, len(np.asarray(tokens)),
+                                 self.cfg.vocab_size)
+        largs = self._lora_operands(lora)
+        preds = (jnp.asarray(budgets, jnp.int32),
+                 jnp.asarray(eos_ids, jnp.int32),
+                 jnp.asarray(stop_ids, jnp.int32),
+                 jnp.asarray(stop_lens, jnp.int32),
+                 jnp.asarray(tail, jnp.int32))
+        if k_scale is None:
+            df = (self._decode_horizon if lora is None
+                  else self._decode_horizon_l)
+            return df(
+                self.params, k_pool, v_pool,
+                jnp.asarray(tables, jnp.int32),
+                jnp.asarray(lengths, jnp.int32),
+                jnp.asarray(tokens, jnp.int32), jnp.asarray(active, bool),
+                self.decode_impl if impl is None else impl, int(n_steps),
+                *lanes, *preds, *largs)
+        maybe_fire("cache.quantize")
+        df = (self._decode_horizon_q if lora is None
+              else self._decode_horizon_ql)
+        return df(
+            self.params, k_pool, v_pool, k_scale, v_scale,  # dslint: disable=DS003 — exclusive branch: the fp dispatch above already returned
+            jnp.asarray(tables, jnp.int32),
+            jnp.asarray(lengths, jnp.int32),
+            jnp.asarray(tokens, jnp.int32), jnp.asarray(active, bool),
+            self.decode_impl if impl is None else impl, int(n_steps),
+            *lanes, *preds, *largs)
 
     def verify_slots(self, k_pool, v_pool, tables, lengths, tokens, active,
                      impl=None, k_scale=None, v_scale=None, lora=None):

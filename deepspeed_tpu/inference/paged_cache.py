@@ -679,6 +679,25 @@ class PagedKVCache:
         on how far a speculative chunk may advance before rollback."""
         return len(self._owned[slot]) * self.block_size
 
+    def horizon_budget(self, slot: int, n_tokens: int) -> int:
+        """Opportunistic capacity grant for a fused multi-step decode
+        (docs/MULTISTEP.md): try to grow the slot's table to cover
+        ``n_tokens`` total positions, but — unlike :meth:`ensure_capacity`
+        — treat a dry pool as a smaller horizon, not a failure. Returns
+        the TOTAL token positions actually granted; the scheduler caps
+        the slot's in-program emission budget there, so horizon tokens
+        beyond the guaranteed first never trigger eviction (the plain
+        one-token preamble already secured that one). The in-scan write
+        path needs no rollback: a lane frozen at its budget stops
+        advancing its length, so no write ever lands past the grant."""
+        want = min(int(n_tokens), self.tokens_per_slot)
+        if want > self.capacity_tokens(slot):
+            try:
+                self.ensure_capacity(slot, want)
+            except CacheExhausted:
+                pass
+        return min(self.capacity_tokens(slot), self.tokens_per_slot)
+
     def rollback(self, slot: int, n_tokens: int) -> None:
         """Shrink the slot's logical length to ``n_tokens`` and RELEASE
         any owned tail block the shorter length no longer covers — the

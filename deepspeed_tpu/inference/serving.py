@@ -155,10 +155,20 @@ from deepspeed_tpu.telemetry import (NOOP, MetricsRegistry, NoopTelemetry,
                                      RATE_BUCKETS, TEMP_BUCKETS, Telemetry,
                                      resolve_telemetry)
 from deepspeed_tpu.utils import faults as faults_lib
+from deepspeed_tpu.utils.env import resolve_decode_horizon
 from deepspeed_tpu.utils.faults import TransientDeviceError
 from deepspeed_tpu.utils.logging import logger
 
 TERMINAL_STATES = ("done", "timeout", "shed", "error")
+
+# in-program stop-sequence modeling caps for the fused multi-step decode
+# (docs/MULTISTEP.md): a stop longer than HORIZON_STOP_WIDTH tokens, or
+# past the first HORIZON_MAX_STOPS sequences, is left unmodeled — its
+# lane free-runs inside the horizon and the authoritative host-side
+# check truncates the stream at the true hit, so tokens stay exact;
+# only the early-freeze optimization is lost for that request
+HORIZON_STOP_WIDTH = 8
+HORIZON_MAX_STOPS = 4
 
 # the stats contract: same keys (and order) as the pre-telemetry dict,
 # now backed by registry metrics ("c" counter / "g" gauge) and exposed
@@ -186,6 +196,8 @@ _STAT_FIELDS = (
     ("spec_accepted", "c", "draft tokens accepted by the target"),
     ("spec_emitted", "c", "tokens emitted by speculative steps"),
     ("spec_fallbacks", "c", "spec steps degraded to plain decode"),
+    ("horizon_fallbacks", "c", "horizon dispatches degraded to "
+                               "single-step decode"),
     ("sampled_tokens", "c", "tokens emitted by sampled (temperature>0) lanes"),
     ("stop_hits", "c", "requests finished by a stop sequence"),
     ("spec_k_capped", "c", "verify participations depth-capped by low "
@@ -481,7 +493,8 @@ class ServingEngine:
                  lora_pool_mb: Optional[float] = None,
                  lora_pool_blocks: Optional[int] = None,
                  lora_max_rank: Optional[int] = None,
-                 lora_rank_block: Optional[int] = None):
+                 lora_rank_block: Optional[int] = None,
+                 decode_horizon: Optional[int] = None):
         if engine.is_encoder:
             raise ValueError("serving needs a causal decoder engine")
         self.engine = engine
@@ -587,6 +600,29 @@ class ServingEngine:
         self.spec_adapt_warmup = int(spec_adapt_warmup)
         self._accept_ewma = np.ones(num_slots, np.float64)
         self._spec_obs = np.zeros(num_slots, np.int64)
+        # fused multi-step decode horizon (docs/MULTISTEP.md): N decode
+        # iterations per dispatch, resolved once and pinned — N is a
+        # static dimension of the horizon programs, so one run compiles
+        # exactly one horizon family (N=1 keeps the single-step
+        # bit-reference program and never compiles the family at all).
+        # With spec_decode on, the verify chunk is already the
+        # multi-token step and takes precedence
+        self.decode_horizon = resolve_decode_horizon(decode_horizon)
+        # horizon-aware scheduler clock: the deadline clock ticks once
+        # per EMITTED token (not per step), so step-clock deadlines and
+        # ttft/tpot keep their one-token-per-tick meaning at N > 1.
+        # _horizon_ticks = ticks the last decode phase consumed;
+        # last_step_span exposes it to external step-unit drivers
+        # (tools/load_gen.drive); token_time_unit is the per-token stamp
+        # spacing such a driver announces (0.0 = wall-clock caller: all
+        # of a horizon's tokens stamp at dispatch time)
+        self._horizon_ticks = 1
+        self._token_tick = 0.0
+        self.last_step_span = 1.0
+        self.token_time_unit = 0.0
+        # wall seconds spent inside device dispatch/harvest calls — the
+        # bench's host/device ms-per-token split (tools/infer_bench.py)
+        self.device_time_s = 0.0
         # per-request sampling: engine-wide ctor knobs are DEFAULTS a
         # request's own fields override (sampling.resolve_params); the
         # resolved knobs live as slot-indexed arrays the fused sampler
@@ -651,6 +687,20 @@ class ServingEngine:
                 "tokens emitted per live slot per verify step",
                 buckets=tuple(float(i)
                               for i in range(1, self.spec_k + 2)))
+            # multi-step decode plane (docs/MULTISTEP.md): realized
+            # per-slot horizon utilization + the run's configured N
+            self._h_horizon = reg.histogram(
+                "serving_horizon_tokens",
+                "tokens emitted per slot per fused multi-step decode "
+                "dispatch",
+                buckets=tuple(float(i)
+                              for i in range(1, self.decode_horizon + 2))) \
+                if self.decode_horizon > 1 else None
+            self._g_horizon = reg.gauge(
+                "decode_horizon",
+                "fused decode iterations per dispatch (static per run; "
+                "1 = single-step bit-reference)")
+            self._g_horizon.set(float(self.decode_horizon))
             self._h_temp = reg.histogram(
                 "serving_request_temperature",
                 "resolved per-request sampling temperature at admission "
@@ -716,6 +766,7 @@ class ServingEngine:
         else:
             self._h_ttft = self._h_tpot = self._h_qwait = self._h_occ = None
             self._h_accept = self._h_tps = self._h_temp = None
+            self._h_horizon = self._g_horizon = None
             self._h_kv_err = None
             self._g_host_bytes = self._h_host_restore = None
             self._g_lora_active = self._g_lora_pool = None
@@ -855,6 +906,13 @@ class ServingEngine:
         produced so far, including this step's, is recorded)."""
         if now is None:
             now = float(self._step_clock)
+            # internal step-clock mode: one tick per emitted token, so
+            # a horizon's tokens stamp at now, now+1, ... exactly as
+            # the N=1 loop would have stamped them
+            self._token_tick = 1.0
+        else:
+            self._token_tick = float(self.token_time_unit)
+        self._horizon_ticks = 1
         bd = self.telemetry.breakdown
         sampled = bd.begin(self._step_clock, sync=self._sync_devices)
         self._expire(now)
@@ -865,7 +923,12 @@ class ServingEngine:
         occ = self._decode_step(now)
         self._spill_step()
         bd.lap("decode")
-        self._step_clock += 1
+        # the deadline clock advances one tick per emitted token: a
+        # horizon-N decode that produced p tokens consumed p ticks, so
+        # relative deadlines keep their token-count meaning at N > 1
+        # (N=1 keeps _horizon_ticks at 1 — bit-identical clocking)
+        self._step_clock += self._horizon_ticks
+        self.last_step_span = float(self._horizon_ticks)
         self._stat["steps"].inc()
         self._stat["occupancy_sum"].inc(occ)
         peak = self._stat["peak_occupancy"]
@@ -1178,6 +1241,13 @@ class ServingEngine:
             # the plain one-token path below (forward progress over
             # speed; the donated pools are intact, the live list is
             # unchanged — no slot was advanced or emitted into)
+        elif self.decode_horizon > 1:
+            occ = self._horizon_decode_step(live, now)
+            if occ is not None:
+                return occ
+            # horizon faulted before dispatch: degrade THIS step to the
+            # plain single-step path below — same contract as spec
+            # (pools intact, no slot state moved, never a dropped token)
         tokens = np.zeros((self.num_slots,), np.int32)
         active = np.zeros((self.num_slots,), bool)
         gen_counts = np.zeros((self.num_slots,), np.int32)
@@ -1212,13 +1282,151 @@ class ServingEngine:
         self._stat["decode_steps"].inc()
         # one host transfer covers every slot's token + logprob (the
         # sampler already ran inside the compiled decode program)
+        t_dev = time.perf_counter()
         toks = np.asarray(toks)
         lps = np.asarray(lps)
+        self.device_time_s += time.perf_counter() - t_dev
         for i in live:
             self.cache.advance(i, 1)
             self._emit_sampled(
                 i, self.slots[i], int(toks[i]),
                 float(lps[i]), now)  # dslint: disable=DS001 — lps is host numpy already (the single batched pull above)
+        return len(live)
+
+    def _horizon_decode_step(self, live: List[int],
+                             now: float) -> Optional[int]:
+        """One fused multi-step decode over the decoding slots: up to
+        ``decode_horizon`` iterations of the decode body in ONE compiled
+        dispatch (engine.decode_horizon, docs/MULTISTEP.md), with each
+        slot's emission budget and eos/stop predicates freezing finished
+        lanes in-program. Admission, eviction, deadline and watchdog
+        checks stay at this horizon boundary; the harvest replays each
+        slot's produced tokens through the exact N=1 emission
+        bookkeeping, so token streams — including mid-horizon stops and
+        evict/requeue resumes — are bit-identical to single-step
+        serving. Returns the occupancy, or None to degrade this step to
+        the plain one-token path (an injected ``serving.horizon`` fault
+        fires BEFORE any capacity or slot state moves — degraded
+        horizons lose speed, never tokens).
+
+        Capacity is opportunistic, mirroring the speculative path: the
+        horizon wants N tokens of room, but a slot that cannot grow
+        (pool pressure, per-slot budget) just runs a shorter horizon —
+        eviction is never triggered FOR horizon tokens, only for the
+        one committed token the plain preamble already guaranteed.
+        Deadlined slots cap their budget at the worst-case token-tick
+        overshoot, so no token is ever stamped past a deadline the N=1
+        loop would have enforced."""
+        N = self.decode_horizon
+        try:
+            self.faults.fire("serving.horizon")
+        except TransientDeviceError:
+            self._stat["horizon_fallbacks"].inc()
+            logger.warning("serving: horizon fault; degrading this step "
+                           "to single-step decode")
+            return None
+        tokens = np.zeros((self.num_slots,), np.int32)
+        active = np.zeros((self.num_slots,), bool)
+        gen_counts = np.zeros((self.num_slots,), np.int32)
+        budgets = np.zeros((self.num_slots,), np.int32)
+        eos_ids = np.full((self.num_slots,), -1, np.int32)
+        stop_ids = np.zeros((self.num_slots, HORIZON_MAX_STOPS,
+                             HORIZON_STOP_WIDTH), np.int32)
+        stop_lens = np.zeros((self.num_slots, HORIZON_MAX_STOPS), np.int32)
+        tail = np.full((self.num_slots, HORIZON_STOP_WIDTH), -1, np.int32)
+        tick = self._token_tick
+        for i in live:
+            req = self.slots[i]
+            tokens[i] = req.out[-1]
+            active[i] = True
+            gen_counts[i] = len(req.out)
+            length = int(self.cache.lengths[i])
+            granted = self.cache.horizon_budget(
+                i, min(length + N, self.cache.tokens_per_slot))
+            # b >= 1 always: the plain preamble secured one token of
+            # room, an emitted-out request would already have finished,
+            # and _expire retired anything past its deadline
+            b = min(N, granted - length,
+                    req.max_new_tokens - len(req.out))
+            if req.deadline is not None and tick > 0.0:
+                b = min(b, max(1, int(math.ceil(
+                    (req.deadline - now) / tick))))
+            budgets[i] = max(1, b)
+            if req.eos_id is not None:
+                eos_ids[i] = int(req.eos_id)
+            if req.stop:
+                row = 0
+                for s in req.stop:
+                    ls = len(s)
+                    if 0 < ls <= HORIZON_STOP_WIDTH \
+                            and row < HORIZON_MAX_STOPS:
+                        stop_ids[i, row, HORIZON_STOP_WIDTH - ls:] = \
+                            [int(t) for t in s]
+                        stop_lens[i, row] = ls
+                        row += 1
+                w = min(len(req.out), HORIZON_STOP_WIDTH)
+                if w:
+                    tail[i, HORIZON_STOP_WIDTH - w:] = req.out[-w:]
+        lanes = self.sampler.lanes(gen_counts)
+        budget = self.step_time_budget_s
+        t0 = time.perf_counter() if budget is not None else 0.0
+        lora = self._lora_args()
+        if self._quant:
+            (toks, lps, produced, done, self.cache.k, self.cache.v,
+             self.cache.k_scale, self.cache.v_scale) = self._device_call(
+                "serving.decode",
+                lambda *a: self.engine.decode_horizon(
+                    *a, sample_state=lanes, lora=lora),
+                self.cache.k, self.cache.v, self.cache.tables,
+                self.cache.lengths, tokens, active, N, budgets, eos_ids,
+                stop_ids, stop_lens, tail, self.decode_impl,
+                self.cache.k_scale, self.cache.v_scale, now=now)
+        else:
+            (toks, lps, produced, done, self.cache.k,
+             self.cache.v) = self._device_call(
+                "serving.decode",
+                lambda *a: self.engine.decode_horizon(
+                    *a, sample_state=lanes, lora=lora),
+                self.cache.k, self.cache.v, self.cache.tables,
+                self.cache.lengths, tokens, active, N, budgets, eos_ids,
+                stop_ids, stop_lens, tail, self.decode_impl, now=now)
+        if budget is not None:
+            self._watchdog_note(time.perf_counter() - t0,
+                                scale=int(budgets[live].max()))
+        self._stat["decode_steps"].inc()
+        # ONE batched host transfer harvests the whole horizon: [N, B]
+        # tokens + logprobs and the per-slot produced counts
+        t_dev = time.perf_counter()
+        toks = np.asarray(toks)
+        lps = np.asarray(lps)
+        produced = np.asarray(produced)
+        self.device_time_s += time.perf_counter() - t_dev
+        ticks = 1
+        prod_by_slot = {}
+        for i in live:
+            req = self.slots[i]
+            p = int(produced[i])
+            prod_by_slot[i] = p
+            # one advance covers the whole horizon (p <= the granted
+            # capacity by the budget construction above); a mid-harvest
+            # finish below frees the slot, releasing any surplus writes
+            self.cache.advance(i, p)
+            if self._h_horizon is not None:
+                self._h_horizon.observe(p)
+            for j in range(p):
+                self._emit_sampled(
+                    i, req, int(toks[j, i]), float(lps[j, i]),  # dslint: disable=DS001 — toks/lps are host numpy already (the single batched pull above)
+                    now + j * tick)
+                if req.state in TERMINAL_STATES:
+                    # an unmodeled stop matched host-side before the
+                    # budget ran out: the surplus in-program tokens die
+                    # with the freed slot, streams stay exact
+                    break
+            ticks = max(ticks, p)
+        self._horizon_ticks = ticks
+        self.telemetry.tracer.event(
+            "horizon_step", step=self._step_clock, n=N,
+            produced=prod_by_slot)
         return len(live)
 
     def _spec_decode_step(self, live: List[int], now: float) -> Optional[int]:
@@ -1426,12 +1634,15 @@ class ServingEngine:
             for ms in samples:
                 self._h_host_restore.observe(ms)
 
-    def _watchdog_note(self, elapsed: float) -> None:
+    def _watchdog_note(self, elapsed: float, scale: int = 1) -> None:
         """Score one decode/verify dispatch against the step budget:
         consecutive over-budget dispatches accumulate strikes until the
         grace runs out, then ``step()`` raises DegradedError AFTER this
-        step's bookkeeping (nothing lost or double-counted on resume)."""
-        budget = self.step_time_budget_s
+        step's bookkeeping (nothing lost or double-counted on resume).
+        ``scale`` stretches the budget for dispatches that legitimately
+        do more than one step of work — a fused horizon doing up to N
+        decode iterations answers to N single-step budgets, not one."""
+        budget = self.step_time_budget_s * max(1, int(scale))
         if elapsed > budget:
             self._over_budget += 1
             self._stat["watchdog_trips"].inc()
@@ -1478,7 +1689,16 @@ class ServingEngine:
         while True:
             try:
                 self.faults.fire(site)
-                return fn(*args)
+                # block inside the timed window: dispatch is async, and
+                # every caller harvests the result immediately anyway —
+                # blocking here makes device_time_s (the bench's
+                # host/device ms-per-token split) and the watchdog's
+                # elapsed measurement cover the actual execution instead
+                # of just the enqueue
+                t_dev = time.perf_counter()
+                out = jax.block_until_ready(fn(*args))
+                self.device_time_s += time.perf_counter() - t_dev
+                return out
             except TransientDeviceError:
                 if attempt >= self.max_retries:
                     raise

@@ -28,7 +28,8 @@ import os
 from dataclasses import dataclass, field
 from typing import Dict, Mapping, Optional, Tuple
 
-__all__ = ["Flag", "FLAGS", "resolve_flag", "flag_names"]
+__all__ = ["Flag", "FLAGS", "resolve_flag", "flag_names",
+           "MAX_DECODE_HORIZON", "resolve_decode_horizon"]
 
 # the shared bool grammar every DS_* switch accepts; "" (unset) is off
 TRUE_WORDS = ("on", "1", "true", "yes")
@@ -109,12 +110,35 @@ FLAGS: Dict[str, Flag] = dict([
     _mk("DS_LORA_RANK_BLOCK", "int", 8,
         "rank granularity of one adapter-pool block (an adapter "
         "occupies ceil(rank/rank_block) blocks)"),
+    _mk("DS_DECODE_HORIZON", "int", 1,
+        "decode iterations fused into one compiled program per dispatch "
+        "(the serving horizon N); 1 is the one-token-per-step "
+        "bit-reference, capped at 32 (docs/MULTISTEP.md)"),
     _mk("DS_FAULTS", "str", "",
         "ambient chaos spec 'site:kind@step[*count][~param];...' "
         "(docs/ROBUSTNESS.md); empty injects nothing"),
     _mk("DS_FAULT_SEED", "int", 0,
         "seed for the ambient FaultInjector's backoff-jitter rng"),
 ])
+
+
+# ceiling on the fused-decode horizon: the scan body is cheap to grow,
+# but every distinct N is its own compiled program and the serving
+# harvest buffers N tokens per slot — cap it where the host-amortization
+# curve has long flattened (docs/MULTISTEP.md)
+MAX_DECODE_HORIZON = 32
+
+
+def resolve_decode_horizon(value=None) -> int:
+    """Resolve the fused-decode horizon N: explicit ``value`` wins, then
+    ``DS_DECODE_HORIZON``, then 1 (the one-token-per-dispatch
+    bit-reference). Validates 1 <= N <= :data:`MAX_DECODE_HORIZON`."""
+    n = resolve_flag("DS_DECODE_HORIZON", value)
+    if not 1 <= int(n) <= MAX_DECODE_HORIZON:
+        raise ValueError(
+            f"DS_DECODE_HORIZON={n!r}: expected an integer in "
+            f"[1, {MAX_DECODE_HORIZON}]")
+    return int(n)
 
 
 def flag_names() -> Tuple[str, ...]:

@@ -57,6 +57,11 @@ Sites currently instrumented:
                        step to plain one-token decode, never retries
 ``serving.spec_draft`` before the per-slot draft proposals each
                        speculative step; same degrade-to-plain contract
+``serving.horizon``    before the fused multi-step decode dispatch each
+                       horizon step, BEFORE any capacity or slot state
+                       moves; the scheduler degrades the step to N=1
+                       single-step decode — never retried, never a
+                       dropped token (docs/MULTISTEP.md)
 ``checkpoint.pre_commit``  after state write, BEFORE the tag dir commit
 ``checkpoint.commit``  after the tag dir commit, BEFORE ``latest`` update
 ``router.dispatch``    after the router picks a target replica, BEFORE
@@ -146,6 +151,7 @@ KINDS = ("device_error", "crash", "slow", "cache_exhausted")
 # subsystems adding sites register them so parse_spec can flag typos
 KNOWN_SITES = {
     "serving.decode", "serving.prefill", "serving.spec_draft",
+    "serving.horizon",
     "engine.prefill", "engine.decode", "engine.verify",
     "cache.allocate", "cache.ensure", "cache.match", "cache.cow",
     "cache.quantize", "cache.spill", "cache.restore", "cache.host_corrupt",

@@ -146,13 +146,16 @@ def test_chaos_parity_under_injected_faults(eng):
              Fault("serving.decode", "slow", step=6, param=0.005),
              Fault("cache.ensure", "cache_exhausted", step=5)]
     with faults_lib.injected(*chaos, seed=0) as inj:
-        # spec pinned off here and below: these tests exercise the
-        # PLAIN decode path's fault sites (serving.decode fires per
-        # one-token dispatch); the speculative sites' chaos contract is
-        # test_spec_serving.py's job
+        # spec and the decode horizon pinned off here and below: these
+        # tests exercise the PLAIN decode path's fault sites
+        # (serving.decode fires per one-token dispatch, and the injected
+        # visit indices are calibrated to that cadence); the speculative
+        # sites' chaos contract is test_spec_serving.py's job, the
+        # serving.horizon degrade is test_horizon.py's
         srv = ServingEngine(eng, num_slots=2, block_size=4, num_blocks=24,
                             prefill_chunk=8, max_retries=3,
-                            retry_backoff_s=0.001, spec_decode=False)
+                            retry_backoff_s=0.001, spec_decode=False,
+                            decode_horizon=1)
         out = srv.run([ServeRequest(rid=i, prompt=p, max_new_tokens=6)
                        for i, p in enumerate(prompts)])
     for i, ref in enumerate(refs):
@@ -241,7 +244,7 @@ def test_watchdog_degraded_error_keeps_everything(eng):
         # injected slow fault — same calibration as the drain tests
         srv = ServingEngine(eng, num_slots=2, block_size=4, num_blocks=24,
                             step_time_budget_s=0.01, watchdog_grace=2,
-                            spec_decode=False)
+                            spec_decode=False, decode_horizon=1)
         with pytest.raises(DegradedError, match="over budget") as ei:
             srv.run([ServeRequest(rid="a", prompt=p1, max_new_tokens=12),
                      ServeRequest(rid="b", prompt=p2, max_new_tokens=3)])
@@ -266,7 +269,7 @@ def test_retry_backoff_survives_transient_burst(eng):
             Fault("serving.decode", "device_error", step=1, count=2)):
         srv = ServingEngine(eng, num_slots=1, block_size=4, num_blocks=24,
                             max_retries=3, retry_backoff_s=0.001,
-                            spec_decode=False)
+                            spec_decode=False, decode_horizon=1)
         out = srv.run([ServeRequest(rid=0, prompt=p, max_new_tokens=5)])
     np.testing.assert_array_equal(out[0], ref)
     assert srv.stats["retries"] == 2
@@ -323,7 +326,7 @@ def test_chaos_compile_count_contract(eng):
                                 max_queue=4, max_retries=3,
                                 retry_backoff_s=0.001,
                                 step_time_budget_s=10.0,
-                                spec_decode=False)
+                                spec_decode=False, decode_horizon=1)
             srv.cache.watermark = 0
             out = srv.run(
                 [ServeRequest(rid="a", prompt=p1, max_new_tokens=12,
@@ -410,7 +413,8 @@ def test_retry_backoff_capped_by_slot_deadline(eng):
             seed=0) as inj:
         srv = ServingEngine(eng, num_slots=1, block_size=4, num_blocks=16,
                             prefill_chunk=8, max_retries=3,
-                            retry_backoff_s=5.0, spec_decode=False)
+                            retry_backoff_s=5.0, spec_decode=False,
+                            decode_horizon=1)
         # warmup run (decode visits 0-3): compiles this pool shape so
         # the timed request's deadline measures backoff, not XLA
         srv.run([ServeRequest(rid="w", prompt=pw, max_new_tokens=4)],
@@ -437,7 +441,8 @@ def test_pending_snapshot_cold_resumes_into_fresh_engine(eng):
             Fault("serving.decode", "slow", step=3, param=0.05), seed=0):
         srv = ServingEngine(eng, num_slots=2, block_size=4, num_blocks=24,
                             prefill_chunk=8, step_time_budget_s=0.01,
-                            watchdog_grace=1, spec_decode=False)
+                            watchdog_grace=1, spec_decode=False,
+                            decode_horizon=1)
         with pytest.raises(DegradedError) as ei:
             srv.run([ServeRequest(rid=i, prompt=p, max_new_tokens=8)
                      for i, p in enumerate(prompts)])

@@ -319,8 +319,10 @@ def test_router_drain_sampled_parity(eng):
     refs = [run_solo(eng, p, max_new=8, temperature=0.8, top_p=0.9,
                      seed=40 + i)[1]
             for i, p in enumerate(prompts)]
+    # horizon pinned: the step-7 crash is calibrated to one-token
+    # steps (the N=8 drain-parity twin lives in test_horizon.py)
     inj = FaultInjector([Fault("router.step", "crash", step=7)], seed=0)
-    fleet = [mk_srv(eng, faults=inj) for _ in range(3)]
+    fleet = [mk_srv(eng, faults=inj, decode_horizon=1) for _ in range(3)]
     router = ReplicaRouter(fleet, faults=inj)
     out = router.run([ServeRequest(rid=i, prompt=p, max_new_tokens=8,
                                    temperature=0.8, top_p=0.9, seed=40 + i)
@@ -387,7 +389,9 @@ def test_snapshot_roundtrip_carries_sampling_fields(eng):
                        temperature=0.7, top_k=12, top_p=0.8, seed=77,
                        repetition_penalty=1.1, stop=[[3, 4]],
                        logprobs=True, n=1)
-    srv = mk_srv(eng)
+    # horizon pinned: "4 steps = prefill + a few decode tokens, still
+    # mid-flight" assumes one token per step
+    srv = mk_srv(eng, decode_horizon=1)
     srv.submit(req)
     for _ in range(4):                   # prefill + a few decode steps
         srv.step()
@@ -416,8 +420,11 @@ def test_sampling_compile_contract_mixed_lanes(devices):
     p1, p2 = prompts_of((10, 9), seed=9)
 
     def workload(kw1, kw2):
+        # horizon pinned: this test wraps the N=1 _decode_slots program
+        # (the _decode_horizon family's contract is test_horizon.py's)
         srv = ServingEngine(eng, num_slots=2, block_size=4, num_blocks=7,
-                            prefill_chunk=8, spec_decode=False)
+                            prefill_chunk=8, spec_decode=False,
+                            decode_horizon=1)
         srv.cache.watermark = 0          # tight pool: evict + requeue
         out = srv.run([
             ServeRequest(rid="a", prompt=p1, max_new_tokens=12, **kw1),

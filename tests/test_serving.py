@@ -209,10 +209,12 @@ def test_serving_staggered_arrival_joins_running_batch(devices):
     p1, p2 = prompts_of((6, 8), seed=11)
     ref1 = _solo_refs(eng, [p1], 12)[0]
     ref2 = _solo_refs(eng, [p2], 6)[0]
-    # spec pinned off: the step-4 arrival must catch r1 mid-decode,
-    # which assumes one token per step (spec timing has its own suite)
+    # spec and the decode horizon pinned to the one-token-per-step
+    # cadence: the step-4 arrival must catch r1 mid-decode (spec timing
+    # and N>1 cadence have their own suites)
     srv = ServingEngine(eng, num_slots=2, block_size=4, num_blocks=24,
-                        prefill_chunk=8, spec_decode=False)
+                        prefill_chunk=8, spec_decode=False,
+                        decode_horizon=1)
     srv.submit(ServeRequest(rid="r1", prompt=p1, max_new_tokens=12), now=0)
     occ = []
     step = 0
@@ -298,11 +300,13 @@ def test_serving_compile_count_contract(devices):
     def run_workload():
         # tight pool + zero watermark: both requests admit, decode
         # growth exhausts the free list, the youngest evicts + requeues.
-        # spec pinned off: this pins the PLAIN decode program contract
-        # (the spec twin lives in test_spec_serving.py, where verify
-        # replaces decode)
+        # spec and the decode horizon pinned off: this pins the PLAIN
+        # decode program contract (the spec twin lives in
+        # test_spec_serving.py, the _decode_horizon family in
+        # test_horizon.py)
         srv = ServingEngine(eng, num_slots=2, block_size=4, num_blocks=7,
-                            prefill_chunk=8, spec_decode=False)
+                            prefill_chunk=8, spec_decode=False,
+                            decode_horizon=1)
         srv.cache.watermark = 0
         out = srv.run([ServeRequest(rid="a", prompt=p1, max_new_tokens=12),
                        ServeRequest(rid="b", prompt=p2, max_new_tokens=10)])
@@ -376,7 +380,10 @@ def test_serving_non_drain_raises_degraded_with_partial_results(devices):
     eng = InferenceEngine(config=cfg, params=params, dtype=jnp.float32)
     p1, p2 = prompts_of((5, 6), seed=17)
     ref2 = _solo_refs(eng, [p2], 2)[0]
-    srv = ServingEngine(eng, num_slots=2, block_size=4, num_blocks=24)
+    # horizon pinned: the max_steps=5 non-drain budget is calibrated to
+    # one token per step (a fused horizon would drain inside it)
+    srv = ServingEngine(eng, num_slots=2, block_size=4, num_blocks=24,
+                        decode_horizon=1)
     with pytest.raises(DegradedError, match="did not drain") as ei:
         srv.run([ServeRequest(rid="slowpoke", prompt=p1,
                               max_new_tokens=30),

@@ -348,7 +348,8 @@ def test_stats_view_read_only_and_registry_backed(eng):
         "backpressure", "prefix_hits", "prefix_tokens_saved",
         "spec_steps", "spec_slot_steps", "spec_proposed",
         "spec_accepted", "spec_emitted", "spec_fallbacks",
-        "sampled_tokens", "stop_hits", "spec_k_capped"}
+        "sampled_tokens", "stop_hits", "spec_k_capped",
+        "horizon_fallbacks"}
     with pytest.raises(TypeError):
         srv.stats["steps"] = 99          # read-only view
     # the registry is the writable surface

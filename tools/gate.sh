@@ -77,6 +77,15 @@ srv.run([retry])
 assert retry.state == "done", retry.state
 print("gate(chaos): adapter-load degrade ok")
 PYEOF
+    # fused-horizon injection: a serving.horizon device_error fires
+    # BEFORE any capacity or slot state moves and degrades that step to
+    # plain N=1 single-step decode (stats["horizon_fallbacks"]) — the
+    # run still drains and streams stay bit-identical to the N=1
+    # reference (docs/MULTISTEP.md, docs/ROBUSTNESS.md)
+    echo "gate(chaos): horizon degrade injection (ambient DS_FAULTS, DS_FAULT_SEED=0)"
+    DS_FAULT_SEED=0 DS_FAULTS="serving.horizon:device_error@1*3" \
+    DS_DECODE_HORIZON=8 python -m pytest tests/test_horizon.py \
+        -k "degrade or parity" -q
 elif [[ "${1:-}" == "quick" ]]; then
     # lint the changed .py files PLUS their direct importers (--closure
     # quick mode, cached import graph from the last full run) so the
@@ -185,6 +194,16 @@ else
     echo "gate: serving smoke (sampled, DS_SPEC_DECODE=on)"
     DS_SPEC_DECODE=on python -m pytest tests/test_sampling.py \
         tests/test_spec_serving.py -q
+    # fused multi-step decode knob smoke: the suite default leaves
+    # DS_DECODE_HORIZON unset (= 1, the one-token-per-dispatch
+    # bit-reference), so rerun the serving + sampling + chaos suites
+    # once with an 8-iteration fused horizon forced ON — greedy AND
+    # sampled parity, stop/eviction/requeue bookkeeping, deadlines and
+    # every degrade path must hold when the scheduler host loop only
+    # runs at horizon boundaries (docs/MULTISTEP.md)
+    echo "gate: serving smoke (DS_DECODE_HORIZON=8)"
+    DS_DECODE_HORIZON=8 python -m pytest tests/test_serving.py \
+        tests/test_sampling.py tests/test_horizon.py tests/test_chaos.py -q
     # closed-loop smoke: the serve-autoscale CPU row must show the SLO
     # contrast (fixed fleet violates, policy fleet holds by scaling up)
     # and the chaos suite must stay green with the controller ACTIVE —

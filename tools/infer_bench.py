@@ -187,7 +187,8 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
                   spec_decode=None, spec_k=None, kv_quant=None,
                   host_tier=None, host_budget_bytes=None,
                   spill_watermark=None, prefix_families=1,
-                  temperature=0.0, top_p=1.0, sample_seed=0, emit=True):
+                  temperature=0.0, top_p=1.0, sample_seed=0,
+                  decode_horizon=None, emit=True):
     """Continuous-batching serving row: synthetic Poisson arrivals driven
     through ServingEngine.step, wall-clock tokens/s, TTFT/TPOT latency
     percentiles from the telemetry registry's histograms, decode-slot
@@ -231,6 +232,13 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
     tier spills and restores it (``spill_watermark`` pins the daemon's
     pressure threshold). Rows report the host transfer counters.
 
+    ``decode_horizon`` pins the fused multi-step decode horizon N
+    (None = ``DS_DECODE_HORIZON``, docs/MULTISTEP.md); rows split
+    ``ms_per_token`` into ``host_ms_per_token`` vs
+    ``device_ms_per_token`` (device = wall seconds the engine spent
+    inside device dispatch + harvest, host = the scheduler-loop rest)
+    so the ~N× host amortization is visible even on CPU.
+
     ``temperature``/``top_p`` > defaults turn the drive into a SAMPLED
     workload (every request seeded ``sample_seed + rid``, so a row is
     reproducible run-to-run); rows report ``sampled``/``temperature``/
@@ -272,6 +280,7 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
                         kv_quant=kv_quant, host_tier=host_tier,
                         host_budget_bytes=host_budget_bytes,
                         spill_watermark=spill_watermark,
+                        decode_horizon=decode_horizon,
                         telemetry=Telemetry())
 
     rng = np.random.default_rng(seed)
@@ -313,7 +322,8 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
                       spec_decode=spec_decode, spec_k=spec_k,
                       kv_quant=kv_quant, host_tier=host_tier,
                       host_budget_bytes=host_budget_bytes,
-                      spill_watermark=spill_watermark)
+                      spill_watermark=spill_watermark,
+                      decode_horizon=decode_horizon)
     w.run([ServeRequest(rid="w", prompt=reqs[0].prompt.copy(),
                         max_new_tokens=2)])
 
@@ -429,6 +439,17 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
         "spec_fallbacks": st["spec_fallbacks"],
         "ms_per_token": round(tpot_h.sum / tpot_h.count * 1e3, 3)
         if tpot_h.count else 0.0,
+        # host/device wall split (docs/MULTISTEP.md): device is the
+        # wall time spent inside device dispatch + harvest pulls, host
+        # is everything else the scheduler loop did — the horizon
+        # amortizes the host share ~N×
+        "decode_horizon": srv.decode_horizon,
+        "device_ms_per_token": round(
+            srv.device_time_s / max(gen_tokens, 1) * 1e3, 3),
+        "host_ms_per_token": round(
+            max(0.0, wall_s - srv.device_time_s)
+            / max(gen_tokens, 1) * 1e3, 3),
+        "horizon_fallbacks": st["horizon_fallbacks"],
         "cache_stats": cache.stats(),
         # per-request lifecycle timestamps (seconds relative to drive
         # start): submit/first-token/finish per rid, so SLO attainment
@@ -500,6 +521,58 @@ def bench_serving_prefix_compare(name, shared_prefix_len=64, **kw):
         "tokens_per_s_on": on["tokens_per_s"],
         "cow_copies": on["cache_stats"]["cow_copies"],
     }), flush=True)
+
+
+def bench_serving_horizon_compare(name, horizons=(1, 4, 8), repeats=1,
+                                  **kw):
+    """Same drive at fused decode horizons N ∈ ``horizons``: token
+    streams must be identical at every N (the docs/MULTISTEP.md
+    bit-parity contract — the horizon changes how many host round-trips
+    the same tokens take, never the tokens); the row is the host-side
+    ms/token the fusion amortizes, one scheduler iteration per horizon
+    instead of per token. On CPU the "device" program is itself
+    host-executed, so device_ms dominates and the host_amortization
+    column understates the on-chip win (the ROADMAP chip-queue entry).
+
+    ``repeats`` runs each N's drive that many times and keeps the MIN
+    of the timing columns — the large-N host deltas are single-digit
+    µs/token on the CPU smoke configs, inside one trial's OS jitter,
+    and min-of-k is the standard way to read a floor through noise.
+    Stream identity is checked on every repeat."""
+    rows = []
+    for n in horizons:
+        best = None
+        for r_i in range(max(1, int(repeats))):
+            r = bench_serving(f"{name}[n{n}]" if repeats <= 1
+                              else f"{name}[n{n} r{r_i}]",
+                              decode_horizon=n, **kw)
+            if best is None:
+                best = r
+            else:
+                assert r["_results"] == best["_results"], \
+                    f"{name}[n{n}]: stream varied across repeats"
+                for col in ("host_ms_per_token", "device_ms_per_token",
+                            "ms_per_token"):
+                    best[col] = min(best[col], r[col])
+                best["tokens_per_s"] = max(best["tokens_per_s"],
+                                           r["tokens_per_s"])
+        rows.append(best)
+    base = rows[0]
+    out = {
+        "config": name, "preset": base["preset"],
+        "decode_horizon": "-vs-".join(str(n) for n in horizons),
+        "output_identical": all(r["_results"] == base["_results"]
+                                for r in rows[1:]),
+    }
+    for n, r in zip(horizons, rows):
+        out[f"host_ms_per_token_n{n}"] = r["host_ms_per_token"]
+        out[f"device_ms_per_token_n{n}"] = r["device_ms_per_token"]
+        out[f"tokens_per_s_n{n}"] = r["tokens_per_s"]
+    out["host_amortization"] = round(
+        base["host_ms_per_token"]
+        / max(rows[-1]["host_ms_per_token"], 1e-9), 2)
+    print(json.dumps(out), flush=True)
+    return out
 
 
 def bench_serving_hosttier_compare(name, shared_prefix_len=24,
@@ -1127,6 +1200,24 @@ SERVE_COMPARE_CONFIGS = [
         mode="sampling", preset="gpt2-medium", num_requests=32,
         mean_gap_steps=1.5, prompt_lens=(64, 384), new_tokens=64,
         num_slots=8, block_size=16, prefill_chunk=128)),
+    # fused multi-step decode horizons N=1 vs 4 vs 8: streams must be
+    # identical at every N while host_ms_per_token falls — the host
+    # scheduler loop runs once per horizon instead of once per token
+    # (docs/MULTISTEP.md; chip-queue entry in ROADMAP for on-chip rows).
+    # burst arrivals (gap 0) keep the slots saturated at every N: a
+    # Poisson gap in scheduler-step units would make the faster-per-step
+    # N=8 run sit through idle arrival-wait steps, billing host time
+    # against zero tokens and muddying the amortization column
+    # repeats=3/min-of-k: the n4→n8 host delta is a few µs/token on
+    # CPU, inside one trial's OS jitter
+    ("serve-horizon-smoke", dict(mode="horizon", num_requests=8,
+                                 mean_gap_steps=0.0, prompt_lens=(6, 20),
+                                 new_tokens=24, num_slots=2, block_size=8,
+                                 prefill_chunk=16, repeats=3)),
+    ("serve-horizon-gpt2-medium", dict(
+        mode="horizon", preset="gpt2-medium", num_requests=32,
+        mean_gap_steps=1.5, prompt_lens=(64, 384), new_tokens=64,
+        num_slots=8, block_size=16, prefill_chunk=128)),
     # replica-fleet router availability: the same requests through one
     # undisturbed engine vs a 3-replica fleet with one replica crash-
     # killed mid-run — drained work must land on survivors with
@@ -1272,6 +1363,7 @@ def main():
                    "sampling": bench_serving_sampling_compare,
                    "autoscale": bench_serving_autoscale_compare,
                    "lora": bench_serving_lora_compare,
+                   "horizon": bench_serving_horizon_compare,
                    }.get(mode, bench_serving_impl_compare)
         try:
             compare(name, **kw)

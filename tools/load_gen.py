@@ -193,7 +193,9 @@ def drive(target, entries: List[Dict], *, mode: str = "open",
           max_steps: int = 100_000) -> Dict:
     """Run a generated population against ``target`` (ServingEngine or
     ReplicaRouter — anything with ``submit(req, now)`` / ``step(now)``
-    / ``busy``), stepping the scheduler clock one unit per iteration.
+    / ``busy``), stepping the scheduler clock in token-time units —
+    one unit per iteration at N=1, up to N units when a fused decode
+    horizon (``DS_DECODE_HORIZON``) emits several tokens per step.
 
     - ``mode="open"``: requests are submitted when the clock reaches
       their ``at`` — queueing delay under a spike is real (the
@@ -211,6 +213,14 @@ def drive(target, entries: List[Dict], *, mode: str = "open",
     (shed, still queued at exhaustion) count as misses."""
     if mode not in ("open", "closed"):
         raise ValueError(f"mode must be open|closed, got {mode!r}")
+    if hasattr(target, "token_time_unit"):
+        # the driver's clock is in token-time units (one unit ≈ one
+        # decode iteration); telling the engine so makes a fused
+        # horizon stamp its i-th in-horizon token at ``clock + i``
+        # — the exact instants the N=1 loop would have used, keeping
+        # ttft/tpot records and deadline enforcement bit-identical
+        # at any DS_DECODE_HORIZON (docs/MULTISTEP.md)
+        target.token_time_unit = 1.0
     order = sorted(range(len(entries)), key=lambda i: entries[i]["at"]) \
         if mode == "open" else list(range(len(entries)))
     reqs = _mk_serve_requests(entries)
@@ -241,7 +251,10 @@ def drive(target, entries: List[Dict], *, mode: str = "open",
                 if r.state not in _TERMINAL:
                     inflight += 1
         target.step(clock)
-        clock += 1.0
+        # a fused multi-step horizon emits up to N tokens per step;
+        # advance by the tokens actually produced so the next arrivals
+        # land at the same token-time they would under N=1
+        clock += max(1.0, float(getattr(target, "last_step_span", 1.0)))
         steps += 1
         if steps > max_steps:
             raise RuntimeError(f"load did not drain in {max_steps} steps")
