@@ -103,7 +103,9 @@ from deepspeed_tpu.runtime.checkpointing import (get_latest_tag, list_tags,
 from deepspeed_tpu.telemetry import (NOOP, MetricsRegistry, NoopTelemetry,
                                      Telemetry, merge_registries,
                                      resolve_telemetry)
+from deepspeed_tpu.telemetry.flight import FlightRecorder, NOOP_FLIGHT
 from deepspeed_tpu.utils import faults as faults_lib
+from deepspeed_tpu.utils.env import flag_names, resolve_flag
 from deepspeed_tpu.utils.faults import InjectedCrash, TransientDeviceError
 from deepspeed_tpu.utils.logging import logger
 
@@ -186,7 +188,9 @@ class ReplicaRouter:
                  affinity_max_imbalance: int = 4,
                  faults: Optional[faults_lib.FaultInjector] = None,
                  telemetry=None,
-                 autoscale=None):
+                 autoscale=None,
+                 flight_recorder: Optional[bool] = None,
+                 flight_dir: Optional[str] = None):
         if not replicas:
             raise ValueError("router needs at least one replica")
         self.replicas = [_Replica(i, srv) for i, srv in enumerate(replicas)]
@@ -250,6 +254,39 @@ class ReplicaRouter:
         # the fixed-fleet bit-reference.
         self.autoscale = autoscale
         self.shed_batch = False
+        # fleet flight recorder (telemetry/flight.py): breaker breaks
+        # and total degrades write a postmortem artifact bundling the
+        # fleet view — per-replica engines keep their own recorders
+        if resolve_flag("DS_FLIGHT_RECORDER", flight_recorder):
+            self.flight = FlightRecorder(
+                outdir=flight_dir or (resolve_flag("DS_FLIGHT_DIR")
+                                      or None),
+                sections=self._flight_sections(), label="router")
+        else:
+            self.flight = NOOP_FLIGHT
+
+    def _flight_sections(self) -> Dict:
+        """Fleet postmortem section providers (called only at dump
+        time): merged fleet metrics + health, the router's own tracer
+        ring, autoscaler decisions, fired faults, resolved flags, and
+        every replica's cost-accounting state."""
+        return {
+            "tracer": lambda: [list(r)
+                               for r in self.telemetry.tracer.records()],
+            "metrics": lambda: self.fleet_snapshot(),
+            "stats": lambda: dict(self.stats),
+            "autoscale": lambda: (list(self.autoscale.decisions)
+                                  if self.autoscale is not None else []),
+            "faults": lambda: [list(f) for f in self.faults.fired],
+            "flags": lambda: {n: resolve_flag(n) for n in flag_names()},
+            "costs": lambda: {
+                f"r{rep.idx}": rep.srv.costs.snapshot()
+                for rep in self.replicas},
+            "requests": lambda: [
+                dict(row, replica=rep.idx)
+                for rep in self.replicas
+                for row in rep.srv._flight_requests()],
+        }
 
     def _mk_health_gauge(self, i: int):
         return self.metrics.gauge(
@@ -602,6 +639,7 @@ class ReplicaRouter:
         self._set_health(rep, BROKEN, now, reason=reason)
         self._stat["breaker_trips"].inc()
         rep.failures = 0
+        self.flight.dump(f"breaker: replica {rep.idx} broken ({reason})")
 
     def _note_failure(self, rep: _Replica, now: float, reason: str) -> None:
         """Feed the breaker: suspect on the first failure, broken (and
@@ -713,6 +751,7 @@ class ReplicaRouter:
                     if s["rid"] not in merged)
         self.telemetry.tracer.event("degraded", step=self._clock,
                                     message=message)
+        self.flight.dump(f"fleet degraded: {message}")
         return DegradedError(
             message, results=merged, finished=list(self._finished),
             pending=pending, stats=dict(self.stats))

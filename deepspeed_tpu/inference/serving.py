@@ -129,6 +129,7 @@ temperature=0 request's output is token-for-token identical to a solo
 ``InferenceEngine.generate`` run of its prompt.
 """
 
+import json
 import math
 import time
 from collections import deque
@@ -154,8 +155,13 @@ from deepspeed_tpu.ops.quantizer import resolve_kv_quant
 from deepspeed_tpu.telemetry import (NOOP, MetricsRegistry, NoopTelemetry,
                                      RATE_BUCKETS, TEMP_BUCKETS, Telemetry,
                                      resolve_telemetry)
+from deepspeed_tpu.telemetry.costs import (CostAccountant, NOOP_COSTS,
+                                           ProgramCostRegistry)
+from deepspeed_tpu.telemetry.costs import new_footprint as _new_footprint
+from deepspeed_tpu.telemetry.flight import FlightRecorder, NOOP_FLIGHT
 from deepspeed_tpu.utils import faults as faults_lib
-from deepspeed_tpu.utils.env import resolve_decode_horizon
+from deepspeed_tpu.utils.env import (flag_names, resolve_decode_horizon,
+                                     resolve_flag)
 from deepspeed_tpu.utils.faults import TransientDeviceError
 from deepspeed_tpu.utils.logging import logger
 
@@ -297,6 +303,11 @@ class ServeRequest:
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
     evictions: int = 0
+    # per-request cost footprint (telemetry/costs.py): FLOPs/HBM bytes/
+    # dispatch counts per class + KV block-seconds. Plain data; rides
+    # pending_snapshot() across drains so attribution survives a
+    # replica death. Populated only while cost accounting is on.
+    cost: Dict = field(default_factory=_new_footprint)
     _admit_seq: int = -1             # eviction picks the youngest
     _work: Optional[np.ndarray] = None   # prompt (+generated, on resume)
 
@@ -338,7 +349,9 @@ class ServeRequest:
             out=[int(t) for t in entry.get("out", ())],
             out_logprobs=[float(x)
                           for x in entry.get("out_logprobs", ())],
-            evictions=int(entry.get("evictions", 0)))
+            evictions=int(entry.get("evictions", 0)),
+            cost=(dict(entry["cost"]) if entry.get("cost")
+                  else _new_footprint()))
 
 
 class DegradedError(RuntimeError):
@@ -390,7 +403,10 @@ def snapshot_entry(req: ServeRequest, **extra) -> Dict:
              "stop": [[int(t) for t in s] for s in req.stop]
              if req.stop else None,
              "logprobs": req.logprobs,
-             "out_logprobs": [float(x) for x in req.out_logprobs]}
+             "out_logprobs": [float(x) for x in req.out_logprobs],
+             # cost footprint rides the snapshot so a drained request
+             # keeps its accrued attribution on the survivor replica
+             "cost": json.loads(json.dumps(req.cost))}
     entry.update(extra)
     return entry
 
@@ -494,7 +510,10 @@ class ServingEngine:
                  lora_pool_blocks: Optional[int] = None,
                  lora_max_rank: Optional[int] = None,
                  lora_rank_block: Optional[int] = None,
-                 decode_horizon: Optional[int] = None):
+                 decode_horizon: Optional[int] = None,
+                 cost_accounting: Optional[bool] = None,
+                 flight_recorder: Optional[bool] = None,
+                 flight_dir: Optional[str] = None):
         if engine.is_encoder:
             raise ValueError("serving needs a causal decoder engine")
         self.engine = engine
@@ -793,6 +812,86 @@ class ServingEngine:
         else:
             self.adapters = None
             self._slot_arows = None
+        # cost-accounting plane (telemetry/costs.py, docs/OBSERVABILITY
+        # .md): exact integer FLOPs/HBM-bytes/block-seconds attribution
+        # per dispatch class, request and tenant. DS_TELEMETRY=on
+        # implies it; DS_COST_ACCOUNTING=on enables it standalone.
+        # Charges are host-int arithmetic only — no device work, no new
+        # programs, and the off path is the usual constant no-op twin
+        if self.telemetry.enabled \
+                or resolve_flag("DS_COST_ACCOUNTING", cost_accounting):
+            kv_tok = int(self.cache.bytes_per_token)
+            block_bytes = (kv_tok * self.cache.block_size
+                           + int(self.cache.scale_bytes_per_block))
+            try:
+                param_itemsize = int(np.dtype(engine.dtype).itemsize)
+            except TypeError:
+                param_itemsize = 2
+            self.costs = CostAccountant(
+                engine.cfg, kv_tok, block_bytes, param_itemsize,
+                registry=self.metrics)
+            self.cost_registry = ProgramCostRegistry()
+            self.cost_registry.populate(engine, cache=self.cache)
+            if self.telemetry.enabled:
+                self.cost_registry.export_gauges(self.metrics)
+        else:
+            self.costs = NOOP_COSTS
+            self.cost_registry = None
+        # flight recorder (telemetry/flight.py): armed when
+        # DS_FLIGHT_RECORDER=on — a DegradedError writes a versioned,
+        # CRC-stamped postmortem artifact tools/postmortem.py can
+        # analyze with zero live objects
+        if resolve_flag("DS_FLIGHT_RECORDER", flight_recorder):
+            self.flight = FlightRecorder(
+                outdir=flight_dir or (resolve_flag("DS_FLIGHT_DIR")
+                                      or None),
+                sections=self._flight_sections(), label="serving")
+        else:
+            self.flight = NOOP_FLIGHT
+
+    def _flight_sections(self) -> Dict:
+        """Postmortem section providers — called only at dump time."""
+        return {
+            "tracer": lambda: [list(r)
+                               for r in self.telemetry.tracer.records()],
+            "metrics": lambda: self.metrics.snapshot(),
+            "windows": lambda: {n: h.window_summary()
+                                for n, h in
+                                self.metrics._histograms.items()},
+            "stats": lambda: dict(self.stats),
+            "faults": lambda: [list(f) for f in self.faults.fired],
+            "flags": lambda: {n: resolve_flag(n) for n in flag_names()},
+            "programs": lambda: (self.cost_registry.to_json()
+                                 if self.cost_registry else {}),
+            "costs": lambda: self.costs.snapshot(),
+            "requests": self._flight_requests,
+        }
+
+    def _flight_requests(self) -> List[Dict]:
+        """Per-request postmortem rows: every finished request plus the
+        in-flight set, each with its lifecycle state and cost
+        footprint."""
+        rows = []
+        for req in self.finished:
+            rows.append({"rid": req.rid, "state": req.state,
+                         "generated": len(req.out),
+                         "adapter_id": req.adapter_id,
+                         "evictions": req.evictions,
+                         "cost": req.cost})
+        for slot, req in enumerate(self.slots):
+            if req is not None:
+                rows.append({"rid": req.rid, "state": req.state,
+                             "slot": slot, "generated": len(req.out),
+                             "adapter_id": req.adapter_id,
+                             "evictions": req.evictions,
+                             "cost": req.cost})
+        for pos, req in enumerate(self.queue):
+            rows.append({"rid": req.rid, "state": req.state,
+                         "queue_pos": pos, "generated": len(req.out),
+                         "adapter_id": req.adapter_id,
+                         "evictions": req.evictions,
+                         "cost": req.cost})
+        return rows
 
     def _on_adapter_load(self) -> None:
         self._stat["adapter_loads"].inc()
@@ -929,6 +1028,15 @@ class ServingEngine:
         # (N=1 keeps _horizon_ticks at 1 — bit-identical clocking)
         self._step_clock += self._horizon_ticks
         self.last_step_span = float(self._horizon_ticks)
+        if self.costs.enabled:
+            # KV residency integrates at horizon boundaries: every slot
+            # holder is billed its block count x the ticks this step
+            # consumed (scheduler-clock units; seconds under wall_clock)
+            for i, r in enumerate(self.slots):
+                if r is not None:
+                    self.costs.charge_block_seconds(
+                        r, self.cache.blocks_for(int(self.cache.lengths[i])),
+                        self._horizon_ticks)
         self._stat["steps"].inc()
         self._stat["occupancy_sum"].inc(occ)
         peak = self._stat["peak_occupancy"]
@@ -1057,6 +1165,8 @@ class ServingEngine:
                                       watermark=None if occupied else 0)
             if not ok:
                 break
+            cow0 = self.cache.cow_copies
+            res0 = self.cache.host_restores
             try:
                 matched = self.cache.allocate(slot, len(req._work),
                                               tokens=tok_key)
@@ -1064,6 +1174,12 @@ class ServingEngine:
                 # an injected (or racing) exhaustion at admission: the
                 # request stays at the queue head and retries next step
                 break
+            if self.costs.enabled:
+                # COW copies and host-tier restores the allocation
+                # triggered are this request's bytes
+                self.costs.charge_cow(req, self.cache.cow_copies - cow0)
+                self.costs.charge_spill(self.cache.host_restores - res0,
+                                        req=req, restore=True)
             arow = None
             if req.adapter_id is not None:
                 try:
@@ -1158,6 +1274,9 @@ class ServingEngine:
             self.cache.advance(slot, n)
             self._progress[slot] = done + n
             self._stat["prefill_chunks"].inc()
+            # one prefill-chunk dispatch: n new tokens over `done`
+            # cached context, whole cost owned by this slot's request
+            self.costs.charge_prefill(req, n, done)
             self.telemetry.tracer.event(
                 "prefill_chunk", rid=req.rid, step=self._step_clock,
                 slot=slot, start=done, n=n)
@@ -1206,6 +1325,7 @@ class ServingEngine:
                     f"{req.max_new_tokens} tokens")
                 self._finish(slot, req, now)
                 continue
+            cow0 = self.cache.cow_copies
             while True:
                 try:
                     self.cache.ensure_capacity(
@@ -1229,6 +1349,9 @@ class ServingEngine:
                             f"tokens")
                         self._finish(slot, req, now)
                     break
+            if self.costs.enabled:
+                # mid-decode divergence copies are this request's bytes
+                self.costs.charge_cow(req, self.cache.cow_copies - cow0)
         live = [i for i, r in enumerate(self.slots)
                 if r is not None and r.state == "decode"]
         if not live:
@@ -1280,6 +1403,12 @@ class ServingEngine:
         if budget is not None:
             self._watchdog_note(time.perf_counter() - t0)
         self._stat["decode_steps"].inc()
+        if self.costs.enabled:
+            # one batched dispatch: each live slot decoded 1 token over
+            # its own cached context; the weight read splits exactly
+            self.costs.charge_batched(
+                "decode", [(self.slots[i], 1, int(self.cache.lengths[i]))
+                           for i in live])
         # one host transfer covers every slot's token + logprob (the
         # sampler already ran inside the compiled decode program)
         t_dev = time.perf_counter()
@@ -1401,6 +1530,13 @@ class ServingEngine:
         lps = np.asarray(lps)
         produced = np.asarray(produced)
         self.device_time_s += time.perf_counter() - t_dev
+        if self.costs.enabled:
+            # one fused dispatch: each live slot produced its own token
+            # count over its own pre-advance context
+            self.costs.charge_batched(
+                "decode",
+                [(self.slots[i], int(produced[i]),
+                  int(self.cache.lengths[i])) for i in live])
         ticks = 1
         prod_by_slot = {}
         for i in live:
@@ -1473,10 +1609,14 @@ class ServingEngine:
             length = int(self.cache.lengths[i])
             want = min(length + G, self.cache.tokens_per_slot)
             if want > self.cache.capacity_tokens(i):
+                cow0 = self.cache.cow_copies
                 try:
                     self.cache.ensure_capacity(i, want)
                 except CacheExhausted:
                     pass      # speculate into whatever room exists
+                if self.costs.enabled:
+                    self.costs.charge_cow(
+                        self.slots[i], self.cache.cow_copies - cow0)
             caps[i] = min(self.cache.capacity_tokens(i),
                           self.cache.tokens_per_slot) - length
         tokens = np.zeros((self.num_slots, G), np.int32)
@@ -1513,6 +1653,13 @@ class ServingEngine:
             self._watchdog_note(time.perf_counter() - t0)
         self._stat["decode_steps"].inc()
         self._stat["spec_steps"].inc()
+        if self.costs.enabled:
+            # the verify program scores all G chunk positions per live
+            # slot whatever gets accepted — the compute is spent either
+            # way, so attribution bills the full chunk
+            self.costs.charge_batched(
+                "verify", [(self.slots[i], G, int(self.cache.lengths[i]))
+                           for i in live])
         # the target's greedy choice at every chunk position — the SAME
         # fp32-cast device argmax the fused sampler's greedy lane takes,
         # so accepted tokens are bit-identical to what plain decode
@@ -1609,7 +1756,12 @@ class ServingEngine:
         if not self.host_tier:
             return
         t0 = time.perf_counter()
+        sp0 = self.cache.host_spills
         self.cache.spill_tick()
+        if self.costs.enabled:
+            # refcount-zero spills have no owning request: the bytes
+            # land in the accountant's system footprint
+            self.costs.charge_spill(self.cache.host_spills - sp0)
         self._sync_host_stats()
         if self.step_time_budget_s is not None:
             elapsed = time.perf_counter() - t0
@@ -1755,12 +1907,42 @@ class ServingEngine:
             self._h_kv_err.observe(float(step) / 2.0)
 
     def _degraded(self, message: str) -> DegradedError:
+        # the flight recorder fires BEFORE the error leaves the engine:
+        # whatever the caller does with the exception, the postmortem
+        # artifact is already on disk (noop twin when the recorder is
+        # off — one attribute access on this already-cold path)
+        self.flight.dump(f"degraded: {message}")
         return DegradedError(
             message,
             results={r.rid: r.tokens for r in self.finished},
             finished=list(self.finished),
             pending=self.pending_snapshot(),
             stats=dict(self.stats))
+
+    def device_time_snapshot(self) -> float:
+        """Monotonic snapshot of cumulative device dispatch+harvest wall
+        seconds. ``device_time_s`` accumulates for the engine's whole
+        lifetime; a bench timing one drive among many must take a
+        before/after delta of THIS value instead of reading the raw
+        accumulator (tools/infer_bench.py min-of-k loops)."""
+        return float(self.device_time_s)
+
+    def capture_profile(self, steps: int, outdir: str,
+                        now: Optional[float] = None) -> str:
+        """On-demand ``jax.profiler`` capture window: trace exactly
+        ``steps`` scheduler iterations (each a horizon boundary — the
+        capture never straddles a partial fused dispatch) into
+        ``outdir`` (TensorBoard/XProf layout; ``tools/trace_analyze.py
+        read <outdir>`` summarizes it). Returns ``outdir``."""
+        jax.profiler.start_trace(outdir)
+        try:
+            for _ in range(max(1, int(steps))):
+                if not self.busy:
+                    break
+                self.step(now)
+        finally:
+            jax.profiler.stop_trace()
+        return outdir
 
     def _release_adapter(self, slot: int, req: ServeRequest) -> None:
         """Drop the slot's adapter pin (if it holds one) and zero its

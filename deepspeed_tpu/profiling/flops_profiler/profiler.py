@@ -22,32 +22,40 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+# the chip-peak table and the analytic per-token formulas now live in
+# telemetry/costs.py so serving-side attribution and this training-side
+# profiler can never disagree; the old names stay importable from here.
+from deepspeed_tpu.telemetry.costs import (PEAK_FLOPS as _PEAK_FLOPS,
+                                           attn_flops,
+                                           device_peak_flops,
+                                           infer_flops,
+                                           model_flops_per_token,
+                                           weight_bytes)
 from deepspeed_tpu.utils.logging import logger
 
-# peak bf16 matmul throughput per chip, FLOP/s (public spec sheets)
-_PEAK_FLOPS = {
-    "TPU v2": 45e12,
-    "TPU v3": 123e12,
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,   # v5e
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,        # v5p
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,   # v6e / Trillium
-    "TPU v6e": 918e12,
-}
 
-
-def device_peak_flops(device=None) -> Optional[float]:
-    """Peak bf16 FLOP/s of the device, or None when unknown (CPU)."""
-    device = device or jax.devices()[0]
-    kind = getattr(device, "device_kind", "")
-    # longest-prefix match so "TPU v5 lite" beats "TPU v5"
-    best = None
-    for name, flops in _PEAK_FLOPS.items():
-        if kind.startswith(name) and (best is None or len(name) > len(best[0])):
-            best = (name, flops)
-    return best[1] if best else None
+def analytic_model_profile(cfg, seq_len: Optional[int] = None,
+                           param_itemsize: int = 2) -> Dict[str, Any]:
+    """Closed-form per-token profile of a :class:`GPTConfig` — no
+    compilation, no device. The per-layer counts route through the
+    ``telemetry/costs.py`` helpers (the single FLOPs formula source of
+    truth over ``models/gpt.py``'s param counts), so a number printed
+    here matches what the serving cost accountant charges per dispatch.
+    """
+    from deepspeed_tpu.models.gpt import (kv_bytes_per_token, num_params,
+                                          train_flops_per_token)
+    s = int(seq_len if seq_len is not None else cfg.max_seq_len)
+    fwd_tok = model_flops_per_token(cfg)
+    return {
+        "params": int(num_params(cfg)),
+        "seq_len": s,
+        "fwd_flops_per_token": fwd_tok,
+        "fwd_attn_flops_seq": attn_flops(cfg, s, 0),
+        "fwd_flops_seq": infer_flops(cfg, s, 0),
+        "train_flops_per_token": int(train_flops_per_token(cfg, s)),
+        "kv_bytes_per_token": int(kv_bytes_per_token(cfg)),
+        "weight_bytes": weight_bytes(cfg, param_itemsize),
+    }
 
 
 def _num_to_string(num: float, units=None, precision: int = 2) -> str:

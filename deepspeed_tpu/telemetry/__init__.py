@@ -41,12 +41,26 @@ from deepspeed_tpu.telemetry.metrics import (Counter, DEFAULT_BUCKETS,
                                              RATE_BUCKETS, TEMP_BUCKETS,
                                              merge_registries)
 from deepspeed_tpu.telemetry.tracer import NoopTracer, RequestTracer
+from deepspeed_tpu.telemetry.costs import (CostAccountant,
+                                           NOOP_COSTS,
+                                           NoopCostAccountant,
+                                           ProgramCostRegistry,
+                                           device_peak_flops,
+                                           model_flops_per_token)
+from deepspeed_tpu.telemetry.flight import (FlightRecorder, NOOP_FLIGHT,
+                                            NoopFlightRecorder,
+                                            load_artifact)
 
 __all__ = ["Telemetry", "NoopTelemetry", "NOOP", "resolve_telemetry",
            "MetricsRegistry", "Counter", "Gauge", "Histogram",
            "RequestTracer", "NoopTracer", "StepBreakdown",
            "NoopBreakdown", "PHASES", "DEFAULT_BUCKETS", "RATE_BUCKETS",
-           "TEMP_BUCKETS", "merge_registries"]
+           "TEMP_BUCKETS", "merge_registries",
+           "CostAccountant", "NoopCostAccountant", "NOOP_COSTS",
+           "ProgramCostRegistry", "device_peak_flops",
+           "model_flops_per_token",
+           "FlightRecorder", "NoopFlightRecorder", "NOOP_FLIGHT",
+           "load_artifact"]
 
 
 def resolve_telemetry(flag: Optional[bool] = None) -> bool:

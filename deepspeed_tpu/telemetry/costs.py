@@ -1,0 +1,521 @@
+"""Cost-accounting plane: analytic cost model, XLA program cost
+registry, and per-dispatch attribution.
+
+The reproduction's serving-side answer to the reference's
+``deepspeed/profiling/`` flops profiler: the telemetry plane (metrics/
+tracer/breakdown) can say how *long* a request took, this module says
+what it *cost* — FLOPs, HBM bytes, and KV block-seconds — per program,
+per request, and per tenant. Three pieces:
+
+- **analytic model** — integer FLOPs/bytes formulas derived from the
+  one source of truth in ``models/gpt.py`` (``num_params``,
+  ``kv_bytes_per_token``); the training-side flops profiler
+  (``profiling/flops_profiler``) imports its per-token constants from
+  here so the two sides can never disagree;
+- :class:`ProgramCostRegistry` — walks the shared
+  ``utils/jit_registry.py`` engine program catalog and records, per
+  compiled twin, XLA's own ``cost_analysis()``/``memory_analysis()``
+  numbers when a lowered executable is available, falling back to the
+  analytic formulas at a reference shape when XLA declines (so the
+  registry is always populated, CPU included);
+- :class:`CostAccountant` — exact integer per-dispatch charges rolled
+  into global ``serving_flops_total``/``serving_hbm_bytes_total``/
+  ``serving_kv_block_seconds`` counters AND per-request footprints,
+  with tenant rollup keyed by ``adapter_id``. Charges are computed
+  per live slot and summed into the globals from the *same* integers,
+  so conservation (sum of footprints == global counters, per dispatch
+  class) holds exactly by construction.
+
+Everything here is host-side arithmetic on python ints — no jax calls
+on the charge path, no device sync, zero new compiled programs
+(``CompileWatch(0)`` holds with the plane on).
+"""
+
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from deepspeed_tpu.utils.jit_registry import (DISPATCH_CLASSES,
+                                              dispatch_class,
+                                              engine_programs)
+
+__all__ = ["PEAK_FLOPS", "device_peak_flops", "matmul_params",
+           "model_flops_per_token", "attn_flops", "infer_flops",
+           "infer_hbm_bytes", "weight_bytes", "split_even",
+           "new_footprint", "merge_footprints", "ProgramCostRegistry",
+           "CostAccountant", "NoopCostAccountant", "NOOP_COSTS"]
+
+# dense peak flops per chip (bf16 MXU throughput) by device_kind
+# prefix — the roofline denominator for MFU estimates. Extend as new
+# generations appear in jax's device_kind strings. (Moved here from
+# profiling/flops_profiler so serving and training share one table.)
+PEAK_FLOPS = {
+    "TPU v2": 45e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def device_peak_flops(device=None) -> Optional[float]:
+    """Peak dense FLOP/s for ``device`` (default: first local device),
+    longest-prefix matched against :data:`PEAK_FLOPS`; None when the
+    platform is unknown (CPU, new TPU generations)."""
+    if device is None:
+        import jax
+        devices = jax.local_devices()
+        if not devices:
+            return None
+        device = devices[0]
+    kind = getattr(device, "device_kind", "") or ""
+    best = None
+    best_len = -1
+    for prefix, peak in PEAK_FLOPS.items():
+        if kind.startswith(prefix) and len(prefix) > best_len:
+            best, best_len = peak, len(prefix)
+    return best
+
+
+# --------------------------------------------------------------------------
+# analytic model — integer formulas over models/gpt.py's param counts
+# --------------------------------------------------------------------------
+
+def matmul_params(cfg, include_head: bool = True) -> int:
+    """Parameters that participate in a matmul per token — ``num_params``
+    minus the wte lookup, with the logit projection counted when
+    ``include_head`` (for tied embeddings the d*V head matmul is real
+    compute even though the weight is shared with wte). The same N the
+    training-side ``train_flops_per_token`` uses, so fwd = 2N and
+    fwd+bwd = 6N agree."""
+    from deepspeed_tpu.models.gpt import num_params
+    n = num_params(cfg) - cfg.vocab_size * cfg.d_model
+    if include_head and cfg.tie_embeddings:
+        n += cfg.d_model * cfg.vocab_size
+    return int(n)
+
+
+def model_flops_per_token(cfg, include_head: bool = True) -> int:
+    """Forward matmul FLOPs per token, attention excluded: 2 FLOPs per
+    matmul parameter. One third of the training-side ``6N``."""
+    return 2 * matmul_params(cfg, include_head)
+
+
+def attn_flops(cfg, n_tokens: int, start_pos: int) -> int:
+    """Forward attention-score FLOPs for ``n_tokens`` consecutive
+    tokens starting at absolute position ``start_pos``: the token at
+    position p attends over p+1 keys, QK^T and PV are each
+    ``2 * d_model`` FLOPs per (query, key) pair per layer — the
+    inference-shape refinement of the training formula's
+    ``12 * L * d * s`` (which is 3x fwd at full context)."""
+    n, s = int(n_tokens), int(start_pos)
+    ctx_sum = n * s + (n * (n + 1)) // 2     # sum of (s + i + 1)
+    return 4 * cfg.n_layers * cfg.d_model * ctx_sum
+
+
+def infer_flops(cfg, n_tokens: int, start_pos: int,
+                include_head: bool = True) -> int:
+    """Total forward FLOPs to process ``n_tokens`` new tokens of one
+    sequence whose cache already holds ``start_pos`` tokens — linear
+    (weight matmul) plus causal attention. Exact integer."""
+    return (int(n_tokens) * model_flops_per_token(cfg, include_head)
+            + attn_flops(cfg, n_tokens, start_pos))
+
+
+def weight_bytes(cfg, param_itemsize: int = 2) -> int:
+    """Bytes of model weights one dispatch streams from HBM (every
+    program reads the full parameter set once per dispatch)."""
+    from deepspeed_tpu.models.gpt import num_params
+    return int(num_params(cfg)) * int(param_itemsize)
+
+
+def infer_hbm_bytes(cfg, n_tokens: int, start_pos: int,
+                    kv_bytes_tok: int, param_itemsize: int = 2,
+                    include_weights: bool = True) -> int:
+    """Analytic HBM traffic for one sequence's share of a dispatch:
+    KV-cache reads (each new token streams the cache up to its
+    position) plus KV writes for the new tokens, plus optionally one
+    full weight read (callers split the weight read across the live
+    slots of a batched dispatch — see :func:`split_even`)."""
+    n, s = int(n_tokens), int(start_pos)
+    ctx_sum = n * s + (n * (n + 1)) // 2
+    kv = int(kv_bytes_tok) * (ctx_sum + n)    # reads + writes
+    return kv + (weight_bytes(cfg, param_itemsize) if include_weights
+                 else 0)
+
+
+def split_even(total: int, n: int) -> List[int]:
+    """Split integer ``total`` into ``n`` integer shares that sum to
+    ``total`` exactly — ``total // n`` each, remainder distributed one
+    unit at a time to the first ``total % n`` shares. The primitive
+    that keeps per-request attribution conservative to the FLOP."""
+    if n <= 0:
+        return []
+    q, r = divmod(int(total), n)
+    return [q + 1 if i < r else q for i in range(n)]
+
+
+# --------------------------------------------------------------------------
+# per-request footprint
+# --------------------------------------------------------------------------
+
+def new_footprint() -> Dict:
+    """Empty per-request cost footprint: per dispatch class a
+    (dispatches, flops, hbm_bytes) triple, plus KV block-seconds
+    integrated at horizon boundaries. Plain data — it rides request
+    snapshots across router drains unchanged."""
+    fp = {cls: {"dispatches": 0, "flops": 0, "hbm_bytes": 0}
+          for cls in DISPATCH_CLASSES}
+    fp["block_seconds"] = 0
+    return fp
+
+
+def merge_footprints(fps: Sequence[Dict]) -> Dict:
+    """Sum footprints (tenant/fleet rollup)."""
+    out = new_footprint()
+    for fp in fps:
+        if not fp:
+            continue
+        for cls in DISPATCH_CLASSES:
+            for k in ("dispatches", "flops", "hbm_bytes"):
+                out[cls][k] += fp.get(cls, {}).get(k, 0)
+        out["block_seconds"] += fp.get("block_seconds", 0)
+    return out
+
+
+def footprint_totals(fp: Dict) -> Dict[str, int]:
+    """Collapse a footprint to its cross-class totals."""
+    return {
+        "flops": sum(fp[c]["flops"] for c in DISPATCH_CLASSES),
+        "hbm_bytes": sum(fp[c]["hbm_bytes"] for c in DISPATCH_CLASSES),
+        "dispatches": sum(fp[c]["dispatches"] for c in DISPATCH_CLASSES),
+        "block_seconds": fp["block_seconds"],
+    }
+
+
+# --------------------------------------------------------------------------
+# program cost registry
+# --------------------------------------------------------------------------
+
+class ProgramCostRegistry:
+    """Static per-program cost card for every serving executable in the
+    shared ``utils/jit_registry.py`` catalog.
+
+    :meth:`populate` walks ``engine_programs()`` against a live engine:
+    when the caller supplies compiled executables (or asks for an AOT
+    probe) each entry records XLA's own ``cost_analysis()`` FLOPs /
+    bytes-accessed and ``memory_analysis()`` peak/argument/output
+    bytes; when XLA declines — the CPU backend reports neither — the
+    entry falls back to the analytic formulas above at a reference
+    shape, so the registry is populated either way. Entries are plain
+    dicts; ``to_json()`` is the flight-recorder section."""
+
+    def __init__(self):
+        self.entries: Dict[str, Dict] = {}
+
+    # .. population .....................................................
+
+    def populate(self, engine, cache=None, compiled=None) -> None:
+        """Fill one entry per registered twin present on ``engine``.
+
+        ``compiled`` optionally maps program id -> an object exposing
+        ``cost_analysis()``/``memory_analysis()`` (an AOT
+        ``jfn.lower(...).compile()`` result); entries without one get
+        the analytic fallback. ``cache`` (a PagedKVCache) refines the
+        KV byte constants; without it the fp32/bf16 defaults from the
+        config dtype are used."""
+        from deepspeed_tpu.models.gpt import kv_bytes_per_token
+        cfg = engine.cfg
+        try:
+            import numpy as _np
+            param_itemsize = int(_np.dtype(engine.dtype).itemsize)
+        except Exception:
+            param_itemsize = 2
+        if cache is not None:
+            kv_tok = int(cache.bytes_per_token)
+        else:
+            kv_tok = int(kv_bytes_per_token(cfg, engine.dtype))
+        block = int(getattr(cache, "block_size", 16) or 16)
+        block_bytes = kv_tok * block
+        ref_ctx = max(1, int(cfg.max_seq_len) // 2)
+
+        for pid, attr, cls in engine_programs():
+            if getattr(engine, attr, None) is None:
+                continue
+            entry = {"program": pid, "attr": attr,
+                     "dispatch_class": cls, "source": "analytic"}
+            entry.update(self._analytic(cfg, cls, kv_tok, block_bytes,
+                                        param_itemsize, ref_ctx))
+            exe = (compiled or {}).get(pid)
+            if exe is not None:
+                xla = probe_compiled(exe)
+                if xla:
+                    entry["source"] = "xla"
+                    entry.update(xla)
+            self.entries[pid] = entry
+
+    @staticmethod
+    def _analytic(cfg, cls: str, kv_tok: int, block_bytes: int,
+                  param_itemsize: int, ref_ctx: int) -> Dict:
+        """Reference-shape cost card: one token (prefill/decode/verify)
+        at half the model's max context, one block (cow/spill)."""
+        if cls in ("prefill", "decode", "verify"):
+            return {
+                "flops": infer_flops(cfg, 1, ref_ctx),
+                "bytes_accessed": infer_hbm_bytes(
+                    cfg, 1, ref_ctx, kv_tok, param_itemsize),
+                "flops_per_token": model_flops_per_token(cfg),
+                "attn_flops_per_ctx_token": 4 * cfg.n_layers * cfg.d_model,
+                "kv_bytes_per_token": kv_tok,
+                "weight_bytes": weight_bytes(cfg, param_itemsize),
+                "ref_context": ref_ctx,
+            }
+        # cow copies a block (read + write); spill moves one block one
+        # way across the host interconnect
+        moved = 2 * block_bytes if cls == "cow" else block_bytes
+        return {"flops": 0, "bytes_accessed": moved,
+                "block_bytes": block_bytes}
+
+    # .. views ..........................................................
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def get(self, pid: str) -> Optional[Dict]:
+        return self.entries.get(pid)
+
+    def export_gauges(self, registry) -> None:
+        """Mirror each entry's headline numbers as gauges on a metrics
+        registry (``program_flops_<pid>`` / ``program_hbm_bytes_<pid>``
+        — declared as wildcard families in the telemetry schema)."""
+        for pid, e in sorted(self.entries.items()):
+            registry.gauge(f"program_flops_{pid}").set(e.get("flops", 0))
+            registry.gauge(f"program_hbm_bytes_{pid}").set(
+                e.get("bytes_accessed", 0))
+
+    def to_json(self) -> Dict:
+        return {"programs": {pid: dict(e)
+                             for pid, e in sorted(self.entries.items())}}
+
+    def dumps(self) -> str:
+        return json.dumps(self.to_json(), sort_keys=True)
+
+
+def probe_compiled(compiled) -> Dict:
+    """Extract XLA's cost/memory analysis from a compiled executable,
+    tolerating every historical shape of the API (dict, list-of-dict,
+    absent, raising). Returns {} when XLA declines — the caller keeps
+    its analytic numbers."""
+    out: Dict = {}
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        if cost:
+            if "flops" in cost:
+                out["flops"] = int(cost["flops"])
+            if "bytes accessed" in cost:
+                out["bytes_accessed"] = int(cost["bytes accessed"])
+    except (AttributeError, TypeError, ValueError, KeyError,
+            IndexError, RuntimeError):
+        pass        # XLA declined; the caller keeps analytic numbers
+    try:
+        mem = compiled.memory_analysis()
+        for attr, key in (("temp_size_in_bytes", "peak_bytes"),
+                          ("argument_size_in_bytes", "argument_bytes"),
+                          ("output_size_in_bytes", "output_bytes")):
+            v = getattr(mem, attr, None)
+            if v is not None:
+                out[key] = int(v)
+    except (AttributeError, TypeError, ValueError, RuntimeError):
+        pass        # memory analysis is backend-optional
+    return out
+
+
+# --------------------------------------------------------------------------
+# per-dispatch accountant
+# --------------------------------------------------------------------------
+
+class CostAccountant:
+    """Exact integer attribution of dispatch costs.
+
+    One instance per :class:`ServingEngine`. Every charge computes the
+    cost per live slot (each slot's own token count and cache context),
+    adds the integers to that request's footprint AND the same integers
+    to the global per-class totals — so the conservation invariant
+
+        sum(per-request footprints) + system footprint == globals
+
+    holds exactly per dispatch class, with no float rounding and no
+    remainder leakage (:func:`split_even` handles shared costs such as
+    the per-dispatch weight read). Costs with no owning request (spill
+    of refcount-zero blocks) land in ``self.system``. When a metrics
+    registry is supplied the cross-class totals also feed the
+    ``serving_flops_total``/``serving_hbm_bytes_total``/
+    ``serving_kv_block_seconds`` counters."""
+
+    enabled = True
+
+    def __init__(self, cfg, kv_bytes_tok: int, block_bytes: int,
+                 param_itemsize: int = 2, registry=None):
+        self.cfg = cfg
+        self.kv_bytes_tok = int(kv_bytes_tok)
+        self.block_bytes = int(block_bytes)
+        self.param_itemsize = int(param_itemsize)
+        self._weight_bytes = weight_bytes(cfg, param_itemsize)
+        self._flops_tok = model_flops_per_token(cfg)
+        self.totals = {cls: {"dispatches": 0, "flops": 0, "hbm_bytes": 0}
+                       for cls in DISPATCH_CLASSES}
+        self.block_seconds_total = 0
+        self.system = new_footprint()
+        self.tenants: Dict[str, Dict] = {}
+        self._c_flops = self._c_bytes = self._c_blocks = None
+        if registry is not None:
+            self._c_flops = registry.counter(
+                "serving_flops_total",
+                "analytic model FLOPs dispatched, all classes")
+            self._c_bytes = registry.counter(
+                "serving_hbm_bytes_total",
+                "analytic HBM bytes moved, all classes")
+            self._c_blocks = registry.counter(
+                "serving_kv_block_seconds",
+                "KV block residency integrated at horizon boundaries "
+                "(scheduler-clock units)")
+
+    # .. internals ......................................................
+
+    def _tenant(self, req) -> Dict:
+        key = getattr(req, "adapter_id", None) or "base"
+        t = self.tenants.get(key)
+        if t is None:
+            t = self.tenants[key] = new_footprint()
+        return t
+
+    def _add(self, cls: str, req, flops: int, nbytes: int,
+             dispatches: int = 0) -> None:
+        for fp in ((req.cost if req is not None else self.system),
+                   self.totals):
+            slot = fp[cls]
+            slot["flops"] += flops
+            slot["hbm_bytes"] += nbytes
+            slot["dispatches"] += dispatches
+        if req is not None:
+            t = self._tenant(req)[cls]
+            t["flops"] += flops
+            t["hbm_bytes"] += nbytes
+            t["dispatches"] += dispatches
+        else:
+            # system charges roll up under a reserved tenant
+            t = self.tenants.setdefault("system", new_footprint())[cls]
+            t["flops"] += flops
+            t["hbm_bytes"] += nbytes
+            t["dispatches"] += dispatches
+        if self._c_flops is not None:
+            self._c_flops.inc(flops)
+            self._c_bytes.inc(nbytes)
+
+    # .. charge API (serving hot loop — host ints only) .................
+
+    def charge_prefill(self, req, n_tokens: int, start_pos: int) -> None:
+        """One prefill-chunk dispatch: single slot owns the whole cost,
+        weight read included."""
+        flops = infer_flops(self.cfg, n_tokens, start_pos)
+        nbytes = infer_hbm_bytes(self.cfg, n_tokens, start_pos,
+                                 self.kv_bytes_tok, self.param_itemsize)
+        self._add("prefill", req, flops, nbytes, dispatches=1)
+
+    def charge_batched(self, cls: str, items) -> None:
+        """One batched dispatch (decode/horizon/verify): ``items`` is a
+        sequence of ``(req, n_tokens, start_pos)`` per live slot. Each
+        slot is charged its own KV/attention cost; the single weight
+        read is split exactly across the live slots."""
+        items = list(items)
+        if not items:
+            return
+        shares = split_even(self._weight_bytes, len(items))
+        for (req, n, s), wshare in zip(items, shares):
+            flops = infer_flops(self.cfg, n, s)
+            nbytes = infer_hbm_bytes(self.cfg, n, s, self.kv_bytes_tok,
+                                     self.param_itemsize,
+                                     include_weights=False) + wshare
+            self._add(cls, req, flops, nbytes, dispatches=1)
+
+    def charge_cow(self, req, n_blocks: int) -> None:
+        """Copy-on-write block copies triggered by ``req``: read+write
+        per block, no FLOPs."""
+        if n_blocks <= 0:
+            return
+        self._add("cow", req, 0, 2 * self.block_bytes * int(n_blocks),
+                  dispatches=int(n_blocks))
+
+    def charge_spill(self, n_blocks: int, req=None,
+                     restore: bool = False) -> None:
+        """Host-tier block transfers (spill or restore): one-way block
+        bytes each. Refcount-zero spills have no owner and land in the
+        system footprint."""
+        if n_blocks <= 0:
+            return
+        self._add("spill", req, 0, self.block_bytes * int(n_blocks),
+                  dispatches=int(n_blocks))
+
+    def charge_block_seconds(self, req, blocks: int, ticks: int) -> None:
+        """KV residency integrated at a horizon boundary: ``blocks``
+        held for ``ticks`` scheduler-clock units."""
+        bs = int(blocks) * int(ticks)
+        if bs <= 0:
+            return
+        req.cost["block_seconds"] += bs
+        self._tenant(req)["block_seconds"] += bs
+        self.block_seconds_total += bs
+        if self._c_blocks is not None:
+            self._c_blocks.inc(bs)
+
+    # .. views ..........................................................
+
+    def snapshot(self) -> Dict:
+        """Plain-data dump for flight recorder / bench rows."""
+        return {
+            "totals": {cls: dict(v) for cls, v in self.totals.items()},
+            "flops_total": sum(v["flops"] for v in self.totals.values()),
+            "hbm_bytes_total": sum(v["hbm_bytes"]
+                                   for v in self.totals.values()),
+            "block_seconds_total": self.block_seconds_total,
+            "system": {cls: dict(self.system[cls])
+                       for cls in DISPATCH_CLASSES}
+            | {"block_seconds": self.system["block_seconds"]},
+            "tenants": {k: merge_footprints([v])
+                        for k, v in sorted(self.tenants.items())},
+        }
+
+
+class NoopCostAccountant:
+    """Off-mode twin: every charge is a constant-time no-op, so the
+    accounting-off hot loop is bit-identical to pre-plane behavior."""
+
+    enabled = False
+    totals: Dict = {}
+    tenants: Dict = {}
+    block_seconds_total = 0
+
+    def charge_prefill(self, req, n_tokens, start_pos):
+        pass
+
+    def charge_batched(self, cls, items):
+        pass
+
+    def charge_cow(self, req, n_blocks):
+        pass
+
+    def charge_spill(self, n_blocks, req=None, restore=False):
+        pass
+
+    def charge_block_seconds(self, req, blocks, ticks):
+        pass
+
+    def snapshot(self) -> Dict:
+        return {}
+
+
+NOOP_COSTS = NoopCostAccountant()

@@ -1,0 +1,188 @@
+"""Flight recorder — black-box postmortem artifacts for serving
+incidents.
+
+A ``DegradedError``, watchdog trip, or breaker break used to leave its
+evidence in live Python objects: whoever caught the exception could
+inspect ``srv.stats`` and the tracer ring, and whoever didn't got
+nothing. The flight recorder turns the incident into a self-contained,
+versioned, CRC-stamped JSON artifact — tracer ring, metrics snapshot,
+autoscaler decisions, fired faults, resolved flags, program cost
+registry, cost-accounting state, and the jax/platform identity — that
+``tools/postmortem.py`` can reconstruct a timeline and cost summary
+from with zero access to the process that died.
+
+Discipline mirrors the rest of the telemetry plane: ``DS_FLIGHT_RECORDER``
+defaults off (DS013 — the off path is the bit-reference and swaps in
+the constant-time :class:`NoopFlightRecorder`); when on, the recorder
+is *always armed* — it costs nothing until an incident (the tracer
+ring it dumps already exists), then one ``json.dump`` on the failure
+path, which is already off the hot loop. Artifacts are bounded: at
+most :attr:`FlightRecorder.MAX_ARTIFACTS` files are kept per
+directory, oldest deleted first.
+"""
+
+import json
+import os
+import tempfile
+import time
+import zlib
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["ARTIFACT_VERSION", "FlightRecorder", "NoopFlightRecorder",
+           "NOOP_FLIGHT", "canonical_json", "verify_artifact",
+           "load_artifact"]
+
+#: bump when the body schema changes shape incompatibly;
+#: tools/postmortem.py refuses versions it doesn't know
+ARTIFACT_VERSION = 1
+
+
+def canonical_json(body: Dict) -> str:
+    """The canonical serialization the CRC is computed over: sorted
+    keys, no whitespace. ``body`` must already be plain JSON data
+    (the recorder normalizes through json before stamping, so the
+    reader's recomputation is byte-identical)."""
+    return json.dumps(body, sort_keys=True, separators=(",", ":"))
+
+
+def _normalize(obj):
+    """Force ``obj`` into plain JSON data (tuples -> lists, unknown
+    objects -> repr strings) so the CRC survives a write/read cycle."""
+    return json.loads(json.dumps(obj, default=repr))
+
+
+class FlightRecorder:
+    """Armed recorder bound to a set of section providers.
+
+    ``sections`` maps section name -> zero-arg callable returning that
+    section's plain data; providers are called only at :meth:`dump`
+    time, and one failing provider degrades to an ``{"error": ...}``
+    stub instead of losing the artifact (a postmortem writer must not
+    itself crash the postmortem)."""
+
+    enabled = True
+    MAX_ARTIFACTS = 8
+
+    def __init__(self, outdir: Optional[str] = None,
+                 sections: Optional[Dict[str, Callable[[], object]]] = None,
+                 label: str = "serving"):
+        self.outdir = outdir or os.path.join(tempfile.gettempdir(),
+                                             "ds_flight")
+        self.label = label
+        self.sections: Dict[str, Callable[[], object]] = dict(sections or {})
+        self.dumps: List[str] = []        # paths written this process
+        self._seq = 0
+
+    def add_section(self, name: str, provider: Callable[[], object]) -> None:
+        self.sections[name] = provider
+
+    # .. the one real entry point ......................................
+
+    def dump(self, reason: str, extra: Optional[Dict] = None) -> str:
+        """Write one postmortem artifact; returns its path. Never
+        raises on provider failure — the artifact records the error."""
+        body: Dict = {
+            "schema": ARTIFACT_VERSION,
+            "label": self.label,
+            "reason": str(reason),
+            "wall_time": time.time(),
+            "identity": _identity(),
+        }
+        for name, provider in self.sections.items():
+            try:
+                body[name] = provider()
+            except Exception as e:          # provider must not kill dump
+                body[name] = {"error": f"{type(e).__name__}: {e}"}
+        if extra:
+            body["extra"] = extra
+        body = _normalize(body)
+        artifact = {
+            "version": ARTIFACT_VERSION,
+            "crc32": zlib.crc32(canonical_json(body).encode("utf-8")),
+            "body": body,
+        }
+        os.makedirs(self.outdir, exist_ok=True)
+        self._seq += 1
+        fname = (f"postmortem-{self.label}-{int(time.time() * 1000)}"
+                 f"-{os.getpid()}-{self._seq}.json")
+        path = os.path.join(self.outdir, fname)
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(artifact, f, sort_keys=True)
+        self.dumps.append(path)
+        self._prune()
+        return path
+
+    def _prune(self) -> None:
+        """Keep the artifact directory bounded: newest MAX_ARTIFACTS
+        postmortems survive."""
+        try:
+            files = sorted(
+                f for f in os.listdir(self.outdir)
+                if f.startswith("postmortem-") and f.endswith(".json"))
+            for stale in files[:-self.MAX_ARTIFACTS]:
+                os.unlink(os.path.join(self.outdir, stale))
+        except OSError:
+            pass
+
+
+class NoopFlightRecorder:
+    """Off-mode twin: no directory, no sections, ``dump`` returns
+    None — one attribute test on the failure path."""
+
+    enabled = False
+    outdir = None
+    sections: Dict = {}
+    dumps: List[str] = []
+
+    def add_section(self, name, provider) -> None:
+        pass
+
+    def dump(self, reason: str, extra=None):
+        return None
+
+
+NOOP_FLIGHT = NoopFlightRecorder()
+
+
+def _identity() -> Dict:
+    """jax/platform identity, degrading gracefully when jax is absent
+    (the postmortem reader never imports jax at all)."""
+    import platform
+    out: Dict = {"python": platform.python_version(),
+                 "platform": platform.platform()}
+    try:
+        import jax
+        out["jax"] = jax.__version__
+        dev = jax.local_devices()[0]
+        out["backend"] = dev.platform
+        out["device_kind"] = dev.device_kind
+        out["device_count"] = jax.local_device_count()
+    except Exception as e:
+        out["jax"] = f"unavailable: {type(e).__name__}"
+    return out
+
+
+# .. reader side (shared with tools/postmortem.py) ......................
+
+def load_artifact(path: str) -> Dict:
+    """Read + verify an artifact; returns the body. Raises ValueError
+    on unknown version or CRC mismatch — a truncated or hand-edited
+    postmortem must fail loudly, not analyze quietly."""
+    with open(path, "r", encoding="utf-8") as f:
+        artifact = json.load(f)
+    verify_artifact(artifact)
+    return artifact["body"]
+
+
+def verify_artifact(artifact: Dict) -> None:
+    if not isinstance(artifact, dict) or "body" not in artifact:
+        raise ValueError("not a flight-recorder artifact (no body)")
+    ver = artifact.get("version")
+    if ver != ARTIFACT_VERSION:
+        raise ValueError(f"unknown postmortem artifact version {ver!r} "
+                         f"(reader knows {ARTIFACT_VERSION})")
+    want = artifact.get("crc32")
+    got = zlib.crc32(canonical_json(artifact["body"]).encode("utf-8"))
+    if want != got:
+        raise ValueError(f"postmortem CRC mismatch: stamped {want}, "
+                         f"recomputed {got} — artifact corrupt")

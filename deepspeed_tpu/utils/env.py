@@ -119,6 +119,17 @@ FLAGS: Dict[str, Flag] = dict([
         "(docs/ROBUSTNESS.md); empty injects nothing"),
     _mk("DS_FAULT_SEED", "int", 0,
         "seed for the ambient FaultInjector's backoff-jitter rng"),
+    _mk("DS_COST_ACCOUNTING", "bool", False,
+        "per-dispatch analytic cost accounting (FLOPs/HBM bytes/KV "
+        "block-seconds per request and tenant) without full telemetry; "
+        "DS_TELEMETRY=on implies it (docs/OBSERVABILITY.md)"),
+    _mk("DS_FLIGHT_RECORDER", "bool", False,
+        "bounded flight recorder: on DegradedError/watchdog/breaker "
+        "trips write a CRC-stamped postmortem JSON artifact "
+        "(tools/postmortem.py reads it; docs/OBSERVABILITY.md)"),
+    _mk("DS_FLIGHT_DIR", "str", "",
+        "directory for flight-recorder postmortem artifacts; empty "
+        "means the platform tempdir under ds_flight/"),
 ])
 
 

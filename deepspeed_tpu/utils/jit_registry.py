@@ -66,3 +66,56 @@ def cache_size(jitted_fn) -> Optional[int]:
     if probe is None:
         return None
     return int(probe())
+
+
+# Serving-side program catalog: every jitted entry point the paged
+# engine dispatches in steady state, by family stem and precision/LoRA
+# twin suffix ("" fp, "_q" int8 KV, "_l" LoRA, "_ql" both). The cost
+# registry (telemetry/costs.py) walks this table to probe
+# ``cost_analysis()``/``memory_analysis()`` per program, and the
+# per-dispatch accountant keys its charges on the same program ids —
+# one table so the two planes can never disagree about what exists.
+# ``cow_blocks`` and the host-tier transfer programs have no LoRA
+# variant (they move cache bytes, not weights).
+ENGINE_PROGRAM_FAMILIES: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
+    ("prefill_slot", ("", "_q", "_l", "_ql")),
+    ("decode_slots", ("", "_q", "_l", "_ql")),
+    ("decode_horizon", ("", "_q", "_l", "_ql")),
+    ("verify_slots", ("", "_q", "_l", "_ql")),
+    ("cow_blocks", ("", "_q")),
+    ("gather_blocks", ("", "_q")),
+    ("scatter_block", ("", "_q")),
+)
+
+# program family stem -> dispatch class the accountant rolls it into
+DISPATCH_CLASSES: Tuple[str, ...] = (
+    "prefill", "decode", "verify", "cow", "spill")
+_FAMILY_CLASS = {
+    "prefill_slot": "prefill",
+    "decode_slots": "decode",
+    "decode_horizon": "decode",
+    "verify_slots": "verify",
+    "cow_blocks": "cow",
+    "gather_blocks": "spill",
+    "scatter_block": "spill",
+}
+
+
+def engine_programs() -> Tuple[Tuple[str, str, str], ...]:
+    """``(program_id, engine_attr, dispatch_class)`` for every serving
+    program: ``("decode_slots_ql", "_decode_slots_ql", "decode")``."""
+    out = []
+    for stem, suffixes in ENGINE_PROGRAM_FAMILIES:
+        for suf in suffixes:
+            out.append((stem + suf, "_" + stem + suf, _FAMILY_CLASS[stem]))
+    return tuple(out)
+
+
+def dispatch_class(program_id: str) -> str:
+    """Dispatch class for a program id (``decode_horizon_q`` →
+    ``decode``); raises ``KeyError`` on an unknown id."""
+    for stem, suffixes in ENGINE_PROGRAM_FAMILIES:
+        for suf in suffixes:
+            if program_id == stem + suf:
+                return _FAMILY_CLASS[stem]
+    raise KeyError(f"unknown engine program id: {program_id!r}")

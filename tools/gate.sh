@@ -86,6 +86,53 @@ PYEOF
     DS_FAULT_SEED=0 DS_FAULTS="serving.horizon:device_error@1*3" \
     DS_DECODE_HORIZON=8 python -m pytest tests/test_horizon.py \
         -k "degrade or parity" -q
+    # flight-recorder postmortem under injected watchdog degrade: the
+    # chaos-induced DegradedError must leave a versioned, CRC-valid
+    # artifact behind, and the stdlib reader (tools/postmortem.py) must
+    # reconstruct the fired faults and a conserved cost summary from
+    # the file alone (docs/OBSERVABILITY.md, docs/ROBUSTNESS.md)
+    echo "gate(chaos): watchdog degrade -> postmortem artifact (DS_FAULT_SEED=0)"
+    DS_FAULT_SEED=0 DS_TELEMETRY=on DS_FLIGHT_RECORDER=on \
+    DS_FLIGHT_DIR=/tmp/ds_gate_flight python - <<'PYEOF'
+import glob, os, jax, jax.numpy as jnp, numpy as np
+from deepspeed_tpu.inference.engine import InferenceEngine
+from deepspeed_tpu.inference.serving import (DegradedError, ServeRequest,
+                                             ServingEngine)
+from deepspeed_tpu.models import gpt
+from deepspeed_tpu.utils import faults as faults_lib
+from deepspeed_tpu.utils.faults import Fault
+
+cfg = gpt.GPTConfig(vocab_size=128, n_layers=2, n_heads=4, d_model=32,
+                    max_seq_len=64, use_flash_attention=False, remat=False,
+                    dtype=jnp.float32)
+params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+eng = InferenceEngine(config=cfg, params=params, dtype=jnp.float32)
+r = np.random.default_rng(12)
+with faults_lib.injected(
+        Fault("serving.decode", "slow", step=4, count=2, param=0.05),
+        seed=0) as inj:
+    srv = ServingEngine(eng, num_slots=2, block_size=4, num_blocks=24,
+                        step_time_budget_s=0.01, watchdog_grace=2,
+                        spec_decode=False, decode_horizon=1)
+    try:
+        srv.run([ServeRequest(rid="a", prompt=r.integers(1, 128, 6).astype(np.int32),
+                              max_new_tokens=12),
+                 ServeRequest(rid="b", prompt=r.integers(1, 128, 9).astype(np.int32),
+                              max_new_tokens=3)])
+        raise SystemExit("watchdog never tripped")
+    except DegradedError:
+        pass
+assert srv.flight.dumps, "degrade wrote no postmortem artifact"
+path = srv.flight.dumps[-1]
+from tools.postmortem import analyze_postmortem, load_artifact
+summary = analyze_postmortem(load_artifact(path))   # CRC + version gate
+assert "over budget" in summary["incident"]["reason"]
+assert [tuple(f) for f in summary["faults"]] == inj.fired
+live = srv.costs.snapshot()
+assert summary["totals"]["per_class"] == live["totals"]
+assert summary["totals"]["flops_total"] == live["flops_total"] > 0
+print(f"gate(chaos): postmortem artifact ok ({os.path.basename(path)})")
+PYEOF
 elif [[ "${1:-}" == "quick" ]]; then
     # lint the changed .py files PLUS their direct importers (--closure
     # quick mode, cached import graph from the last full run) so the
@@ -204,6 +251,21 @@ else
     echo "gate: serving smoke (DS_DECODE_HORIZON=8)"
     DS_DECODE_HORIZON=8 python -m pytest tests/test_serving.py \
         tests/test_sampling.py tests/test_horizon.py tests/test_chaos.py -q
+    # cost-accounting + flight-recorder smoke: the suite default leaves
+    # DS_TELEMETRY and DS_COST_ACCOUNTING unset (= off, the no-op
+    # accountant), so run the conservation + postmortem suite once with
+    # the telemetry plane forced ON — per-request/tenant attribution
+    # must balance against the global counters to the integer in every
+    # scenario (eviction, spec fallback, horizon, router drain), and
+    # the DegradedError postmortem round-trip must hold
+    # (docs/OBSERVABILITY.md)
+    echo "gate: cost accounting conservation + postmortem (DS_TELEMETRY=on)"
+    DS_TELEMETRY=on python -m pytest tests/test_cost_accounting.py -q
+    # and once with the standalone knob: cost accounting without the
+    # rest of the telemetry plane must still conserve
+    echo "gate: cost accounting standalone (DS_COST_ACCOUNTING=on)"
+    DS_COST_ACCOUNTING=on python -m pytest tests/test_cost_accounting.py \
+        -k "knob or snapshot or analytic" -q
     # closed-loop smoke: the serve-autoscale CPU row must show the SLO
     # contrast (fixed fleet violates, policy fleet holds by scaling up)
     # and the chaos suite must stay green with the controller ACTIVE —

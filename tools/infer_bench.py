@@ -188,7 +188,7 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
                   host_tier=None, host_budget_bytes=None,
                   spill_watermark=None, prefix_families=1,
                   temperature=0.0, top_p=1.0, sample_seed=0,
-                  decode_horizon=None, emit=True):
+                  decode_horizon=None, chip_peak_flops=None, emit=True):
     """Continuous-batching serving row: synthetic Poisson arrivals driven
     through ServingEngine.step, wall-clock tokens/s, TTFT/TPOT latency
     percentiles from the telemetry registry's histograms, decode-slot
@@ -327,6 +327,11 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
     w.run([ServeRequest(rid="w", prompt=reqs[0].prompt.copy(),
                         max_new_tokens=2)])
 
+    # device-time via the snapshot/delta idiom: device_time_s is a
+    # monotonic accumulator over the engine's lifetime, so a drive must
+    # bill itself the DELTA, not the running total — reusing one engine
+    # for k repeats would otherwise double-bill every repeat
+    dev0 = srv.device_time_snapshot()
     t0 = time.perf_counter()
     step = 0
     nxt = 0
@@ -337,6 +342,7 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
         srv.step(now=time.perf_counter())
         step += 1
     wall_s = time.perf_counter() - t0
+    device_s = srv.device_time_snapshot() - dev0
 
     ttft_h = srv.metrics.histogram("serving_ttft")
     tpot_h = srv.metrics.histogram("serving_tpot")
@@ -357,6 +363,17 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
     from deepspeed_tpu.ops.attention.paged import paged_hbm_bytes_per_token
     mean_len = float(np.mean([len(r.prompt) + len(r.out) / 2
                               for r in srv.finished])) if srv.finished else 0
+    # serve-cost-* attribution columns (telemetry/costs.py): the exact
+    # integer FLOPs/HBM bytes the accountant charged this drive, the
+    # analytic per-token model cost, and a roofline MFU against the
+    # chip peak (``chip_peak_flops`` overrides; default = this device's
+    # spec-sheet peak, None on CPU -> mfu_analytic null)
+    from deepspeed_tpu.telemetry.costs import (device_peak_flops,
+                                               model_flops_per_token)
+    cost_snap = srv.costs.snapshot() if srv.costs.enabled else None
+    peak = (chip_peak_flops if chip_peak_flops is not None
+            else device_peak_flops())
+    cost_flops = cost_snap["flops_total"] if cost_snap else 0
     row = {
         "config": name, "preset": preset or "cpu-smoke",
         "num_requests": num_requests, "new_tokens": new_tokens,
@@ -445,11 +462,22 @@ def bench_serving(name, preset=None, num_requests=16, mean_gap_steps=2.0,
         # amortizes the host share ~N×
         "decode_horizon": srv.decode_horizon,
         "device_ms_per_token": round(
-            srv.device_time_s / max(gen_tokens, 1) * 1e3, 3),
+            device_s / max(gen_tokens, 1) * 1e3, 3),
         "host_ms_per_token": round(
-            max(0.0, wall_s - srv.device_time_s)
+            max(0.0, wall_s - device_s)
             / max(gen_tokens, 1) * 1e3, 3),
         "horizon_fallbacks": st["horizon_fallbacks"],
+        "model_flops_per_token": model_flops_per_token(cfg),
+        "serve_cost_flops_total": cost_flops,
+        "serve_cost_hbm_bytes_total": (cost_snap["hbm_bytes_total"]
+                                       if cost_snap else 0),
+        "serve_cost_kv_block_seconds": (cost_snap["block_seconds_total"]
+                                        if cost_snap else 0),
+        "serve_cost_flops_per_token": round(
+            cost_flops / max(gen_tokens, 1), 1),
+        "chip_peak_tflops": round(peak / 1e12, 1) if peak else None,
+        "mfu_analytic": round(cost_flops / device_s / peak, 4)
+        if (peak and device_s > 0) else None,
         "cache_stats": cache.stats(),
         # per-request lifecycle timestamps (seconds relative to drive
         # start): submit/first-token/finish per rid, so SLO attainment
@@ -1002,6 +1030,109 @@ def bench_serving_lora_compare(name, preset=None, num_requests=10,
     }), flush=True)
 
 
+def bench_serving_cost_attrib(name, preset=None, num_requests=10,
+                              mean_gap_steps=2.0, prompt_lens=(6, 14),
+                              new_tokens=8, num_slots=2, block_size=8,
+                              prefill_chunk=16, n_adapters=2, rank=4,
+                              seed=0):
+    """Per-tenant cost attribution (telemetry/costs.py): a mixed
+    base + n_adapters LoRA population through ONE engine with the cost
+    accountant on, reporting each tenant's exact FLOPs/HBM-bytes/
+    KV-block-seconds footprint, the per-dispatch-class totals, and the
+    conservation verdict (sum of per-request footprints == the global
+    counters, per class — exact integers, not approximately)."""
+    from deepspeed_tpu.models import gpt
+    import deepspeed_tpu
+    from deepspeed_tpu.inference.serving import ServeRequest, ServingEngine
+    from deepspeed_tpu.runtime.lora import add_lora, adapter_state_dict
+    from deepspeed_tpu.telemetry import Telemetry
+    from deepspeed_tpu.utils.jit_registry import DISPATCH_CLASSES
+
+    on_tpu = "tpu" in (jax.devices()[0].platform +
+                       jax.devices()[0].device_kind).lower()
+    max_seq = prompt_lens[1] + new_tokens + 8
+    if preset:
+        cfg = gpt.preset(preset, max_seq_len=max_seq, dtype=jnp.bfloat16,
+                         use_flash_attention=on_tpu)
+    else:
+        cfg = gpt.GPTConfig(vocab_size=512, n_layers=4, n_heads=8,
+                            d_model=256, max_seq_len=max_seq,
+                            use_flash_attention=False, remat=False,
+                            dtype=jnp.float32)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    eng = deepspeed_tpu.init_inference(model=(cfg, params), dtype=dtype)
+    srv = ServingEngine(eng, num_slots=num_slots, block_size=block_size,
+                        prefill_chunk=prefill_chunk, spec_decode=False,
+                        lora_serve=True, telemetry=Telemetry())
+    for t in range(n_adapters):
+        srv.register_adapter(
+            f"tenant-{t}",
+            adapter_state_dict(add_lora(
+                params, rank=rank, alpha=2.0 * rank,
+                rng=jax.random.PRNGKey(seed + 100 + t))))
+
+    rng = np.random.default_rng(seed)
+    arrive = np.floor(np.cumsum(
+        rng.exponential(mean_gap_steps, num_requests))).astype(int)
+    reqs = [ServeRequest(
+                rid=i,
+                prompt=rng.integers(0, cfg.vocab_size,
+                                    rng.integers(*prompt_lens)
+                                    ).astype(np.int32),
+                max_new_tokens=new_tokens,
+                adapter_id=(f"tenant-{i % n_adapters}"
+                            if i % 3 else None))
+            for i in range(num_requests)]
+    t0 = time.perf_counter()
+    s = nxt = 0
+    while nxt < num_requests or srv.busy:
+        while nxt < num_requests and arrive[nxt] <= s:
+            srv.submit(reqs[nxt], now=time.perf_counter())
+            nxt += 1
+        srv.step(now=time.perf_counter())
+        s += 1
+    wall_s = time.perf_counter() - t0
+
+    snap = srv.costs.snapshot()
+    # conservation check, same arithmetic the test suite pins: refold
+    # every per-request footprint (plus the unowned system residue)
+    # and compare against the accountant's per-class totals
+    folded = {c: {"flops": 0, "hbm_bytes": 0, "dispatches": 0}
+              for c in DISPATCH_CLASSES}
+    for r in srv.finished:
+        for c in DISPATCH_CLASSES:
+            for k in folded[c]:
+                folded[c][k] += r.cost[c][k]
+    for c in DISPATCH_CLASSES:
+        for k in folded[c]:
+            folded[c][k] += srv.costs.system[c][k]
+    conserved = all(folded[c][k] == srv.costs.totals[c][k]
+                    for c in DISPATCH_CLASSES for k in folded[c])
+    gen_tokens = sum(len(r.out) for r in srv.finished)
+    row = {
+        "config": name, "preset": preset or "cpu-smoke",
+        "num_requests": num_requests, "n_adapters": n_adapters,
+        "completed": srv.stats["completed"],
+        "tokens_per_s": round(gen_tokens / max(wall_s, 1e-9), 1),
+        "conservation_exact": bool(conserved),
+        "serve_cost_flops_total": snap["flops_total"],
+        "serve_cost_hbm_bytes_total": snap["hbm_bytes_total"],
+        "serve_cost_kv_block_seconds": snap["block_seconds_total"],
+        "cost_registry_programs": len(srv.cost_registry.entries),
+        "per_class": {c: dict(srv.costs.totals[c])
+                      for c in DISPATCH_CLASSES},
+        "per_tenant": {
+            tid: {"flops": sum(fp[c]["flops"] for c in DISPATCH_CLASSES),
+                  "hbm_bytes": sum(fp[c]["hbm_bytes"]
+                                   for c in DISPATCH_CLASSES),
+                  "block_seconds": fp["block_seconds"]}
+            for tid, fp in sorted(srv.costs.tenants.items())},
+    }
+    print(json.dumps(row), flush=True)
+    return row
+
+
 def bench_serving_autoscale_compare(name, preset=None, num_slots=2,
                                     block_size=8, num_blocks=None,
                                     prefill_chunk=16, max_replicas=3,
@@ -1260,6 +1391,20 @@ SERVE_COMPARE_CONFIGS = [
         mean_gap_steps=1.5, prompt_lens=(16, 96), new_tokens=32,
         num_slots=4, block_size=16, prefill_chunk=64, n_adapters=4,
         rank=8)),
+    # per-tenant cost attribution: a mixed base+LoRA population with
+    # the cost accountant on — the row is each tenant's exact
+    # FLOPs/HBM/block-seconds footprint and the conservation verdict
+    # (sum of per-request footprints == global counters, per class)
+    ("serve-cost-attrib-smoke", dict(mode="cost_attrib",
+                                     num_requests=10,
+                                     mean_gap_steps=2.0,
+                                     prompt_lens=(6, 14), new_tokens=8,
+                                     num_slots=2, block_size=8,
+                                     prefill_chunk=16, n_adapters=2)),
+    ("serve-cost-attrib-gpt2-medium", dict(
+        mode="cost_attrib", preset="gpt2-medium", num_requests=24,
+        mean_gap_steps=1.5, prompt_lens=(16, 96), new_tokens=32,
+        num_slots=4, block_size=16, prefill_chunk=64, n_adapters=3)),
 ]
 
 
@@ -1364,6 +1509,7 @@ def main():
                    "autoscale": bench_serving_autoscale_compare,
                    "lora": bench_serving_lora_compare,
                    "horizon": bench_serving_horizon_compare,
+                   "cost_attrib": bench_serving_cost_attrib,
                    }.get(mode, bench_serving_impl_compare)
         try:
             compare(name, **kw)

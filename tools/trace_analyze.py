@@ -21,6 +21,15 @@ Usage:
         breaker/health timeline, drains/restarts/fleet-shape changes
         and the autoscale decision timeline with each decision's
         triggering window metrics.
+  python tools/trace_analyze.py cost <artifact-or-snapshot.json>
+      — per-phase / per-tenant cost summary (FLOPs, HBM bytes, KV
+        block-seconds) from either a flight-recorder postmortem
+        artifact (CRC-verified) or a live ``CostAccountant.snapshot()``
+        JSON dump.
+
+``serve``/``fleet``/``cost`` accept ``--json``: print the full summary
+dict as one JSON document (stable schema — the same dict the tests
+assert on) instead of the human report.
 """
 
 import collections
@@ -258,6 +267,65 @@ def analyze_fleet_trace(path: str, quiet: bool = False) -> dict:
     return summary
 
 
+def analyze_cost(path: str, quiet: bool = False) -> dict:
+    """Per-phase / per-tenant cost summary from a cost-accounting
+    snapshot. Accepts either a flight-recorder postmortem artifact
+    (``{"version", "crc32", "body"}`` — CRC-verified via
+    ``tools/postmortem.py``'s stdlib reader) or a raw
+    ``CostAccountant.snapshot()`` JSON file. Returns the summary dict
+    (tests assert on it); prints it unless ``quiet``."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and "body" in doc and "crc32" in doc:
+        from tools.postmortem import verify_artifact
+        verify_artifact(doc)
+        costs = doc["body"].get("costs") or {}
+        source = "postmortem"
+    else:
+        costs = doc
+        source = "snapshot"
+    per_class = costs.get("totals") or {}
+    tenants = costs.get("tenants") or {}
+
+    def _fold(fp):
+        out = {"flops": 0, "hbm_bytes": 0, "dispatches": 0,
+               "block_seconds": int(fp.get("block_seconds", 0))}
+        for cls, c in fp.items():
+            if isinstance(c, dict):
+                for k in ("flops", "hbm_bytes", "dispatches"):
+                    out[k] += int(c.get(k, 0))
+        return out
+
+    summary = {
+        "source": source,
+        "flops_total": int(costs.get("flops_total") or 0),
+        "hbm_bytes_total": int(costs.get("hbm_bytes_total") or 0),
+        "block_seconds_total": int(costs.get("block_seconds_total") or 0),
+        "per_class": per_class,
+        "per_tenant": {tid: _fold(fp) for tid, fp
+                       in sorted(tenants.items())},
+    }
+    if not quiet:
+        print(json.dumps({"file": path, "source": source,
+                          "flops_total": summary["flops_total"],
+                          "hbm_bytes_total": summary["hbm_bytes_total"],
+                          "kv_block_seconds":
+                          summary["block_seconds_total"]}))
+        if per_class:
+            print("\n-- by dispatch class --")
+            for cls, c in sorted(per_class.items()):
+                print(f"  {cls:<8} {c.get('dispatches', 0):>8} dispatches"
+                      f" {c.get('flops', 0):>16} flops"
+                      f" {c.get('hbm_bytes', 0):>16} bytes")
+        if summary["per_tenant"]:
+            print("\n-- by tenant --")
+            for tid, t in summary["per_tenant"].items():
+                print(f"  {tid:<14} {t['flops']:>16} flops"
+                      f" {t['hbm_bytes']:>16} bytes"
+                      f" {t['block_seconds']:>8} block-s")
+    return summary
+
+
 def run():
     import jax
     import numpy as np
@@ -297,11 +365,20 @@ def run():
 
 
 if __name__ == "__main__":
+    _as_json = "--json" in sys.argv[2:]
     if sys.argv[1:] and sys.argv[1] == "read":
         analyze(sys.argv[2])
     elif sys.argv[1:] and sys.argv[1] == "serve":
-        analyze_serving_trace(sys.argv[2])
+        s = analyze_serving_trace(sys.argv[2], quiet=_as_json)
+        if _as_json:
+            print(json.dumps(s, sort_keys=True))
     elif sys.argv[1:] and sys.argv[1] == "fleet":
-        analyze_fleet_trace(sys.argv[2])
+        s = analyze_fleet_trace(sys.argv[2], quiet=_as_json)
+        if _as_json:
+            print(json.dumps(s, sort_keys=True))
+    elif sys.argv[1:] and sys.argv[1] == "cost":
+        s = analyze_cost(sys.argv[2], quiet=_as_json)
+        if _as_json:
+            print(json.dumps(s, sort_keys=True))
     else:
         run()
