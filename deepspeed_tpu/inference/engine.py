@@ -1209,7 +1209,8 @@ class InferenceEngine:
         return logits, toks, lps, ks, vs
 
     def _verify_slots_l_fn(self, params, k_pool, v_pool, tables, lengths,
-                           tokens, active, impl, lora_a, lora_b, ablocks):
+                           tokens, active, impl="gather", lora_a=None,
+                           lora_b=None, ablocks=None):
         """LoRA twin of _verify_slots_fn: each slot's draft chunk is
         scored under ITS adapter (speculative decode composes with
         multi-tenant serving — the verify distribution is the adapted
@@ -1298,8 +1299,8 @@ class InferenceEngine:
         return logits, toks, lps, ks, vs, kss, vss
 
     def _verify_slots_ql_fn(self, params, k_pool, v_pool, k_scale, v_scale,
-                            tables, lengths, tokens, active, impl,
-                            lora_a, lora_b, ablocks):
+                            tables, lengths, tokens, active, impl="gather",
+                            lora_a=None, lora_b=None, ablocks=None):
         """int8-pool + LoRA combo twin of _verify_slots_fn."""
         cfg = self.cfg
         B, G = tokens.shape

@@ -250,6 +250,28 @@ class _StatsView(Mapping):
         return repr(dict(self))
 
 
+# ServeRequest fields dslint DS018 must NOT require to round-trip
+# through snapshot_entry/from_snapshot — each is either derived on
+# resubmit or meaningless on a fresh replica. Adding a field to
+# ServeRequest without serializing it OR listing it here (with a
+# reason) is a lint error: that is exactly how adapter_id, seed chains
+# and cost footprints were silently lost before they were retrofitted.
+SNAPSHOT_EPHEMERAL = frozenset({
+    "n",                # expansion happens at submit; candidates snapshot
+                        # individually, so a resumed request is always n=1
+    "state",            # serialized for postmortems, but a resumed request
+                        # must re-enter the scheduler as "queued"
+    "token_times",      # scheduler-clock latency stamps; a fresh replica's
+                        # clock makes them incomparable
+    "submitted_at",     # ditto — resubmission re-stamps it
+    "first_token_at",   # ditto
+    "finished_at",      # pending requests by definition never finished
+    "_admit_seq",       # admission order on the dead replica; the resuming
+                        # scheduler assigns its own
+    "_work",            # rebuilt from prompt + out at re-prefill
+})
+
+
 @dataclass
 class ServeRequest:
     """One generation request. ``out`` accumulates generated token ids;

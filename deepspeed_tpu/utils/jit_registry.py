@@ -87,6 +87,36 @@ ENGINE_PROGRAM_FAMILIES: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     ("scatter_block", ("", "_q")),
 )
 
+# Declared per-feature twin deltas: what a feature suffix is ALLOWED to
+# change relative to the base program. dslint's DS015 normalizes each
+# twin's AST modulo this spec and flags any other divergence, so an edit
+# to ``_decode_slots_fn`` that misses ``_decode_slots_q_fn`` is a lint
+# error instead of a silent parity bug. Suffix characters compose:
+# ``_ql`` owns the union of the "q" and "l" deltas.
+#
+#   params : extra positional parameters the twin's signature may add
+#   names  : local/parameter names the feature owns — any statement or
+#            tuple/call element mentioning ONLY these is feature-owned
+#            and stripped before comparison (q: the requantize block's
+#            scale sidecars; l: the gathered-einsum LoRA block)
+#   kwargs : call keywords the twin may thread through (``k_scale=``,
+#            ``lora_ops=``) that the base never passes
+TWIN_DELTAS = {
+    "q": {
+        "params": ("k_scale", "v_scale", "ks_blk", "vs_blk"),
+        "names": ("k_scale", "v_scale", "ks_blk", "vs_blk",
+                  "ksp", "vsp", "kss", "vss"),
+        "kwargs": ("k_scale", "v_scale"),
+    },
+    "l": {
+        "params": ("lora_a", "lora_b", "ablocks", "ablock_row"),
+        "names": ("lora_a", "lora_b", "ablocks", "ablock_row",
+                  "la", "lb", "lora", "lora_ops"),
+        "kwargs": ("lora", "lora_ops"),
+    },
+}
+
+
 # program family stem -> dispatch class the accountant rolls it into
 DISPATCH_CLASSES: Tuple[str, ...] = (
     "prefill", "decode", "verify", "cow", "spill")

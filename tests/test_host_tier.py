@@ -419,6 +419,37 @@ def test_cache_abort_transfers_settles_inflight():
     assert (c._refcount >= 0).all()
 
 
+def test_cache_abort_midstream_then_respill_stays_balanced():
+    """Regression for the dslint DS016 resource-pairing audit of the
+    host tier: an abort landing BETWEEN a harvest and the next dispatch
+    must settle every `_in_transfer` entry exactly once (no orphaned
+    entries, no double return to the free list), and the aborted blocks
+    must remain spillable — a later pass picks them up cleanly."""
+    c = cache_of()
+    t = np.arange(1, 13, dtype=np.int32)             # 3 blocks @ bs=4
+    prefilled(c, 0, t)
+    c.free(0)
+    free0 = len(c._free)
+    c.spill_tick()                # dispatch batch 1 (2 blocks)
+    c.spill_tick()                # harvest batch 1, dispatch batch 2
+    assert c.host_spills == 2 and len(c._in_transfer) == 1
+    aborted = c.abort_transfers()
+    assert aborted == 1
+    assert not c._in_transfer and c._pending_spill is None
+    # the aborted block stayed cached + device-resident: it was NOT
+    # returned to the free list (that would be a double release once a
+    # later spill frees it again)
+    assert len(c._free) == free0 + 2
+    assert len(set(c._free)) == len(c._free)
+    assert (c._refcount >= 0).all()
+    # ...and the spill daemon picks it up again on the next pass
+    _spill_all(c)
+    assert c.host_spills == 3
+    assert not c._in_transfer and c._pending_spill is None
+    assert len(c._free) == free0 + 3
+    assert len(set(c._free)) == len(c._free)
+
+
 def test_cache_off_mode_is_inert():
     """host_tier=False keeps every new surface dormant: no pool, no
     transfers, spill_tick a no-op — the off path is the bit-reference

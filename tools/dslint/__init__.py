@@ -4,12 +4,18 @@ CLI: ``python -m tools.dslint deepspeed_tpu tools tests`` (see
 __main__.py). Library surface (used by tests): analyze_source /
 analyze_paths / analyze_package, load_baseline / apply_baseline /
 write_baseline, default_rules, interproc_rules, build_symbol_table,
-to_sarif.
+to_sarif — plus the v3 dataflow core: build_cfg, solve_forward,
+GenKill, summarize_pairs, dataflow_rules.
 """
 
 from tools.dslint.core import (Finding, analyze_package, analyze_paths,
                                analyze_source, apply_baseline,
                                load_baseline, write_baseline)
+from tools.dslint.dataflow import (CFG, Block, ForwardAnalysis, GenKill,
+                                   PairSpec, build_cfg,
+                                   build_pair_summaries, dataflow_catalog,
+                                   dataflow_rules, solve_forward,
+                                   summarize_pairs)
 from tools.dslint.interproc import interproc_catalog, interproc_rules
 from tools.dslint.rules import default_rules, rule_catalog
 from tools.dslint.sarif import to_sarif, write_sarif
@@ -19,4 +25,7 @@ __all__ = ["Finding", "analyze_package", "analyze_paths", "analyze_source",
            "apply_baseline", "load_baseline", "write_baseline",
            "default_rules", "rule_catalog", "interproc_rules",
            "interproc_catalog", "build_symbol_table", "to_sarif",
-           "write_sarif"]
+           "write_sarif", "CFG", "Block", "ForwardAnalysis", "GenKill",
+           "PairSpec", "build_cfg", "build_pair_summaries",
+           "dataflow_catalog", "dataflow_rules", "solve_forward",
+           "summarize_pairs"]

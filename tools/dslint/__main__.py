@@ -4,12 +4,16 @@ Exit code 0 when every finding is fixed, suppressed inline, or in the
 baseline; 1 otherwise. ``--update-baseline`` rewrites the checked-in
 baseline from the current tree (visible debt, non-blocking).
 
-Two phases: the per-file rules (DS001–DS010) and the interprocedural
-rules (DS011–DS014) over a package-wide symbol table. ``--closure``
-switches to quick mode: the positional paths are treated as *changed
-files* and the lint runs over them plus their direct importers (from
-the cached import graph), with the whole-tree completeness checks
-disabled. ``--sarif PATH`` additionally writes a SARIF 2.1.0 log.
+Two phases: the per-file rules (DS001–DS010) and the package-wide
+rules over a shared symbol table — interprocedural (DS011–DS014) and
+flow-sensitive dataflow (DS015–DS018). ``--closure`` switches to quick
+mode: the positional paths are treated as *changed files* and the lint
+runs over them plus their direct importers (from the cached import
+graph), with the whole-tree completeness checks disabled; the cache
+key includes the content hashes of jit_registry.py and
+telemetry_schema.json, so editing either forces a full re-analysis.
+``--sarif PATH`` additionally writes a SARIF 2.1.0 log.
+``--explain DS0NN`` prints one rule's doc + a minimal true positive.
 """
 
 import argparse
@@ -25,7 +29,7 @@ from tools.dslint.rules import default_rules, rule_catalog
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.dslint",
-        description="JAX/TPU-aware static analysis (rules DS001-DS014; "
+        description="JAX/TPU-aware static analysis (rules DS001-DS018; "
                     "see docs/LINT.md)")
     ap.add_argument("paths", nargs="*", default=["deepspeed_tpu", "tools"],
                     help="files or directories (default: deepspeed_tpu "
@@ -42,6 +46,9 @@ def main(argv=None) -> int:
     ap.add_argument("--rules", default=None,
                     help="comma-separated rule IDs to run (default: all)")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--explain", metavar="DS0NN", default=None,
+                    help="print one rule's doc + a minimal true-positive "
+                         "example, then exit")
     ap.add_argument("--show-baselined", action="store_true",
                     help="also print baselined findings in text mode")
     ap.add_argument("--sarif", metavar="PATH", default=None,
@@ -53,6 +60,15 @@ def main(argv=None) -> int:
                          "their direct importers (cached import graph); "
                          "whole-tree completeness checks are skipped")
     args = ap.parse_args(argv)
+
+    if args.explain:
+        from tools.dslint.explain import explain
+        text = explain(args.explain)
+        if text is None:
+            print(f"no such rule: {args.explain}", file=sys.stderr)
+            return 2
+        print(text)
+        return 0
 
     if args.list_rules:
         for r in rule_catalog() + interproc_catalog():

@@ -489,8 +489,11 @@ def _dedupe(findings: List[Finding]) -> List[Finding]:
 # --------------------------------------------------------------------------
 
 def interproc_rules() -> List[InterprocRule]:
+    # dataflow (v3) imports InterprocRule from this module, so its
+    # import must stay inside the function body
+    from tools.dslint.dataflow import dataflow_rules
     return [DonationFlowHazard(), FaultSiteIntegrity(),
-            EnvFlagRegistry(), TelemetrySchemaDrift()]
+            EnvFlagRegistry(), TelemetrySchemaDrift()] + dataflow_rules()
 
 
 def interproc_catalog() -> List[Dict[str, str]]:

@@ -18,12 +18,25 @@ SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
                 "master/Schemata/sarif-schema-2.1.0.json")
 
 
+def _help_anchor(rule_id: str) -> str:
+    """LINT.md section anchor for a rule id — SARIF viewers surface it
+    as the rule's documentation link."""
+    n = int(rule_id[2:])
+    if n >= 15:
+        return "#the-flow-sensitive-rules-phase-3"
+    if n >= 11:
+        return "#the-interprocedural-rules-phase-2"
+    return "#the-rules"
+
+
 def _rule_entry(rule: Dict[str, str]) -> Dict:
     return {
         "id": rule["id"],
         "name": rule["name"],
         "shortDescription": {"text": rule["name"]},
         "fullDescription": {"text": rule["rationale"]},
+        "helpUri": ((REPO_ROOT / "docs" / "LINT.md").as_uri()
+                    + _help_anchor(rule["id"])),
         "defaultConfiguration": {"level": "error"},
     }
 

@@ -772,24 +772,64 @@ def _propagate_helper_donation(table: SymbolTable, fn_calls) -> None:
 
 CALLGRAPH_CACHE = REPO_ROOT / "build" / "dslint_callgraph.json"
 
+# Shared analysis INPUTS whose content changes rule behaviour without
+# changing any analyzed .py file's import graph: the jit-wrapper/twin
+# spec and the telemetry schema. Their hashes ride the cache so a
+# `--closure` run after editing one of them misses the cache and falls
+# back to a full pass (a stale cache here means DS002/DS011/DS014/DS015
+# silently lint against yesterday's contract).
+CACHE_INPUT_FILES: Tuple[Tuple[str, Path], ...] = (
+    ("jit_registry", REPO_ROOT / "deepspeed_tpu" / "utils"
+     / "jit_registry.py"),
+    ("telemetry_schema", REPO_ROOT / "tools" / "dslint"
+     / "telemetry_schema.json"),
+)
+
+
+def cache_input_hashes(files: Optional[Sequence[Tuple[str, Path]]] = None
+                       ) -> Dict[str, str]:
+    """sha256 per shared analysis input; absent files hash to ''."""
+    import hashlib
+    out: Dict[str, str] = {}
+    for key, p in (CACHE_INPUT_FILES if files is None else files):
+        try:
+            out[key] = hashlib.sha256(Path(p).read_bytes()).hexdigest()
+        except OSError:
+            out[key] = ""
+    return out
+
 
 def write_callgraph_cache(table: SymbolTable,
-                          path: Optional[Path] = None) -> Path:
+                          path: Optional[Path] = None,
+                          inputs: Optional[Dict[str, str]] = None) -> Path:
     path = Path(path or CALLGRAPH_CACHE)
     path.parent.mkdir(parents=True, exist_ok=True)
     data = {p: sorted(deps) for p, deps in sorted(table.imports.items())}
-    path.write_text(json.dumps({"version": 1, "imports": data}, indent=1)
-                    + "\n", encoding="utf-8")
+    path.write_text(json.dumps({
+        "version": 2,
+        "inputs": cache_input_hashes() if inputs is None else inputs,
+        "imports": data}, indent=1) + "\n", encoding="utf-8")
     return path
 
 
-def load_callgraph_cache(path: Optional[Path] = None) -> Dict[str, Set[str]]:
+def load_callgraph_cache(path: Optional[Path] = None,
+                         inputs: Optional[Dict[str, str]] = None
+                         ) -> Dict[str, Set[str]]:
+    """The cached import graph, or {} when the cache is missing,
+    unreadable, from another cache version, or was written against
+    different shared-input content (jit_registry / telemetry_schema) —
+    {} makes --closure fall back to a full re-analysis."""
     path = Path(path or CALLGRAPH_CACHE)
     if not path.exists():
         return {}
     try:
         data = json.loads(path.read_text(encoding="utf-8"))
     except (OSError, ValueError):
+        return {}
+    if data.get("version") != 2:
+        return {}
+    current = cache_input_hashes() if inputs is None else inputs
+    if data.get("inputs") != current:
         return {}
     return {p: set(deps) for p, deps in data.get("imports", {}).items()}
 
