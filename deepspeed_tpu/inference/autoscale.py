@@ -18,11 +18,17 @@ merged across every registry in the fleet — "p99 TTFT over the last
 ``window`` clock units", not lifetime) plus the live load
 (queue depth + occupied slots), and decides ONE of:
 
-- ``scale_up`` — windowed p99 TTFT over ``ttft_slo`` and the fleet is
+- ``scale_up`` — windowed p99 TTFT over ``ttft_slo`` (or, with
+  ``tpot_slo`` set, windowed p99 TPOT over it) and the fleet is
   below ``max_replicas``: spawn a replica via the router's
   ``replica_factory``. Replicas sharing one ``InferenceEngine`` share
   its compiled programs, so scale-up compiles nothing
   (tests/test_autoscale.py pins this with ``CompileWatch(0)``).
+  In a disaggregated fleet (any ``prefill``-role replica) the two
+  pools scale INDEPENDENTLY: TTFT pressure adds a ``prefill``
+  replica (first tokens are late because prefills queue), TPOT or
+  queue pressure adds a ``decode`` replica (streams are stalling);
+  a role-less fleet adds ``mixed`` replicas exactly as before.
 - ``tighten`` — over SLO but the fleet cannot (or need not) grow:
   close the ``shed_batch`` admission gate so ``priority="batch"``
   traffic sheds at the front door and interactive traffic keeps the
@@ -31,7 +37,9 @@ merged across every registry in the fleet — "p99 TTFT over the last
 - ``retire`` — the fleet has been completely idle (zero queued, zero
   occupied) for ``idle_to_retire`` consecutive clock units and is
   above ``min_replicas``: drain-and-retire the highest-index active
-  replica through the router's snapshot path.
+  replica through the router's snapshot path. Role-aware: the victim
+  is never the last decode-capable replica, and the router settles
+  any in-flight KV migrations first (docs/ROBUSTNESS.md).
 - ``noop`` — everything inside the envelope.
 
 Decisions are rate-limited by ``cooldown`` (clock units between
@@ -80,6 +88,10 @@ class SLOController:
 
     - ``ttft_slo``: the p99 TTFT budget; windowed p99 above it is the
       scale-up / tighten trigger.
+    - ``tpot_slo``: optional p99 time-per-output-token budget read off
+      the fleet's ``serving_tpot`` histograms; pressure here scales
+      the DECODE pool in a disaggregated fleet. None = TTFT/queue
+      policy only (the pre-disaggregation bit-reference).
     - ``window``: how far back the windowed percentile looks.
     - ``eval_every``: ticks between evaluations (the hook itself is a
       counter increment on the off-ticks).
@@ -101,16 +113,20 @@ class SLOController:
       building. None = pure windowed-TTFT policy.
     """
 
-    def __init__(self, *, ttft_slo: float, window: float = 32.0,
+    def __init__(self, *, ttft_slo: float, tpot_slo: Optional[float] = None,
+                 window: float = 32.0,
                  eval_every: int = 4, min_replicas: int = 1,
                  max_replicas: int = 4, cooldown: float = 16.0,
                  idle_to_retire: float = 32.0, relax_ratio: float = 0.5,
                  min_samples: int = 4, queue_high: Optional[float] = None):
         if ttft_slo <= 0:
             raise ValueError("ttft_slo must be positive")
+        if tpot_slo is not None and tpot_slo <= 0:
+            raise ValueError("tpot_slo must be positive when set")
         if min_replicas < 1 or max_replicas < min_replicas:
             raise ValueError("need 1 <= min_replicas <= max_replicas")
         self.ttft_slo = float(ttft_slo)
+        self.tpot_slo = None if tpot_slo is None else float(tpot_slo)
         self.window = float(window)
         self.eval_every = max(1, int(eval_every))
         self.min_replicas = int(min_replicas)
@@ -138,6 +154,8 @@ class SLOController:
     def _evaluate(self, router, now: float) -> str:
         self._bind(router)
         win = self._window_view(router, now)
+        tpot_win = (self._window_view(router, now, metric="serving_tpot")
+                    if self.tpot_slo is not None else None)
         active = [rep for rep in router.replicas
                   if rep.health not in ("broken", "retired")]
         qdepth = sum(len(rep.srv.queue) for rep in active)
@@ -157,27 +175,42 @@ class SLOController:
         p99, count = win["p99"], win["count"]
         pressure = (self.queue_high is not None and active
                     and qdepth / len(active) > self.queue_high)
-        over = (count >= self.min_samples and p99 > self.ttft_slo) \
-            or pressure
+        ttft_over = count >= self.min_samples and p99 > self.ttft_slo
+        tpot_over = (tpot_win is not None
+                     and tpot_win["count"] >= self.min_samples
+                     and tpot_win["p99"] > self.tpot_slo)
+        over = ttft_over or tpot_over or pressure
         cooled = (self._last_resize is None
                   or now - self._last_resize >= self.cooldown)
+        # disaggregated fleets scale their two pools independently:
+        # TTFT pressure means prefills are queueing (add prefill),
+        # TPOT or queue pressure means decode streams are stalling
+        # (add decode); a role-less fleet keeps adding mixed replicas
+        disagg = any(rep.role == "prefill" for rep in router.replicas)
+        grow_role = "mixed"
+        if disagg:
+            grow_role = ("prefill"
+                         if ttft_over and not (tpot_over or pressure)
+                         else "decode")
 
         action = NOOP
         if over and len(active) < self.max_replicas and cooled \
                 and router.replica_factory is not None:
             idx = router.add_replica(
-                now=now, reason=f"p99 ttft {p99:.3g} (slo "
-                                f"{self.ttft_slo:.3g}), queue {qdepth}")
+                now=now, role=grow_role,
+                reason=f"p99 ttft {p99:.3g} (slo "
+                       f"{self.ttft_slo:.3g}), queue {qdepth}")
             self._last_resize = now
             action = SCALE_UP
-            detail = {"replica": idx}
+            detail = {"replica": idx, "role": grow_role}
         elif over and not router.shed_batch:
             router.shed_batch = True
             action = TIGHTEN
             detail = {}
         elif router.shed_batch \
                 and (count < self.min_samples
-                     or p99 <= self.relax_ratio * self.ttft_slo):
+                     or p99 <= self.relax_ratio * self.ttft_slo) \
+                and not tpot_over:
             # the window shows no pressure (below the hysteresis floor)
             # or no evidence at all (spike cleared, ring drained past
             # the window) — re-open the gate
@@ -186,12 +219,22 @@ class SLOController:
             detail = {}
         elif (not over and len(active) > self.min_replicas and cooled
               and idle_for >= self.idle_to_retire):
-            victim = max(rep.idx for rep in active)
-            router.retire_replica(victim, now=now, reason="sustained idle")
-            self._last_resize = now
-            self._idle_since = now       # restart the idle clock
-            action = RETIRE
-            detail = {"replica": victim}
+            # role-aware victim: never the last decode-capable replica
+            # (the router would refuse; a fleet of only prefill
+            # replicas cannot finish a single request)
+            decode_capable = [r for r in active if r.role != "prefill"]
+            cands = [r for r in active
+                     if r.role == "prefill" or len(decode_capable) > 1]
+            if cands:
+                victim = max(rep.idx for rep in cands)
+                router.retire_replica(victim, now=now,
+                                      reason="sustained idle")
+                self._last_resize = now
+                self._idle_since = now   # restart the idle clock
+                action = RETIRE
+                detail = {"replica": victim}
+            else:
+                detail = {}
         else:
             detail = {}
 
@@ -204,6 +247,10 @@ class SLOController:
             "active_replicas": len(active),
             "shed_batch": router.shed_batch,
         }
+        if tpot_win is not None:
+            decision["p99_tpot"] = tpot_win["p99"]
+            decision["tpot_window_count"] = tpot_win["count"]
+            decision["tpot_slo"] = self.tpot_slo
         decision.update(detail)
         self.decisions.append(decision)
         self._stat["decisions"].inc()
@@ -243,15 +290,17 @@ class SLOController:
             "autoscale_admission_tight",
             "1 while the shed_batch admission gate is closed")
 
-    def _window_view(self, router, now: float) -> Dict[str, float]:
-        """Fleet-windowed TTFT digest: interleave the recent-
-        observation rings of every ``serving_ttft`` histogram in the
+    def _window_view(self, router, now: float,
+                     metric: str = "serving_ttft") -> Dict[str, float]:
+        """Fleet-windowed latency digest for ``metric`` (TTFT by
+        default, TPOT for the decode-pool signal): interleave the
+        recent-observation rings of every matching histogram in the
         fleet into one scratch histogram and summarize the window
         ending at ``now``. Count 0 when telemetry is off fleet-wide."""
-        scratch = Histogram("fleet_ttft_window")
+        scratch = Histogram(f"fleet_{metric}_window")
         pairs = []
         for reg in router.fleet_registries():
-            h = reg._histograms.get("serving_ttft")
+            h = reg._histograms.get(metric)
             if h is not None:
                 pairs.extend(h._ring)
         pairs.sort(key=lambda p: p[0])

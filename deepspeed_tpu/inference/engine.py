@@ -1500,8 +1500,10 @@ class InferenceEngine:
 
     def _gather_blocks_fn(self, k_pool, v_pool, ids):
         """Pull a fixed-width batch of pool blocks (device half of a
-        host-tier spill, paged_cache.spill_tick). Pools stay live —
-        the gathered copy is what travels to host."""
+        host-tier spill, paged_cache.spill_tick, and of a replica-to-
+        replica KV migration, paged_cache.migrate_gather — both ride
+        the SAME compiled program). Pools stay live — the gathered
+        copy is what travels to host."""
         return k_pool[:, ids], v_pool[:, ids]
 
     def gather_blocks(self, k_pool, v_pool, ids):
@@ -1510,7 +1512,10 @@ class InferenceEngine:
 
     def _scatter_block_fn(self, k_pool, v_pool, k_blk, v_blk, dst):
         """Write one restored block back into the donated pools (device
-        half of a host-tier restore, paged_cache._dispatch_restore)."""
+        half of a host-tier restore, paged_cache._dispatch_restore,
+        and of a migration landing, paged_cache.land_parked — the
+        destination replica reuses this program to place migrated
+        blocks free-list-only)."""
         return (k_pool.at[:, dst].set(k_blk),
                 v_pool.at[:, dst].set(v_blk))
 

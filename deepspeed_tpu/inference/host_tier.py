@@ -23,6 +23,14 @@ re-prefills, and NEVER serves wrong K/V as if it were cached truth.
 Budget exhaustion is not an error: :meth:`HostBlockPool.put` returns
 None and the caller leaves the block device-resident, where plain LRU
 eviction — exactly the tier-off behavior — remains the backstop.
+
+The pool has a second consumer beyond spill/restore: the router's
+replica-to-replica KV migration (``router._migrate`` +
+``paged_cache.migrate_gather``/``land_parked``) stages a finished
+prefill's blocks here on the way from a prefill replica's pool to a
+decode replica's — the same CRC32-at-put / verify-at-get contract
+guarantees a corrupted hand-off degrades to a cold re-prefill instead
+of wrong K/V (docs/ROBUSTNESS.md migration ladder).
 """
 
 import zlib

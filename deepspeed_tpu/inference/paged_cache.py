@@ -305,6 +305,12 @@ class PagedKVCache:
         # harvested): excluded from EVERY reclaim/eviction predicate and
         # from free-list returns until the harvest settles them
         self._in_transfer: set = set()
+        # replica-to-replica migration landings (docs/ROBUSTNESS.md):
+        # rid -> (block ids, prefix length). Parked blocks are neither
+        # free nor owned nor indexed — invisible to every reclaim path —
+        # until the request's admission adopts them or a drain/fallback
+        # drops them back onto the free list
+        self._parked: Dict = {}
         self._pending_spill = None   # (ids, gathered device arrays)
         self._spill_cooldown = 0     # ticks until the next spill attempt
         self._spill_backoff = 1      # cooldown applied on the next failure
@@ -323,6 +329,9 @@ class PagedKVCache:
         self.host_restore_failures = 0
         self.host_spill_aborts = 0
         self.host_budget_refusals = 0
+        # migration counters (landings adopted / chains dropped)
+        self.parked_adopted = 0
+        self.parked_aborts = 0
 
     # -- accounting ----------------------------------------------------
     @property
@@ -404,6 +413,9 @@ class PagedKVCache:
             "host_restore_failures": self.host_restore_failures,
             "host_spill_aborts": self.host_spill_aborts,
             "host_budget_refusals": self.host_budget_refusals,
+            "parked_blocks": sum(len(b) for b, _ in self._parked.values()),
+            "parked_adopted": self.parked_adopted,
+            "parked_aborts": self.parked_aborts,
         }
 
     def used_block_bytes(self) -> int:
@@ -892,6 +904,144 @@ class PagedKVCache:
                     self.index is not None and bid in self.index):
                 self._free.append(bid)
         return aborted
+
+    # -- replica-to-replica KV migration (docs/ROBUSTNESS.md) ----------
+    # The disaggregated prefill/decode fleet generalizes the host tier's
+    # CRC-verified transfer path into a replica→replica channel: the
+    # SOURCE cache gathers a finished prefill's whole chain through host
+    # DRAM (per-array CRC32 at put time), the DESTINATION lands the
+    # blocks free-list-only as a PARKED chain its admission later
+    # adopts. Every failure rung — budget refusal, CRC mismatch, dry
+    # free list, a replica dying mid-flight — degrades to a cold
+    # re-prefill on the decode side, never a wrong token.
+
+    def warm_migration(self) -> None:
+        """Compile the transfer gather/scatter up front on trash-block
+        lanes (same programs :meth:`warm_host_tier` warms, but the
+        migration channel needs them with the host tier OFF too), so a
+        role'd fleet's steady state compiles nothing — CompileWatch(0)."""
+        ids = np.zeros((self.transfer_blocks,), np.int32)
+        arrs = self._run_gather(ids)
+        payload = tuple(np.asarray(a[:, 0]) for a in jax.device_get(arrs))
+        self._run_scatter(payload, 0)
+
+    def migrate_gather(self, slot: int, pool: HostBlockPool) -> Dict:
+        """Source half of a migration: pull the slot's owned chain
+        through host DRAM in ``transfer_blocks``-wide batches (the same
+        fixed-width gather the spill daemon uses — short batches pad
+        with trash lanes) and :meth:`HostBlockPool.put` each block, so
+        every array carries a CRC32 tag the landing verifies. Returns
+        ``{"keys", "length", "n_blocks"}`` — the migration's
+        ``kv_handle``. On ANY failure (budget refusal raises
+        :class:`CacheExhausted`) the already-stored keys are discarded
+        and the slot is left untouched: the source still owns its
+        blocks, so the caller can fall back to a cold re-prefill."""
+        if not self.active[slot]:
+            raise ValueError(f"slot {slot} is not active")
+        chain = list(self._owned[slot])
+        keys: List[int] = []
+        try:
+            for start in range(0, len(chain), self.transfer_blocks):
+                ids = chain[start:start + self.transfer_blocks]
+                padded = np.zeros((self.transfer_blocks,), np.int32)
+                padded[:len(ids)] = ids
+                host = jax.device_get(self._run_gather(padded))
+                for i in range(len(ids)):
+                    payload = tuple(np.asarray(a[:, i]) for a in host)
+                    key = pool.put(payload)
+                    if key is None:
+                        raise CacheExhausted(
+                            f"migration host budget refused block "
+                            f"{len(keys) + 1}/{len(chain)}")
+                    keys.append(key)
+        except Exception:
+            for k in keys:
+                pool.discard(k)
+            raise
+        return {"keys": keys, "length": int(self.lengths[slot]),
+                "n_blocks": len(chain)}
+
+    def land_parked(self, rid, keys: List[int], pool: HostBlockPool,
+                    length: int) -> int:
+        """Destination half: CRC-verified fetch of each migrated block
+        and a free-list-ONLY scatter into this pool (landings never
+        evict — the decode side's cache must not be cannibalized by an
+        incoming migration; a dry free list raises
+        :class:`CacheExhausted` and the request re-prefills cold). The
+        landed chain parks under ``rid`` until :meth:`adopt_parked`. A
+        mid-landing failure (corruption, dry list) returns every landed
+        block to the free list and re-raises — the host entries stay
+        the caller's to discard."""
+        if rid in self._parked:
+            raise ValueError(f"request {rid!r} already has a parked chain")
+        landed: List[int] = []
+        try:
+            for key in keys:
+                payload = pool.get(key)      # CRC32 -> HostCorruption
+                if not self._free:
+                    raise CacheExhausted(
+                        f"migration landing needs a free block "
+                        f"({len(landed)}/{len(keys)} landed)")
+                bid = self._free.pop()
+                self._run_scatter(payload, bid)
+                landed.append(bid)
+        except Exception:
+            self._free.extend(reversed(landed))
+            raise
+        self._parked[rid] = (landed, int(length))
+        self._mark()
+        return len(landed)
+
+    def has_parked(self, rid) -> bool:
+        """True when a migrated chain is parked for ``rid``."""
+        return rid in self._parked
+
+    def adopt_parked(self, slot: int, rid) -> int:
+        """Install the parked chain as ``slot``'s owned blocks — the
+        migration analog of :meth:`allocate`'s prefix hit: the slot
+        starts with ``length`` tokens already resident (refcount 1,
+        private — migrated blocks are never shared) and prefill resumes
+        at that offset, covering only the already-emitted tail tokens.
+        Returns the resident prefix length."""
+        if not 0 <= slot < self.num_slots:
+            raise ValueError(f"slot {slot} out of range "
+                             f"[0, {self.num_slots})")
+        if self.active[slot] or self._owned[slot]:
+            raise ValueError(f"slot {slot} is already allocated; free() "
+                             f"it before adopting a parked chain")
+        bids, length = self._parked.pop(rid)
+        for bid in bids:
+            self._refcount[bid] = 1
+        self._owned[slot] = list(bids)
+        self.tables[slot, :] = 0
+        self.tables[slot, :len(bids)] = bids
+        self.lengths[slot] = length
+        self.active[slot] = True
+        self.parked_adopted += 1
+        self._mark()
+        return length
+
+    def drop_parked(self, rid) -> int:
+        """Return a parked chain's blocks to the free list (idempotent
+        — fallback and drain paths may both try). Returns blocks freed."""
+        entry = self._parked.pop(rid, None)
+        if entry is None:
+            return 0
+        bids, _ = entry
+        self._free.extend(reversed(bids))
+        self.parked_aborts += 1
+        return len(bids)
+
+    def abort_parked(self) -> int:
+        """Drop every parked chain — the drain/retire contract, same
+        discipline as :meth:`abort_transfers`: a replica settles its
+        migration landings BEFORE ``pending_snapshot(release=True)``
+        hands its requests away (each dropped chain's request re-
+        prefills cold on a survivor). Returns chains dropped."""
+        rids = list(self._parked)
+        for rid in rids:
+            self.drop_parked(rid)
+        return len(rids)
 
     def drain_restore_ms(self) -> List[float]:
         """Hand the per-restore wall-clock samples (ms) to the caller

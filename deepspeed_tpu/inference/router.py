@@ -88,6 +88,31 @@ once per :meth:`step` and drives both actuators plus the
 ``shed_batch`` admission gate from windowed fleet metrics — default
 ``None``, in which case router behavior is bit-identical to the
 fixed-fleet shape (docs/OBSERVABILITY.md).
+
+**Disaggregation** (docs/ROBUSTNESS.md): replicas optionally carry a
+role — ``prefill`` / ``decode`` / ``mixed`` (the default; ``roles=None``
+keeps the fleet bit-identical to the role-less shape). A prefill
+replica runs chunked prefill only: it emits the FIRST token (TTFT is
+stamped where the prefill ran), parks the request in a ``handoff``
+slot, and the router migrates the finished KV prefix to a decode
+replica through a CRC-verified host-DRAM staging pool — the host
+tier's gather/scatter transfer path generalized replica-to-replica
+(per-array CRC32 at put, free-list-only landing at the destination,
+int8 ``_q`` twins carrying their scale sidecars). The request itself
+rides the snapshot envelope (``snapshot_entry`` extended with a
+``kv_handle``) and resumes decode WITHOUT re-prefilling: admission
+adopts the parked chain. Three chaos sites guard the channel —
+``router.migrate_gather``, ``router.migrate_scatter``,
+``router.migrate_corrupt`` — and the ladder is absolute: ANY failure
+(transient device error, CRC mismatch, host-budget or capacity
+refusal, crash, mid-migration retire or breaker-break) discards the
+partial landing, frees both sides, and re-dispatches the request for
+a cold re-prefill on the decode side. Token-identical either way,
+because snapshot resume re-prefills prompt + already-emitted tokens
+and the sampling key chain is position-pure (docs/SAMPLING.md).
+``router_migrations`` / ``router_migration_fallbacks`` count the two
+outcomes, ``router_replicas_role_<role>`` gauges the pool shapes, and
+the ``migrate`` tracer event records every attempt.
 """
 
 import time
@@ -95,6 +120,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Set
 
 import numpy as np
 
+from deepspeed_tpu.inference.host_tier import HostBlockPool, HostCorruption
+from deepspeed_tpu.inference.paged_cache import CacheExhausted
 from deepspeed_tpu.inference.serving import (DegradedError, ServeRequest,
                                              ServingEngine, _StatsView,
                                              snapshot_entry)
@@ -117,6 +144,12 @@ HEALTHY, SUSPECT, BROKEN, RECOVERING, RETIRED = (
 HEALTH_CODES = {HEALTHY: 0, SUSPECT: 1, BROKEN: 2, RECOVERING: 3,
                 RETIRED: 4}
 
+# replica roles (disaggregated prefill/decode fleets): a "prefill"
+# replica runs chunked prefill only and hands finished prefixes off; a
+# "decode" replica lands migrations and decodes; "mixed" (the default)
+# does both — an all-mixed fleet is bit-identical to the role-less one.
+ROLES = ("prefill", "decode", "mixed")
+
 _ROUTER_STAT_FIELDS = (
     ("steps", "c", "router scheduler iterations"),
     ("dispatched", "c", "requests dispatched to a replica"),
@@ -135,6 +168,10 @@ _ROUTER_STAT_FIELDS = (
     ("retires", "c", "replicas retired from the fleet (retire_replica)"),
     ("shed", "c",
      "requests shed router-side by the tightened-admission gate"),
+    ("migrations", "c",
+     "KV migrations landed prefill->decode (disaggregated handoff)"),
+    ("migration_fallbacks", "c",
+     "migrations degraded to a cold re-prefill on the decode side"),
 )
 
 
@@ -143,9 +180,11 @@ class _Replica:
     state, the consecutive-failure count the breaker watches, and the
     probe rids whose clean completion closes a half-open breaker."""
 
-    def __init__(self, idx: int, srv: ServingEngine):
+    def __init__(self, idx: int, srv: ServingEngine,
+                 role: str = "mixed"):
         self.idx = idx
         self.srv = srv
+        self.role = role
         self.health = HEALTHY
         self.failures = 0            # consecutive, reset on success
         self.probe_rids: Set[Any] = set()
@@ -159,6 +198,9 @@ class ReplicaRouter:
 
     - ``replicas``: the ServingEngine fleet (sharing one
       ``InferenceEngine`` shares its compiled programs).
+    - ``roles``: optional per-replica role list (``prefill`` /
+      ``decode`` / ``mixed``); None = all ``mixed``, bit-identical to
+      the role-less fleet (module docstring, **Disaggregation**).
     - ``replica_factory``: ``(replica_id, checkpoint_tag) ->
       ServingEngine`` used by :meth:`restart_replica`; ``ckpt_dir``
       points the warm restart at a crash-safe checkpoint directory
@@ -180,6 +222,7 @@ class ReplicaRouter:
     """
 
     def __init__(self, replicas: Sequence[ServingEngine], *,
+                 roles: Optional[Sequence[str]] = None,
                  replica_factory: Optional[Callable] = None,
                  ckpt_dir: Optional[str] = None,
                  breaker_threshold: int = 3,
@@ -193,7 +236,26 @@ class ReplicaRouter:
                  flight_dir: Optional[str] = None):
         if not replicas:
             raise ValueError("router needs at least one replica")
-        self.replicas = [_Replica(i, srv) for i, srv in enumerate(replicas)]
+        role_list = (["mixed"] * len(replicas) if roles is None
+                     else [str(r) for r in roles])
+        if len(role_list) != len(replicas):
+            raise ValueError("roles must name one role per replica")
+        for r in role_list:
+            if r not in ROLES:
+                raise ValueError(f"unknown replica role {r!r} "
+                                 f"(expected one of {ROLES})")
+        if "prefill" in role_list and not any(
+                r != "prefill" for r in role_list):
+            raise ValueError(
+                "a disaggregated fleet needs at least one decode-"
+                "capable (decode/mixed) replica")
+        self.replicas = [_Replica(i, srv, role=role_list[i])
+                         for i, srv in enumerate(replicas)]
+        for rep in self.replicas:
+            # the router is the single source of truth for roles: a
+            # prefill replica parks finished prefills for migration
+            # instead of decoding them (serving.py handoff contract)
+            rep.srv.prefill_only = (rep.role == "prefill")
         self.replica_factory = replica_factory
         self.ckpt_dir = ckpt_dir
         self.breaker_threshold = max(1, int(breaker_threshold))
@@ -228,6 +290,13 @@ class ReplicaRouter:
                 f"router_replicas_{state}",
                 f"replicas currently {state}")
             for state in HEALTH_CODES}
+        # pool-shape gauges (disaggregated fleets): non-retired
+        # replicas per role, the SLO controller's per-pool capacity view
+        self._g_role = {
+            role: self.metrics.gauge(
+                f"router_replicas_role_{role}",
+                f"non-retired replicas with the {role} role")
+            for role in ROLES}
         self._update_state_gauges()
         self._h_qwait = (self.metrics.histogram(
             "router_dispatch_queue_wait",
@@ -264,6 +333,16 @@ class ReplicaRouter:
                 sections=self._flight_sections(), label="router")
         else:
             self.flight = NOOP_FLIGHT
+        # replica-to-replica migration channel: one CRC-verified host
+        # staging pool for the whole fleet — the host tier's spill
+        # storage generalized to carry KV between pools
+        # (docs/KV_TIERING.md). Only disaggregated fleets exercise it;
+        # warming every replica's gather/scatter lane up front means
+        # steady-state migrations compile nothing (CompileWatch(0)).
+        self._mig_pool = HostBlockPool()
+        if any(rep.role == "prefill" for rep in self.replicas):
+            for rep in self.replicas:
+                rep.srv.cache.warm_migration()
 
     def _flight_sections(self) -> Dict:
         """Fleet postmortem section providers (called only at dump
@@ -297,6 +376,9 @@ class ReplicaRouter:
     def _update_state_gauges(self) -> None:
         for state, g in self._g_state.items():
             g.set(sum(1 for rep in self.replicas if rep.health == state))
+        for role, g in self._g_role.items():
+            g.set(sum(1 for rep in self.replicas
+                      if rep.role == role and rep.health != RETIRED))
 
     # -- API -----------------------------------------------------------
     def submit(self, req: ServeRequest, now: float = 0.0) -> bool:
@@ -360,6 +442,17 @@ class ReplicaRouter:
                 self._drain(rep, now)
             else:
                 self._note_success(rep, now)
+        # disaggregated handoff harvest: a prefill-role replica whose
+        # chunked prefill just finished parks the request in a handoff
+        # slot — migrate each one to a decode-capable replica now, or
+        # degrade it to a cold re-prefill (never leave it wedged)
+        for rep in list(self.replicas):
+            if rep.health in (BROKEN, RETIRED) or not rep.srv.prefill_only:
+                continue
+            for slot, hreq in list(rep.srv.ready_handoffs()):
+                if rep.health in (BROKEN, RETIRED):
+                    break     # a crash mid-harvest already drained it
+                self._migrate(rep, slot, hreq, now)
         self._rr = (self._rr + 1) % n
         self._clock += 1
         self._stat["steps"].inc()
@@ -434,29 +527,41 @@ class ReplicaRouter:
 
     # -- elasticity ----------------------------------------------------
     def add_replica(self, srv: Optional[ServingEngine] = None,
-                    now: float = 0.0, reason: str = "") -> int:
+                    now: float = 0.0, reason: str = "",
+                    role: str = "mixed") -> int:
         """Grow the fleet by one replica and return its index. With no
         explicit engine the replica comes from ``replica_factory``,
         warm-started from the newest valid checkpoint tag (the same
         walk-back :meth:`restart_replica` uses). The newcomer joins
         ``healthy`` and is immediately dispatchable; sharing the
         fleet's ``InferenceEngine`` means it shares the already-
-        compiled programs, so scale-up compiles nothing."""
+        compiled programs, so scale-up compiles nothing. ``role``
+        places the newcomer in a disaggregated pool (default
+        ``mixed`` — the role-less shape)."""
+        if role not in ROLES:
+            raise ValueError(f"unknown replica role {role!r} "
+                             f"(expected one of {ROLES})")
         idx = len(self.replicas)
         if srv is None:
             if self.replica_factory is None:
                 raise RuntimeError(
                     "add_replica needs an engine or a replica_factory")
             srv = self.replica_factory(idx, self._restart_tag())
-        self.replicas.append(_Replica(idx, srv))
+        self.replicas.append(_Replica(idx, srv, role=role))
+        srv.prefill_only = (role == "prefill")
+        if any(rep.role == "prefill" for rep in self.replicas):
+            # the newcomer may source or land migrations: pre-compile
+            # its gather/scatter lane outside the steady state
+            srv.cache.warm_migration()
         self._g_health.append(self._mk_health_gauge(idx))
         self._g_health[idx].set(HEALTH_CODES[HEALTHY])
         self._update_state_gauges()
         self._stat["scale_ups"].inc()
         self.telemetry.tracer.event(
             "scale", step=self._clock, action="add", replica=idx,
-            reason=reason)
-        logger.info(f"router: replica {idx} added ({reason or 'manual'})")
+            reason=reason, role=role)
+        logger.info(f"router: replica {idx} added as {role} "
+                    f"({reason or 'manual'})")
         return idx
 
     def retire_replica(self, idx: int, now: float = 0.0,
@@ -476,6 +581,18 @@ class ReplicaRouter:
         if not survivors:
             raise ValueError(
                 "cannot retire the last dispatchable replica")
+        if rep.role != "prefill" and all(s.role == "prefill"
+                                         for s in survivors):
+            raise ValueError(
+                "cannot retire the last decode-capable replica")
+        # settle in-flight migrations FIRST (the abort_transfers
+        # discipline): finished prefills parked in handoff slots
+        # migrate out while the replica can still gather; anything that
+        # cannot land degrades to a cold re-prefill on a survivor
+        for slot, hreq in list(rep.srv.ready_handoffs()):
+            if rep.health in (BROKEN, RETIRED):
+                break         # a crash mid-settle already drained it
+            self._migrate(rep, slot, hreq, now)
         self._set_health(rep, RETIRED, now, reason=reason or "scale-down")
         placed = self._drain(rep, now)
         self._stat["retires"].inc()
@@ -548,6 +665,17 @@ class ReplicaRouter:
                 excluded: Set[int]) -> Optional[_Replica]:
         cands = [rep for rep in self.replicas
                  if rep.idx not in excluded and self._dispatchable(rep)]
+        # role fence (disaggregated fleets): resumed/migrated work needs
+        # a decode-capable target — a prefill-only replica would just
+        # hand it off again. Fresh work prefers the prefill pool but may
+        # still land on decode replicas when it is the only pool left
+        # (they are full engines; role is policy, not capability).
+        if bool(len(req.out)):
+            cands = [rep for rep in cands if rep.role != "prefill"]
+        else:
+            pref = [rep for rep in cands if rep.role != "decode"]
+            if pref:
+                cands = pref
         if not cands:
             return None
         best = min(cands, key=lambda rep: (self._load(rep), rep.idx))
@@ -673,6 +801,160 @@ class ReplicaRouter:
                                  reason="probe completed")
                 logger.info(f"router: replica {rep.idx} recovered")
 
+    # -- migration (disaggregated prefill/decode) ----------------------
+    def _decode_target(self, src: _Replica) -> Optional[_Replica]:
+        """Least-loaded decode-capable replica other than ``src`` — the
+        landing side of a KV migration."""
+        cands = [rep for rep in self.replicas
+                 if rep.idx != src.idx and rep.role != "prefill"
+                 and self._dispatchable(rep)]
+        if not cands:
+            return None
+        return min(cands, key=lambda rep: (self._load(rep), rep.idx))
+
+    def _resume_in_place(self, req: ServeRequest, entry: Dict) -> None:
+        """Rebuild ``req`` from its snapshot entry IN the same object:
+        the caller that submitted the request keeps its reference, so
+        ``state``/``finished_at``/``tokens`` stay observable through
+        the migration (load_gen's drive records per-request SLOs off
+        the objects it submitted). Unlike a cross-drain resume, the
+        fleet shares one scheduler clock, so the original latency
+        stamps remain comparable — they are restored by
+        ``_restamp`` after the destination's submit re-stamps them."""
+        fresh = ServeRequest.from_snapshot(entry)
+        req.__dict__.update(fresh.__dict__)
+
+    @staticmethod
+    def _restamp(req: ServeRequest, stamps: tuple) -> None:
+        """Put back the pre-migration latency stamps: ``submitted_at``
+        (submit re-stamped it), ``first_token_at`` (the first token
+        REALLY left the prefill replica before the handoff — TTFT must
+        not be re-measured, nor the TTFT histogram double-observed)
+        and the already-emitted tokens' ``token_times``."""
+        req.submitted_at, req.first_token_at = stamps[0], stamps[1]
+        req.token_times = list(stamps[2]) + list(req.token_times)
+
+    def _migrate(self, src: _Replica, slot: int, req: ServeRequest,
+                 now: float) -> bool:
+        """Move one finished prefill's KV chain from ``src`` (handoff
+        slot ``slot``) to a decode-capable replica through the
+        CRC-verified host-DRAM channel — per-array CRC32 on the way in,
+        free-list-only landing on the way out — then resume the request
+        there WITHOUT re-prefilling (admission adopts the parked chain).
+
+        Degradation ladder (docs/ROBUSTNESS.md): ANY failure — a fault
+        at a ``router.migrate_*`` site, host-budget refusal, CRC
+        mismatch, destination capacity refusal, or a crash that breaks
+        either endpoint — discards the partial landing, frees both
+        sides, and re-dispatches the request for a cold re-prefill on
+        the decode side. Token-identical either way (snapshot resume
+        re-prefills prompt + already-emitted tokens); counted in
+        ``router_migration_fallbacks``. Returns True only for a landed
+        migration."""
+        keys: List[int] = []
+        dest: Optional[_Replica] = None
+        stage = "gather"
+        try:
+            dest = self._decode_target(src)
+            if dest is None:
+                raise TransientDeviceError(
+                    "no decode-capable replica to land the migration")
+            self.faults.fire("router.migrate_gather")
+            handle = src.srv.cache.migrate_gather(slot, self._mig_pool)
+            keys = list(handle["keys"])
+            fault = self.faults.fire("router.migrate_corrupt")
+            if fault is not None and keys:
+                # flip a real stored byte: the genuine per-array CRC32
+                # verify in land_parked drives the degrade below —
+                # corrupted KV can never reach attention as cached truth
+                self._mig_pool.corrupt(keys[0])
+            stage = "scatter"
+            self.faults.fire("router.migrate_scatter")
+            dest.srv.cache.land_parked(req.rid, keys, self._mig_pool,
+                                       handle["length"])
+        except InjectedCrash as e:
+            # a crash breaks the acting endpoint: the gather side is
+            # the source, the scatter side is the destination
+            victim = src if stage == "gather" else dest
+            for k in keys:
+                self._mig_pool.discard(k)
+            if dest is not None:
+                dest.srv.cache.drop_parked(req.rid)
+            self._break(victim, now, f"crash: {e}")
+            self._drain(victim, now)
+            if victim is src:
+                # the drain just snapshotted the handoff request,
+                # resumed it cold on a survivor, and counted it in
+                # migration_fallbacks — nothing left to settle here
+                return False
+            self._migration_fallback(src, req, now, f"crash: {e}",
+                                     dest=dest)
+            return False
+        except (TransientDeviceError, CacheExhausted, HostCorruption) as e:
+            self._migration_fallback(src, req, now, str(e), keys=keys,
+                                     dest=dest)
+            return False
+        # landed: the host copies served their purpose; the destination
+        # owns the device-resident chain (parked until admission adopts)
+        for k in keys:
+            self._mig_pool.discard(k)
+        entry = snapshot_entry(req, kv_handle={
+            "blocks": int(handle["n_blocks"]),
+            "length": int(handle["length"]),
+            "src": src.idx, "dest": dest.idx})
+        src.srv.release_handoff(req.rid)
+        stamps = (req.submitted_at, req.first_token_at,
+                  list(req.token_times))
+        self._resume_in_place(req, entry)
+        ok = dest.srv.submit(req, now=now)
+        if not ok:
+            # bounded-queue shed at the destination: free the landing
+            # and degrade cold on whoever has room
+            dest.srv.cache.drop_parked(req.rid)
+            self._stat["migration_fallbacks"].inc()
+            self.telemetry.tracer.event(
+                "migrate", rid=req.rid, step=self._clock, src=src.idx,
+                dest=dest.idx, ok=False,
+                reason="destination queue full")
+            self._dispatch(req, now, excluded={src.idx, dest.idx})
+            self._restamp(req, stamps)
+            return False
+        self._restamp(req, stamps)
+        if dest.health == RECOVERING:
+            dest.probe_rids.add(req.rid)
+        self._stat["migrations"].inc()
+        self.telemetry.tracer.event(
+            "migrate", rid=req.rid, step=self._clock, src=src.idx,
+            dest=dest.idx, blocks=int(handle["n_blocks"]),
+            length=int(handle["length"]), ok=True)
+        return True
+
+    def _migration_fallback(self, src: _Replica, req: ServeRequest,
+                            now: float, reason: str,
+                            keys: Sequence[int] = (),
+                            dest: Optional[_Replica] = None) -> None:
+        """Bottom rung of the migration ladder: discard the host
+        copies and any partial landing, free the source's handoff
+        slot, and re-dispatch the request for a cold re-prefill on the
+        decode side — the same recompute-on-resume path drains use, so
+        the output stays token-identical."""
+        for k in keys:
+            self._mig_pool.discard(k)
+        if dest is not None:
+            dest.srv.cache.drop_parked(req.rid)
+        entry = snapshot_entry(req)
+        src.srv.release_handoff(req.rid)
+        self._stat["migration_fallbacks"].inc()
+        self.telemetry.tracer.event(
+            "migrate", rid=req.rid, step=self._clock, src=src.idx,
+            dest=(dest.idx if dest is not None else None), ok=False,
+            reason=reason)
+        stamps = (req.submitted_at, req.first_token_at,
+                  list(req.token_times))
+        self._resume_in_place(req, entry)
+        self._dispatch(req, now, excluded={src.idx})
+        self._restamp(req, stamps)
+
     # -- drain ---------------------------------------------------------
     def _drain(self, rep: _Replica, now: float) -> int:
         """Move a broken replica's work to survivors: merge its
@@ -704,10 +986,19 @@ class ReplicaRouter:
         # pending_snapshot(release=True) settles the dead replica's
         # in-flight host-tier spills first (abort_transfers); record how
         # many were cut short so a chaos run's timeline shows the
-        # drain/spill interaction explicitly
+        # drain/spill interaction explicitly. Migrations cut short the
+        # same way — finished prefills still parked in handoff slots
+        # (source side) and landed chains not yet adopted (destination
+        # side, freed by abort_parked) — degrade to cold re-prefills
+        # through the snapshot resume below and count as fallbacks.
+        mig_cut = len(rep.srv.ready_handoffs())
+        parked_aborts_before = rep.srv.cache.parked_aborts
         spill_aborts_before = rep.srv.cache.host_spill_aborts
         snap = rep.srv.pending_snapshot(release=True)
         spill_aborts = rep.srv.cache.host_spill_aborts - spill_aborts_before
+        mig_cut += rep.srv.cache.parked_aborts - parked_aborts_before
+        if mig_cut:
+            self._stat["migration_fallbacks"].inc(mig_cut)
         reqs = [ServeRequest.from_snapshot(s) for s in snap
                 if s["rid"] not in self._results]
         placed = 0
@@ -724,7 +1015,7 @@ class ReplicaRouter:
         self.telemetry.tracer.event(
             "drain", step=self._clock, replica=rep.idx,
             resumed=placed, rids=[r.rid for r in reqs],
-            spill_aborts=spill_aborts)
+            spill_aborts=spill_aborts, migrations_cut=mig_cut)
         logger.warning(
             f"router: drained {placed}/{len(reqs)} in-flight requests "
             f"from replica {rep.idx} onto survivors")

@@ -319,7 +319,10 @@ class ServeRequest:
     n: int = 1
     out: List[int] = field(default_factory=list)
     out_logprobs: List[float] = field(default_factory=list)
-    state: str = "queued"      # queued | prefill | decode | done | timeout | shed
+    state: str = "queued"      # queued | prefill | decode | handoff |
+    #                            done | timeout | shed | error — handoff
+    #                            = finished prefill parked on a
+    #                            prefill-only replica awaiting migration
     token_times: List[float] = field(default_factory=list)
     submitted_at: Optional[float] = None
     first_token_at: Optional[float] = None
@@ -535,7 +538,8 @@ class ServingEngine:
                  decode_horizon: Optional[int] = None,
                  cost_accounting: Optional[bool] = None,
                  flight_recorder: Optional[bool] = None,
-                 flight_dir: Optional[str] = None):
+                 flight_dir: Optional[str] = None,
+                 prefill_only: bool = False):
         if engine.is_encoder:
             raise ValueError("serving needs a causal decoder engine")
         self.engine = engine
@@ -616,6 +620,14 @@ class ServingEngine:
         # same contract for the host-tier transfer programs: the first
         # spill/restore must not compile inside the pinned steady state
         self.cache.warm_host_tier()
+        # disaggregated prefill role (docs/ROBUSTNESS.md): a prefill-only
+        # replica runs chunked prefill, emits the FIRST token (TTFT is
+        # stamped where the prefill ran), then parks the request in
+        # state="handoff" for the router to migrate its KV to a decode
+        # replica — it never runs a decode step for it. Plain flag, no
+        # program change: the decode executables stay compiled/warm, so
+        # flipping a replica's role never recompiles.
+        self.prefill_only = bool(prefill_only)
         self.num_slots = num_slots
         self.prefill_chunk = int(prefill_chunk)
         self.temperature = temperature
@@ -1122,8 +1134,11 @@ class ServingEngine:
             # drain/retire contract (docs/KV_TIERING.md): in-flight
             # spills settle BEFORE any slot releases — a mid-transfer
             # block must be releasable like any other, and the snapshot
-            # path must never race a harvest
+            # path must never race a harvest. Parked migration landings
+            # settle with the same discipline (docs/ROBUSTNESS.md):
+            # their requests re-prefill cold on a survivor
             self.cache.abort_transfers()
+            self.cache.abort_parked()
             for slot, r in enumerate(self.slots):
                 if r is not None:
                     self._release_adapter(slot, r)
@@ -1134,6 +1149,33 @@ class ServingEngine:
             self.queue.clear()
             self._update_backpressure()
         return snap
+
+    # -- disaggregated prefill/decode handoff (docs/ROBUSTNESS.md) -----
+    def ready_handoffs(self) -> List:
+        """Finished prefills parked for migration: ``(slot, req)`` for
+        every slot in ``state="handoff"`` (``prefill_only`` replicas
+        only — a mixed/decode replica never parks). The router harvests
+        these each step and drives the KV migration."""
+        return [(slot, r) for slot, r in enumerate(self.slots)
+                if r is not None and r.state == "handoff"]
+
+    def release_handoff(self, rid) -> bool:
+        """Free the handoff slot for ``rid`` after the router has taken
+        ownership (migrated the KV, or fallen back to a cold resume on
+        the decode side): blocks back to the pool, slot reopened. The
+        request is NOT retired here — its one terminal state lands on
+        the destination replica. Returns False when ``rid`` holds no
+        handoff slot (it timed out or was already released — the
+        caller's snapshot path owns it then)."""
+        for slot, r in enumerate(self.slots):
+            if r is not None and r.rid == rid and r.state == "handoff":
+                self._release_adapter(slot, r)
+                self.cache.free(slot)
+                self.slots[slot] = None
+                self.sampler.release(slot)
+                self._slot_params[slot] = None
+                return True
+        return False
 
     # -- phases ----------------------------------------------------------
     def _expire(self, now: float) -> None:
@@ -1155,6 +1197,9 @@ class ServingEngine:
             if req.deadline is not None and now >= req.deadline:
                 req.state = "timeout"
                 req.finished_at = now
+                # a migrated-in request expiring while queued must
+                # return its parked landing, or the blocks leak
+                self.cache.drop_parked(req.rid)
                 self.finished.append(req)
                 self._stat["timeouts"].inc()
                 self.telemetry.tracer.event(
@@ -1163,6 +1208,15 @@ class ServingEngine:
             else:
                 keep.append(req)
         self.queue = keep
+
+    def _unqueue(self, req: ServeRequest) -> None:
+        """Remove ``req`` from the queue by IDENTITY (dataclass ``==``
+        is unusable on array-carrying requests, and a parked request
+        admitted out of line is not the head)."""
+        for i, r in enumerate(self.queue):
+            if r is req:
+                del self.queue[i]
+                return
 
     def _admit(self, now: float = 0.0) -> None:
         # FIFO head-of-line: no queue jumping, so a preempted-and-
@@ -1183,15 +1237,53 @@ class ServingEngine:
             # adapter's weights — a cross-tenant hit would serve
             # another adapter's activations (docs/ADAPTERS.md)
             tok_key = None if req.adapter_id is not None else req._work
-            ok = self.cache.can_admit(len(req._work), tokens=tok_key,
-                                      watermark=None if occupied else 0)
-            if not ok:
-                break
+            # migrated-in request (docs/ROBUSTNESS.md): the router
+            # already landed its KV chain as a parked chain — adoption
+            # needs no fresh blocks, so admission control is skipped
+            parked = self.cache.has_parked(req.rid)
+            if not parked:
+                ok = self.cache.can_admit(len(req._work), tokens=tok_key,
+                                          watermark=None if occupied
+                                          else 0)
+                if not ok:
+                    # strict head-of-line would deadlock a disagg
+                    # decode replica: the blocks a cold head request
+                    # waits for can be HELD by parked migrated-in
+                    # chains queued BEHIND it, and those only free by
+                    # being served. Adoption consumes no fresh blocks,
+                    # so a parked request may jump a blocked head —
+                    # the one break from FIFO, taken only when FIFO
+                    # cannot make progress (docs/ROBUSTNESS.md).
+                    req = next((r for r in list(self.queue)[1:]
+                                if self.cache.has_parked(r.rid)), None)
+                    if req is None:
+                        break
+                    parked = True
+                    tok_key = (None if req.adapter_id is not None
+                               else req._work)
             cow0 = self.cache.cow_copies
             res0 = self.cache.host_restores
             try:
-                matched = self.cache.allocate(slot, len(req._work),
-                                              tokens=tok_key)
+                if parked:
+                    # the prompt's K/V is already resident: prefill
+                    # covers only the emitted tail tokens (the same
+                    # recompute window a prefix hit leaves), so decode
+                    # resumes without re-prefilling the prompt
+                    matched = self.cache.adopt_parked(slot, req.rid)
+                    try:
+                        # the migrated chain covers exactly the prompt;
+                        # grow it to cover the emitted tail before the
+                        # tail prefill writes there
+                        self.cache.ensure_capacity(slot, len(req._work))
+                    except CacheExhausted:
+                        # cannot grow: degrade to a cold re-prefill —
+                        # free the landing and retry the request as a
+                        # normal admission (never a wrong token)
+                        self.cache.free(slot)
+                        break
+                else:
+                    matched = self.cache.allocate(slot, len(req._work),
+                                                  tokens=tok_key)
             except CacheExhausted:
                 # an injected (or racing) exhaustion at admission: the
                 # request stays at the queue head and retries next step
@@ -1216,7 +1308,7 @@ class ServingEngine:
                     # keeps serving, and a slot NEVER decodes with base
                     # (or stale) weights in place of its named adapter
                     self.cache.free(slot)
-                    self.queue.popleft()
+                    self._unqueue(req)
                     req.state = "error"
                     req.finished_at = now
                     self.finished.append(req)
@@ -1229,7 +1321,7 @@ class ServingEngine:
                         "finish", rid=req.rid, step=self._step_clock,
                         state="error", generated=len(req.out))
                     continue
-            self.queue.popleft()
+            self._unqueue(req)
             self.slots[slot] = req
             if arow is not None:
                 self._slot_arows[slot] = arow
@@ -1237,7 +1329,7 @@ class ServingEngine:
             # blocks' K/V is already resident, so those tokens are
             # never recomputed
             self._progress[slot] = matched
-            if matched > 0:
+            if matched > 0 and not parked:
                 self._stat["prefix_hits"].inc()
                 self._stat["prefix_tokens_saved"].inc(matched)
             req.state = "prefill"
@@ -1326,7 +1418,10 @@ class ServingEngine:
                     float(np.asarray(lp)[0]),  # dslint: disable=DS001 — same single completion-time pull
                     now)
                 if req.state not in TERMINAL_STATES:
-                    req.state = "decode"
+                    # prefill-only role: park the finished prefill for
+                    # the router's KV migration instead of decoding it
+                    req.state = "handoff" if self.prefill_only \
+                        else "decode"
 
     def _decode_step(self, now: float) -> int:
         # every decoding slot needs room for ONE more token; exhaustion

@@ -72,6 +72,18 @@ Sites currently instrumented:
                        (its in-flight work drains onto survivors)
 ``router.drain``       at the start of a dead replica's drain, BEFORE
                        any snapshot/redistribution state moves
+``router.migrate_gather``  before the source replica gathers a finished
+                       prefill's KV blocks into host DRAM for a
+                       replica-to-replica migration; any failure falls
+                       back to cold re-prefill on the decode side
+``router.migrate_scatter``  before the destination replica lands the
+                       migrated blocks free-list-only into its own
+                       pool; failure (including capacity refusal)
+                       discards the partial landing and falls back cold
+``router.migrate_corrupt``  after the gather passed, before the landing
+                       fetch; ``cache_exhausted`` flips a real stored
+                       byte so the genuine per-array CRC32 verify
+                       drives the fallback — never wrong tokens
 ====================== =====================================================
 
 Fault kinds and what firing does:
@@ -158,6 +170,8 @@ KNOWN_SITES = {
     "cache.adapter_load",
     "checkpoint.pre_commit", "checkpoint.commit",
     "router.dispatch", "router.step", "router.drain",
+    "router.migrate_gather", "router.migrate_scatter",
+    "router.migrate_corrupt",
 }
 
 _warned_sites: set = set()

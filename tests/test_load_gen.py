@@ -86,12 +86,29 @@ def test_mix_shape_contracts():
         reqs = make_requests(seed=0, mix=mix, n=64, vocab_size=128,
                              max_prompt_len=48)
         assert len(reqs) == 64
+        components = params.get("components")
         for r in reqs:
             assert 1 <= len(r["prompt"]) <= 48
             assert r["max_new_tokens"] >= 1
             assert r["priority"] in ("interactive", "batch")
-            assert r["kind"] == mix
+            # a composite mix stamps each request with its COMPONENT
+            # kind (that is what keys the per-kind SLO budgets); simple
+            # mixes stamp their own name
+            if components:
+                assert r["kind"] in components
+            else:
+                assert r["kind"] == mix
             assert all(1 <= t < 128 for t in r["prompt"])
+        if components:
+            # both populations must actually appear, and the override
+            # mechanism must bind: rag answers are grounded spans (the
+            # (4, 8) floor), never the 2-token ack of the plain rag mix
+            kinds = {r["kind"] for r in reqs}
+            assert kinds == set(components)
+            lo, hi = params["overrides"]["rag"]["new"]
+            assert all(lo <= r["max_new_tokens"] <= hi
+                       for r in reqs if r["kind"] == "rag")
+            continue
         if params["shared_prefix"]:
             lead = reqs[0]["prompt"][:params["shared_prefix"]]
             assert all(r["prompt"][:len(lead)] == lead for r in reqs)

@@ -30,7 +30,7 @@ if [[ "${1:-}" == "chaos" ]]; then
     echo "gate(chaos): fault-injection smoke (DS_FAULT_SEED=0)"
     DS_FAULT_SEED=0 python -m pytest tests/test_chaos.py \
         tests/test_checkpointing.py tests/test_router.py \
-        tests/test_host_tier.py -q
+        tests/test_host_tier.py tests/test_disagg.py -q
     # tiered-KV three-site ambient injection: spill, restore and CRC
     # corruption all fire against the LIVE serving drives — every one
     # must degrade (blocks stay resident / cold-miss re-prefill), and
@@ -40,6 +40,55 @@ if [[ "${1:-}" == "chaos" ]]; then
     DS_FAULTS="cache.spill:cache_exhausted@0;cache.restore:cache_exhausted@1;cache.host_corrupt:cache_exhausted@0" \
         python -m pytest tests/test_host_tier.py \
         -k "parity or drain_releases" -q
+    # KV-migration three-kind ambient injection over the mixed trace: a
+    # transient gather failure, a REAL flipped host byte caught by the
+    # CRC32 verify at landing, and a crash that breaks the destination
+    # mid-scatter all fire against a live disaggregated fleet — every
+    # one must degrade that request to a cold re-prefill on a decode
+    # survivor, and tokens must stay bit-identical to the uninjected
+    # fleet (docs/ROBUSTNESS.md migration ladder)
+    echo "gate(chaos): KV-migration three-kind injection, mixed trace (ambient DS_FAULTS, DS_FAULT_SEED=0)"
+    DS_FAULT_SEED=0 \
+    DS_FAULTS="router.migrate_gather:device_error@0;router.migrate_corrupt:cache_exhausted@1;router.migrate_scatter:crash@2" \
+        python - <<'PYEOF'
+import jax, jax.numpy as jnp, numpy as np
+from deepspeed_tpu.inference.engine import InferenceEngine
+from deepspeed_tpu.inference.router import ReplicaRouter
+from deepspeed_tpu.inference.serving import ServingEngine
+from deepspeed_tpu.models import gpt
+from deepspeed_tpu.utils.faults import FaultInjector
+from tools.load_gen import _mk_serve_requests, make_requests
+
+cfg = gpt.GPTConfig(vocab_size=128, n_layers=2, n_heads=4, d_model=32,
+                    max_seq_len=96, use_flash_attention=False, remat=False,
+                    dtype=jnp.float32)
+params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+eng = InferenceEngine(config=cfg, params=params, dtype=jnp.float32)
+
+def mk_fleet(n):
+    return [ServingEngine(eng, num_slots=2, block_size=8, num_blocks=24,
+                          prefill_chunk=8, spec_decode=False)
+            for _ in range(n)]
+
+entries = make_requests(seed=0, mix="mixed", phases=[(40, 0.3)],
+                        vocab_size=cfg.vocab_size, max_prompt_len=64)
+# reference: the same disagg fleet under an EXPLICIT empty injector
+# (the ambient DS_FAULTS install must not reach it)
+ref = ReplicaRouter(mk_fleet(3), roles=["prefill", "decode", "decode"],
+                    faults=FaultInjector([], seed=0)
+                    ).run(_mk_serve_requests(entries))
+# chaos fleet: faults=None picks up the ambient injector
+router = ReplicaRouter(mk_fleet(3), roles=["prefill", "decode", "decode"])
+res = router.run(_mk_serve_requests(entries))
+assert set(res) == set(ref), "request set diverged"
+for rid in ref:
+    np.testing.assert_array_equal(res[rid], ref[rid])
+assert router.stats["migration_fallbacks"] >= 3, router.stats
+assert router.stats["breaker_trips"] >= 1, router.stats
+print(f"gate(chaos): migration chaos ok "
+      f"({router.stats['migrations']} migrated, "
+      f"{router.stats['migration_fallbacks']} fell back cold)")
+PYEOF
     # adapter-load injection against the AMBIENT injector install path
     # (the suite's own chaos test builds its injector explicitly): the
     # first acquire fails -> that request retires state="error" with the
@@ -284,6 +333,23 @@ assert res_f["ttft_p99"] > res_p["ttft_p99"], "no SLO contrast"
 PYEOF
     DS_FAULT_SEED=0 python -m pytest tests/test_autoscale.py \
         tests/test_load_gen.py tests/test_router.py -q
+    # disaggregation smoke: at the same chip count, the monolithic
+    # fleet must violate at least one per-kind SLO on the mixed
+    # rag+chat trace while the prefill/decode split holds ALL of them,
+    # with bit-identical tokens and zero steady-state compiles — the
+    # bench-row contract from docs/ROBUSTNESS.md
+    echo "gate: disagg smoke (serve-disagg-smoke SLO contrast)"
+    python - <<'PYEOF'
+from tools.infer_bench import SERVE_COMPARE_CONFIGS, bench_serving_disagg_compare
+kw = dict(next(kw for name, kw in SERVE_COMPARE_CONFIGS
+               if name == "serve-disagg-smoke"))
+kw.pop("mode", None)
+row, _, _, _ = bench_serving_disagg_compare("serve-disagg-smoke", **kw)
+assert row["slo_violated_mono"], "monolithic fleet never violated an SLO"
+assert row["slo_holds_disagg"], f"disagg fleet violated: {row}"
+assert row["output_identical"], "tokens diverged between fleets"
+assert row["steady_state_compiles"] == 0, row["steady_state_compiles"]
+PYEOF
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 fi
 echo "gate: green"

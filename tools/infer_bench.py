@@ -1231,6 +1231,130 @@ def bench_serving_autoscale_compare(name, preset=None, num_slots=2,
     return res_f, res_p, policy
 
 
+def bench_serving_disagg_compare(name, preset=None, num_replicas=2,
+                                 num_slots=2, block_size=8,
+                                 num_blocks=24, prefill_chunk=8,
+                                 phases=((110, 0.27),), seed=3,
+                                 max_prompt=64):
+    """Disaggregated prefill/decode vs monolithic at the SAME chip
+    count (docs/ROBUSTNESS.md): ONE seeded mixed rag+chat load-gen
+    trace (Zipf-popular rag document prefixes) driven through (a)
+    ``num_replicas`` mixed-role replicas and (b) the same replicas
+    split into 1 prefill + N-1 decode roles, KV migrating between
+    pools through the CRC-verified host channel. The monolithic fleet
+    interleaves long rag prefills with interactive chat decodes in the
+    same slots — head-of-line prefill wait and block-pressure
+    preemption violate at least one per-kind p99 SLO budget
+    (tools/load_gen.SLO_TARGETS); the split fleet must hold ALL of
+    them, with byte-identical per-request tokens (``output_identical``
+    — migration resume is exact, and every injected-fault fallback
+    degrades to a cold re-prefill, never a wrong token). The disagg
+    drive runs under ``CompileWatch(0)``: migration gather/scatter
+    lanes are pre-warmed at router construction, so the steady state
+    compiles nothing. Ambient ``DS_FAULTS`` naming the three
+    ``router.migrate_*`` sites turns this row into the chaos leg:
+    ``migration_fallbacks`` goes positive and every assert still
+    holds."""
+    from tools.load_gen import SLO_TARGETS, drive, make_requests
+    from deepspeed_tpu.models import gpt
+    import deepspeed_tpu
+    from deepspeed_tpu.inference.router import ReplicaRouter
+    from deepspeed_tpu.inference.serving import ServeRequest, ServingEngine
+    from deepspeed_tpu.telemetry import Telemetry
+    from deepspeed_tpu.utils.compile_guard import CompileWatch
+
+    on_tpu = "tpu" in (jax.devices()[0].platform +
+                       jax.devices()[0].device_kind).lower()
+    max_seq = max_prompt + 16 + 8
+    if preset:
+        cfg = gpt.preset(preset, max_seq_len=max_seq, dtype=jnp.bfloat16,
+                         use_flash_attention=on_tpu)
+    else:
+        cfg = gpt.GPTConfig(vocab_size=512, n_layers=4, n_heads=8,
+                            d_model=256, max_seq_len=max_seq,
+                            use_flash_attention=False, remat=False,
+                            dtype=jnp.float32)
+    eng = deepspeed_tpu.init_inference(
+        model=(cfg, gpt.init_params(jax.random.PRNGKey(0), cfg)),
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+
+    entries = make_requests(seed=seed, mix="mixed", phases=list(phases),
+                            vocab_size=cfg.vocab_size,
+                            max_prompt_len=max_prompt)
+
+    def mk_srv(tel):
+        return ServingEngine(eng, num_slots=num_slots,
+                             block_size=block_size, num_blocks=num_blocks,
+                             prefill_chunk=prefill_chunk,
+                             spec_decode=False, telemetry=tel)
+
+    # warmup: compile prefill/decode slot programs outside both drives
+    mk_srv(None).run([ServeRequest(
+        rid="w", prompt=np.asarray(entries[0]["prompt"], np.int32),
+        max_new_tokens=2)])
+
+    def kind_p99(res, key):
+        out = {}
+        for kind in ("chat", "rag"):
+            vals = [r[key] for r in res["per_request"]
+                    if r["kind"] == kind and r[key] is not None]
+            out[kind] = (float(np.percentile(np.asarray(vals), 99))
+                         if vals else 0.0)
+        return out
+
+    def slo_holds(res):
+        ttft, tpot = kind_p99(res, "ttft"), kind_p99(res, "tpot")
+        return all(ttft[k] <= SLO_TARGETS[k]["ttft"]
+                   and tpot[k] <= SLO_TARGETS[k]["tpot"]
+                   for k in ("chat", "rag"))
+
+    # (a) monolithic: every replica mixed-role — the contention shape
+    tel_m = Telemetry()
+    mono = ReplicaRouter([mk_srv(tel_m) for _ in range(num_replicas)],
+                         telemetry=tel_m)
+    res_m = drive(mono, entries, mode="open", include_tokens=True)
+
+    # (b) same chip count, split roles: KV migrates prefill -> decode.
+    # Router construction pre-warms the migration gather/scatter lanes,
+    # so the watched drive must compile NOTHING.
+    tel_d = Telemetry()
+    roles = ["prefill"] + ["decode"] * (num_replicas - 1)
+    disagg = ReplicaRouter([mk_srv(tel_d) for _ in range(num_replicas)],
+                           roles=roles, telemetry=tel_d)
+    watch = CompileWatch(max_compiles=0, label="disagg steady state")
+    with watch:
+        res_d = drive(disagg, entries, mode="open", include_tokens=True)
+
+    toks_m = {r["rid"]: r["tokens"] for r in res_m["per_request"]}
+    toks_d = {r["rid"]: r["tokens"] for r in res_d["per_request"]}
+    identical = toks_m == toks_d
+
+    ttft_m, tpot_m = kind_p99(res_m, "ttft"), kind_p99(res_m, "tpot")
+    ttft_d, tpot_d = kind_p99(res_d, "ttft"), kind_p99(res_d, "tpot")
+    snap = disagg.fleet_snapshot()
+    row = {
+        "config": name, "preset": preset or "cpu-smoke",
+        "disagg": f"{num_replicas}-mixed-vs-1prefill+"
+                  f"{num_replicas - 1}decode",
+        "num_requests": len(entries),
+        "slo_targets": {k: SLO_TARGETS[k] for k in ("chat", "rag")},
+        "ttft_p99_mono": {k: round(v, 2) for k, v in ttft_m.items()},
+        "tpot_p99_mono": {k: round(v, 2) for k, v in tpot_m.items()},
+        "ttft_p99_disagg": {k: round(v, 2) for k, v in ttft_d.items()},
+        "tpot_p99_disagg": {k: round(v, 2) for k, v in tpot_d.items()},
+        "slo_violated_mono": not slo_holds(res_m),
+        "slo_holds_disagg": slo_holds(res_d),
+        "migrations": snap["counters"]["router_migrations"],
+        "migration_fallbacks":
+            snap["counters"]["router_migration_fallbacks"],
+        "output_identical": identical,
+        "steady_state_compiles": watch.compiles,
+        "steps_mono": res_m["steps"], "steps_disagg": res_d["steps"],
+    }
+    print(json.dumps(row), flush=True)
+    return row, res_m, res_d, disagg
+
+
 SERVE_CONFIGS = [
     # CPU-verifiable smoke: staggered Poisson arrivals must batch
     # (mean_occupancy > 1) and the paged footprint must undercut the
@@ -1375,6 +1499,20 @@ SERVE_COMPARE_CONFIGS = [
         mode="autoscale", preset="gpt2-medium", num_slots=4,
         block_size=16, prefill_chunk=64, max_replicas=3, ttft_slo=12.0,
         phases=((6, 0.2), (60, 0.5), (30, 0.05)))),
+    # disaggregated prefill/decode at the same chip count: the mixed
+    # rag+chat trace must violate at least one per-kind p99 SLO budget
+    # on the monolithic fleet while the 1-prefill+1-decode split holds
+    # ALL of them, with byte-identical tokens (migration resume is
+    # exact) and zero compiles in the watched steady state
+    ("serve-disagg-smoke", dict(mode="disagg", num_replicas=2,
+                                num_slots=2, block_size=8,
+                                num_blocks=24, prefill_chunk=8,
+                                phases=((110, 0.27),), seed=3,
+                                max_prompt=64)),
+    ("serve-disagg-gpt2-medium", dict(
+        mode="disagg", preset="gpt2-medium", num_replicas=2,
+        num_slots=2, block_size=8, num_blocks=24, prefill_chunk=8,
+        phases=((110, 0.27),), seed=3, max_prompt=64)),
     # multi-tenant LoRA serving: merged-single vs unmerged-single must
     # stream identically (the bit-parity contract), and the mixed
     # Zipf-tenant drive must match per-tenant merged references while
@@ -1434,6 +1572,27 @@ def _backend_probe(timeout=240):
         return False, f"probe spawn failed: {repr(e)[:200]}"
 
 
+def _classify_probe_failure(reason):
+    """Bucket a probe-failure reason string into a stable machine key,
+    so a dashboard can aggregate outages by CLASS ("timeout" = wedged
+    tunnel, "no_device" = backend up but empty, "import_error" = broken
+    deploy) without regexing free-text stderr tails. The free-text
+    ``reason`` still rides alongside for humans."""
+    if reason is None:
+        return None
+    low = reason.lower()
+    if "hung past" in low or "timeout" in low:
+        return "timeout"
+    if "spawn failed" in low:
+        return "spawn_error"
+    if "importerror" in low or "modulenotfounderror" in low:
+        return "import_error"
+    if ("no devices" in low or "unable to initialize backend" in low
+            or "failed to connect" in low):
+        return "no_device"
+    return "other"
+
+
 def _wait_for_backend():
     """Bounded recovery loop with exponential backoff: a transient
     tunnel wedge must not forfeit the whole bench round, but an
@@ -1467,7 +1626,9 @@ def main():
         # "backend gone" from "bench crashed" without parsing stderr
         print(json.dumps({"config": "backend-probe", "probe_fail": True,
                           "status": "error:backend_unreachable",
-                          "reason": reason, "attempts": attempts}),
+                          "reason": reason,
+                          "reason_kind": _classify_probe_failure(reason),
+                          "attempts": attempts}),
               flush=True)
         return
     for name, kw in CONFIGS:
@@ -1507,6 +1668,7 @@ def main():
                    "router": bench_serving_router_compare,
                    "sampling": bench_serving_sampling_compare,
                    "autoscale": bench_serving_autoscale_compare,
+                   "disagg": bench_serving_disagg_compare,
                    "lora": bench_serving_lora_compare,
                    "horizon": bench_serving_horizon_compare,
                    "cost_attrib": bench_serving_cost_attrib,

@@ -14,7 +14,10 @@ bench row regressed bit-for-bit. This module provides both halves:
   heavy), ``heavy_tail`` (adversarial Pareto-tailed lengths) and
   ``multitenant`` (a Zipf-popular LoRA tenant population plus a
   base-only fraction — the adapter-pool / adapter-affinity shape,
-  docs/ADAPTERS.md);
+  docs/ADAPTERS.md) and ``mixed`` (the rag and chat populations
+  interleaved, rag prefixes Zipf-popular, per-kind SLO budgets in
+  :data:`SLO_TARGETS` — the disaggregated prefill/decode workload,
+  docs/ROBUSTNESS.md);
 - **arrivals**: an open-loop Poisson process over piecewise-constant
   rate ``phases`` (``[(duration, rate), ...]`` — a spike is just a
   high-rate middle phase), or a burst (every request at t=0) for
@@ -71,6 +74,39 @@ MIXES: Dict[str, Dict[str, Any]] = {
     "multitenant": dict(plen=(6, 16), new=(4, 12), shared_prefix=0,
                         alphabet=None, batch_frac=0.2, pareto=False,
                         adapters=6, zipf_a=1.5, base_frac=0.25),
+    # mixed (the disaggregation workload, docs/ROBUSTNESS.md): the rag
+    # and chat populations interleaved — long batch-heavy prefills
+    # fighting short interactive decodes for the same slots is exactly
+    # the contention the prefill/decode split resolves. Each request
+    # keeps its component's kind/priority/SLO budget; rag requests
+    # draw their document prefix from a Zipf-popular family (a few hot
+    # contexts, a long warm tail). Composite: per-request parameters
+    # come from the named component mixes.
+    # Overrides reshape the components for disaggregation stress: rag
+    # prompts grow to real document length (40-64 tokens, 5-8 prefill
+    # chunks — the head-of-line block a mixed fleet suffers) and its
+    # answers become grounded spans rather than 2-token acks (a
+    # 2-token stream's "mean inter-token gap" is ONE gap, so TPOT
+    # would be meaningless); chat answers lengthen so its decode
+    # stream is long enough for inter-token stalls to register.
+    "mixed": dict(components=("chat", "rag"), rag_frac=0.6,
+                  prefix_families=4, zipf_a=1.4,
+                  overrides={"rag": {"plen": (40, 64), "new": (4, 8)},
+                             "chat": {"new": (6, 16)}}),
+}
+
+# per-kind SLO budgets in scheduler token-time units (one unit ≈ one
+# decode iteration): ``ttft`` bounds submit -> first token, ``tpot``
+# bounds the mean inter-token gap of the decode stream. These are the
+# targets the disagg compare row must hold for BOTH kinds at once
+# (tools/infer_bench.py bench_serving_disagg_compare); drive() records
+# the raw per-request numbers so attainment is offline-recomputable.
+SLO_TARGETS: Dict[str, Dict[str, float]] = {
+    "chat": {"ttft": 12.0, "tpot": 2.5},
+    "rag": {"ttft": 14.0, "tpot": 8.0},
+    "repetitive": {"ttft": 16.0, "tpot": 3.0},
+    "heavy_tail": {"ttft": 30.0, "tpot": 4.0},
+    "multitenant": {"ttft": 16.0, "tpot": 3.0},
 }
 
 TRACE_VERSION = 1
@@ -117,6 +153,10 @@ def make_requests(*, seed: int, mix: str = "chat", n: Optional[int] = None,
     else:
         ats = [0.0] * int(n)
     rng = np.random.default_rng(seed + 1)     # independent of arrivals
+    if "components" in params:
+        return _composite_requests(mix, params, ats, rng,
+                                   vocab_size=vocab_size,
+                                   max_prompt_len=max_prompt_len)
     lo_tok, hi_tok = 1, vocab_size            # 0 reserved (pad/eos)
     if params["alphabet"]:
         hi_tok = min(vocab_size, lo_tok + params["alphabet"])
@@ -156,6 +196,51 @@ def make_requests(*, seed: int, mix: str = "chat", n: Optional[int] = None,
     return out
 
 
+def _composite_requests(mix: str, params: Dict, ats: List[float],
+                        rng: np.random.Generator, *, vocab_size: int,
+                        max_prompt_len: int) -> List[Dict]:
+    """Composite-mix population (``components`` in MIXES): each request
+    draws its component by ``rag_frac`` and keeps that component's
+    ``kind`` (so per-kind SLO budgets in :data:`SLO_TARGETS` apply
+    per request). Chat requests share one system prefix; rag requests
+    pick their document prefix from a Zipf-popular family. Pure in the
+    passed ``rng`` — same seed, byte-identical trace."""
+    comp = {name: dict(MIXES[name], **params.get("overrides", {})
+                       .get(name, {}))
+            for name in params["components"]}
+    lo_tok = 1
+    chat_shared = rng.integers(
+        lo_tok, vocab_size, comp["chat"]["shared_prefix"]).tolist()
+    families = [rng.integers(lo_tok, vocab_size,
+                             comp["rag"]["shared_prefix"]).tolist()
+                for _ in range(int(params["prefix_families"]))]
+    out: List[Dict] = []
+    for i, at in enumerate(ats):
+        kind = "rag" if rng.random() < params["rag_frac"] else "chat"
+        p = comp[kind]
+        plen = min(int(rng.integers(p["plen"][0], p["plen"][1] + 1)),
+                   max_prompt_len)
+        if kind == "rag":
+            fam = (int(rng.zipf(params["zipf_a"])) - 1) % len(families)
+            shared = families[fam]
+        else:
+            shared = chat_shared
+        tail = max(1, plen - len(shared))
+        prompt = shared + rng.integers(lo_tok, vocab_size, tail).tolist()
+        out.append({
+            "rid": f"{mix}-{i}",
+            "at": float(at),
+            "kind": kind,
+            "priority": ("batch" if rng.random() < p["batch_frac"]
+                         else "interactive"),
+            "adapter_id": None,
+            "prompt": [int(t) for t in prompt],
+            "max_new_tokens": int(rng.integers(p["new"][0],
+                                               p["new"][1] + 1)),
+        })
+    return out
+
+
 def save_trace(path: str, requests: List[Dict], *, seed: int,
                mix: str = "", meta: Optional[Dict] = None) -> str:
     """Persist a request population as a replayable JSON trace."""
@@ -190,7 +275,7 @@ def _mk_serve_requests(entries: List[Dict]) -> List:
 
 def drive(target, entries: List[Dict], *, mode: str = "open",
           concurrency: int = 8, slo_ttft: Optional[float] = None,
-          max_steps: int = 100_000) -> Dict:
+          max_steps: int = 100_000, include_tokens: bool = False) -> Dict:
     """Run a generated population against ``target`` (ServingEngine or
     ReplicaRouter — anything with ``submit(req, now)`` / ``step(now)``
     / ``busy``), stepping the scheduler clock in token-time units —
@@ -205,12 +290,17 @@ def drive(target, entries: List[Dict], *, mode: str = "open",
       as soon as one finishes (throughput-probe shape).
 
     Returns ``{"per_request": [...], "steps", "slo_attainment",
-    "ttft_p50/p95/p99"}`` where each per-request record carries
-    ``submitted_at`` / ``first_token_at`` / ``finished_at`` / ``state``
-    — the offline-recomputable SLO record. ``slo_attainment`` (when
-    ``slo_ttft`` is given) counts a request attained iff it got its
-    first token within the budget; requests that never produced one
-    (shed, still queued at exhaustion) count as misses."""
+    "ttft_p50/p95/p99", "tpot_p50/p95/p99"}`` where each per-request
+    record carries ``submitted_at`` / ``first_token_at`` /
+    ``finished_at`` / ``state`` / ``ttft`` / ``tpot`` — the offline-
+    recomputable SLO record (``tpot`` is the mean inter-token gap of
+    the decode stream, None for < 2 generated tokens).
+    ``slo_attainment`` (when ``slo_ttft`` is given) counts a request
+    attained iff it got its first token within the budget; requests
+    that never produced one (shed, still queued at exhaustion) count
+    as misses. ``include_tokens=True`` embeds each request's final
+    ``tokens`` so two runs can assert token-identical output (the
+    disagg compare row's ``output_identical`` check)."""
     if mode not in ("open", "closed"):
         raise ValueError(f"mode must be open|closed, got {mode!r}")
     if hasattr(target, "token_time_unit"):
@@ -261,6 +351,7 @@ def drive(target, entries: List[Dict], *, mode: str = "open",
 
     per_request: List[Dict] = []
     ttfts: List[float] = []
+    tpots: List[float] = []
     attained = 0
     for e, r in zip(entries, reqs):
         ttft = (r.first_token_at - r.submitted_at
@@ -270,16 +361,26 @@ def drive(target, entries: List[Dict], *, mode: str = "open",
             ttfts.append(ttft)
             if slo_ttft is not None and ttft <= slo_ttft:
                 attained += 1
-        per_request.append({
+        tpot = ((r.finished_at - r.first_token_at) / (len(r.out) - 1)
+                if r.first_token_at is not None
+                and r.finished_at is not None and len(r.out) > 1
+                else None)
+        if tpot is not None:
+            tpots.append(tpot)
+        rec = {
             "rid": e["rid"], "kind": e["kind"],
             "priority": e.get("priority"), "arrival": e["at"],
             "submitted_at": r.submitted_at,
             "first_token_at": r.first_token_at,
             "finished_at": r.finished_at,
-            "state": r.state, "ttft": ttft,
+            "state": r.state, "ttft": ttft, "tpot": tpot,
             "generated": len(r.out),
-        })
+        }
+        if include_tokens:
+            rec["tokens"] = [int(t) for t in r.tokens]
+        per_request.append(rec)
     arr = np.asarray(ttfts) if ttfts else np.asarray([0.0])
+    tarr = np.asarray(tpots) if tpots else np.asarray([0.0])
     return {
         "per_request": per_request,
         "steps": steps,
@@ -289,6 +390,9 @@ def drive(target, entries: List[Dict], *, mode: str = "open",
         "ttft_p50": float(np.percentile(arr, 50)),
         "ttft_p95": float(np.percentile(arr, 95)),
         "ttft_p99": float(np.percentile(arr, 99)),
+        "tpot_p50": float(np.percentile(tarr, 50)),
+        "tpot_p95": float(np.percentile(tarr, 95)),
+        "tpot_p99": float(np.percentile(tarr, 99)),
     }
 
 
